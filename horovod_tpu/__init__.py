@@ -1,0 +1,86 @@
+"""horovod_tpu — a TPU-native distributed training framework with the
+capabilities of Horovod (allreduce-based data parallelism, coordinator
+negotiation with tensor fusion / response cache / autotune, timeline, stall
+inspection, a ``horovodrun``-style launcher) built on JAX/XLA for the TPU
+data plane and a C++ host runtime for the control plane and host tensors.
+
+Top level exposes the framework-agnostic (numpy) API; framework bindings
+live in ``horovod_tpu.jax``, ``horovod_tpu.torch``, ``horovod_tpu.keras``,
+``horovod_tpu.tensorflow``, ``horovod_tpu.mxnet``.
+"""
+
+import atexit as _atexit
+
+from .common import (  # noqa: F401
+    HorovodInternalError,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    broadcast,
+    broadcast_async,
+    get_basics,
+    poll,
+    synchronize,
+)
+
+__version__ = "0.1.0"
+
+_initialized_here = False
+
+
+def init():
+    """Initializes the core runtime (rendezvous + background thread).
+
+    Reference analogue: ``hvd.init()`` -> ``horovod/common/basics.py:29-60``.
+    """
+    global _initialized_here
+    get_basics().init()
+    if not _initialized_here:
+        _atexit.register(shutdown)
+        _initialized_here = True
+
+
+def shutdown():
+    """Coordinated shutdown of the core runtime."""
+    get_basics().shutdown()
+
+
+def is_initialized():
+    return get_basics().initialized()
+
+
+def rank():
+    return get_basics().rank()
+
+
+def local_rank():
+    return get_basics().local_rank()
+
+
+def cross_rank():
+    return get_basics().cross_rank()
+
+
+def size():
+    return get_basics().size()
+
+
+def local_size():
+    return get_basics().local_size()
+
+
+def cross_size():
+    return get_basics().cross_size()
+
+
+def is_homogeneous():
+    return get_basics().is_homogeneous()
+
+
+def tcp_built():
+    return get_basics().tcp_built()
+
+
+def cpu_ops_built():
+    return get_basics().cpu_ops_built()

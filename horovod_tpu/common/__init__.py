@@ -1,0 +1,12 @@
+from .basics import HorovodBasics, get_basics  # noqa: F401
+from .ops import (  # noqa: F401
+    HorovodInternalError,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    broadcast,
+    broadcast_async,
+    poll,
+    synchronize,
+)

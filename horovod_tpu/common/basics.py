@@ -1,0 +1,156 @@
+"""ctypes binding to the native core runtime (libhorovod_tpu.so).
+
+Capability parity with the reference ``horovod/common/basics.py:22-197``
+(HorovodBasics): process-wide init/shutdown/rank/size queries and build
+probes, plus the handle-based enqueue/wait surface the collective wrappers
+use (reference analogue: the torch binding's handle manager,
+``horovod/torch/mpi_ops.py:58-90``).
+"""
+
+import ctypes
+import os
+
+import numpy as np
+
+_MOD_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_MOD_DIR, "..", "native", "libhorovod_tpu.so")
+
+# DataType enum values must match native/message.h.
+_NUMPY_TO_DTYPE = {
+    np.dtype(np.uint8): 0,
+    np.dtype(np.int8): 1,
+    np.dtype(np.uint16): 2,
+    np.dtype(np.int16): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int64): 5,
+    np.dtype(np.float16): 6,
+    np.dtype(np.float32): 7,
+    np.dtype(np.float64): 8,
+    np.dtype(np.bool_): 9,
+}
+
+_DTYPE_TO_NUMPY = {v: k for k, v in _NUMPY_TO_DTYPE.items()}
+
+HVD_BFLOAT16 = 10
+
+try:  # ml_dtypes ships with jax; bfloat16 is the native TPU 16-bit format.
+    import ml_dtypes
+
+    _NUMPY_TO_DTYPE[np.dtype(ml_dtypes.bfloat16)] = HVD_BFLOAT16
+    _DTYPE_TO_NUMPY[HVD_BFLOAT16] = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    pass
+
+
+def numpy_to_hvd_dtype(dtype):
+    dt = np.dtype(dtype)
+    if dt not in _NUMPY_TO_DTYPE:
+        raise ValueError("Unsupported dtype for horovod_tpu collective: %s"
+                         % dt)
+    return _NUMPY_TO_DTYPE[dt]
+
+
+class HorovodBasics:
+    """Wraps the extern "C" API exported by the native core."""
+
+    def __init__(self, lib_path=_LIB_PATH):
+        self.lib = ctypes.CDLL(os.path.abspath(lib_path),
+                               mode=ctypes.RTLD_GLOBAL)
+        lib = self.lib
+        lib.horovod_tpu_init.restype = ctypes.c_int
+        for fn in ("horovod_tpu_rank", "horovod_tpu_local_rank",
+                   "horovod_tpu_cross_rank", "horovod_tpu_size",
+                   "horovod_tpu_local_size", "horovod_tpu_cross_size",
+                   "horovod_tpu_initialized", "horovod_tpu_is_homogeneous",
+                   "horovod_tpu_tcp_built", "horovod_tpu_cpu_ops_built"):
+            getattr(lib, fn).restype = ctypes.c_int
+        lib.horovod_tpu_enqueue_allreduce.restype = ctypes.c_int
+        lib.horovod_tpu_enqueue_allreduce.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_double,
+            ctypes.c_double,
+        ]
+        lib.horovod_tpu_enqueue_allgather.restype = ctypes.c_int
+        lib.horovod_tpu_enqueue_allgather.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ]
+        lib.horovod_tpu_enqueue_broadcast.restype = ctypes.c_int
+        lib.horovod_tpu_enqueue_broadcast.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+        ]
+        lib.horovod_tpu_poll.restype = ctypes.c_int
+        lib.horovod_tpu_poll.argtypes = [ctypes.c_int]
+        lib.horovod_tpu_wait.restype = ctypes.c_int
+        lib.horovod_tpu_wait.argtypes = [ctypes.c_int]
+        lib.horovod_tpu_error_string.restype = ctypes.c_char_p
+        lib.horovod_tpu_error_string.argtypes = [ctypes.c_int]
+        lib.horovod_tpu_allgather_bytes.restype = ctypes.c_int64
+        lib.horovod_tpu_allgather_bytes.argtypes = [ctypes.c_int]
+        lib.horovod_tpu_allgather_rank_dim.restype = ctypes.c_int64
+        lib.horovod_tpu_allgather_rank_dim.argtypes = [ctypes.c_int,
+                                                       ctypes.c_int]
+        lib.horovod_tpu_allgather_copy.restype = ctypes.c_int
+        lib.horovod_tpu_allgather_copy.argtypes = [ctypes.c_int,
+                                                   ctypes.c_void_p]
+        lib.horovod_tpu_release.argtypes = [ctypes.c_int]
+
+    # -- lifecycle ---------------------------------------------------------
+    def init(self):
+        if not self.lib.horovod_tpu_init():
+            raise RuntimeError(
+                "horovod_tpu initialization failed (rendezvous error?). "
+                "Check HVD_TPU_ADDRS / HVD_TPU_RANK / HVD_TPU_SIZE.")
+
+    def shutdown(self):
+        self.lib.horovod_tpu_shutdown()
+
+    def initialized(self):
+        return bool(self.lib.horovod_tpu_initialized())
+
+    # -- topology ----------------------------------------------------------
+    def rank(self):
+        return self._query("horovod_tpu_rank")
+
+    def local_rank(self):
+        return self._query("horovod_tpu_local_rank")
+
+    def cross_rank(self):
+        return self._query("horovod_tpu_cross_rank")
+
+    def size(self):
+        return self._query("horovod_tpu_size")
+
+    def local_size(self):
+        return self._query("horovod_tpu_local_size")
+
+    def cross_size(self):
+        return self._query("horovod_tpu_cross_size")
+
+    def is_homogeneous(self):
+        return bool(self.lib.horovod_tpu_is_homogeneous())
+
+    def _query(self, fn):
+        value = getattr(self.lib, fn)()
+        if value == -1:
+            raise ValueError(
+                "Horovod-TPU has not been initialized; call hvd.init() first.")
+        return value
+
+    # -- build probes ------------------------------------------------------
+    def tcp_built(self):
+        return bool(self.lib.horovod_tpu_tcp_built())
+
+    def cpu_ops_built(self):
+        return bool(self.lib.horovod_tpu_cpu_ops_built())
+
+
+_basics = None
+
+
+def get_basics():
+    global _basics
+    if _basics is None:
+        _basics = HorovodBasics()
+    return _basics

@@ -1,0 +1,129 @@
+"""Framework-agnostic host collectives over the native core.
+
+Async handle semantics mirror the reference torch binding
+(``horovod/torch/mpi_ops.py:58-90,413-445``): ``*_async`` returns an int
+handle, ``poll``/``synchronize`` complete it, and a module-level handle map
+keeps the numpy buffers alive until the background thread is done with them.
+"""
+
+import ctypes
+
+import numpy as np
+
+from .basics import get_basics, numpy_to_hvd_dtype, _DTYPE_TO_NUMPY
+
+# handle -> (input array, output array or None) — keeps buffers alive while
+# the background thread works on them.
+_handle_map = {}
+
+# Status codes must match native/common.h StatusType.
+_STATUS_OK = 0
+_STATUS_IN_PROGRESS = 5
+
+
+class HorovodInternalError(RuntimeError):
+    pass
+
+
+def _shape_array(arr):
+    return (ctypes.c_int64 * arr.ndim)(*arr.shape)
+
+
+def allreduce_async(tensor, name, prescale_factor=1.0, postscale_factor=1.0):
+    """Starts an allreduce (sum) on a numpy array; returns a handle."""
+    basics = get_basics()
+    arr = np.ascontiguousarray(tensor)
+    out = np.empty_like(arr)
+    handle = basics.lib.horovod_tpu_enqueue_allreduce(
+        name.encode("utf-8"), arr.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p), arr.ndim, _shape_array(arr),
+        numpy_to_hvd_dtype(arr.dtype), float(prescale_factor),
+        float(postscale_factor))
+    _handle_map[handle] = (arr, out)
+    return handle
+
+
+def allgather_async(tensor, name):
+    """Starts an allgather along dim 0; returns a handle."""
+    basics = get_basics()
+    arr = np.ascontiguousarray(tensor)
+    if arr.ndim == 0:
+        arr = arr.reshape(1)
+    handle = basics.lib.horovod_tpu_enqueue_allgather(
+        name.encode("utf-8"), arr.ctypes.data_as(ctypes.c_void_p), arr.ndim,
+        _shape_array(arr), numpy_to_hvd_dtype(arr.dtype))
+    _handle_map[handle] = (arr, None)
+    return handle
+
+
+def broadcast_async(tensor, root_rank, name):
+    """Starts a broadcast from root_rank; returns a handle."""
+    basics = get_basics()
+    arr = np.ascontiguousarray(tensor)
+    out = np.empty_like(arr)
+    handle = basics.lib.horovod_tpu_enqueue_broadcast(
+        name.encode("utf-8"), arr.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p), arr.ndim, _shape_array(arr),
+        numpy_to_hvd_dtype(arr.dtype), int(root_rank))
+    _handle_map[handle] = (arr, out)
+    return handle
+
+
+def poll(handle):
+    """True when the collective behind `handle` completed."""
+    return bool(get_basics().lib.horovod_tpu_poll(handle))
+
+
+def synchronize(handle):
+    """Blocks until completion; returns the result array."""
+    basics = get_basics()
+    if handle not in _handle_map:
+        raise ValueError("unknown handle %d" % handle)
+    status = basics.lib.horovod_tpu_wait(handle)
+    try:
+        if status != _STATUS_OK:
+            msg = basics.lib.horovod_tpu_error_string(handle)
+            raise HorovodInternalError(
+                msg.decode("utf-8") if msg else "collective failed")
+        arr, out = _handle_map[handle]
+        if out is not None:
+            return out
+        # Allgather: copy the core-owned result out.
+        nbytes = basics.lib.horovod_tpu_allgather_bytes(handle)
+        if nbytes < 0:
+            raise HorovodInternalError("allgather produced no result")
+        size = get_basics().size()
+        first_dim = 0
+        for r in range(size):
+            d = basics.lib.horovod_tpu_allgather_rank_dim(handle, r)
+            if d < 0:
+                raise HorovodInternalError("allgather sizes missing")
+            first_dim += d
+        shape = (first_dim,) + tuple(arr.shape[1:])
+        result = np.empty(shape, dtype=arr.dtype)
+        if nbytes != result.nbytes:
+            raise HorovodInternalError(
+                "allgather size mismatch: %d != %d" % (nbytes, result.nbytes))
+        basics.lib.horovod_tpu_allgather_copy(
+            handle, result.ctypes.data_as(ctypes.c_void_p))
+        return result
+    finally:
+        basics.lib.horovod_tpu_release(handle)
+        del _handle_map[handle]
+
+
+def allreduce(tensor, name, average=False, prescale_factor=1.0,
+              postscale_factor=1.0):
+    """Synchronous allreduce; returns the reduced array."""
+    if average:
+        postscale_factor = postscale_factor / get_basics().size()
+    return synchronize(allreduce_async(tensor, name, prescale_factor,
+                                       postscale_factor))
+
+
+def allgather(tensor, name):
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast(tensor, root_rank, name):
+    return synchronize(broadcast_async(tensor, root_rank, name))

@@ -1,0 +1,28 @@
+#include "common.h"
+
+#include <sstream>
+
+namespace hvdtpu {
+
+const std::string SHUT_DOWN_ERROR =
+    "Horovod-TPU has been shut down. This was caused by an exception on one "
+    "of the ranks or an attempt to enqueue a collective after one of the "
+    "ranks finished execution.";
+
+const std::string DUPLICATE_NAME_ERROR =
+    "Requested to collect a tensor with the same name as another tensor that "
+    "is currently being processed. If you want to request another tensor, "
+    "use a different tensor name.";
+
+std::string TensorShape::DebugString() const {
+  std::ostringstream os;
+  os << "[";
+  for (std::size_t i = 0; i < dims_.size(); ++i) {
+    if (i > 0) os << ", ";
+    os << dims_[i];
+  }
+  os << "]";
+  return os.str();
+}
+
+}  // namespace hvdtpu

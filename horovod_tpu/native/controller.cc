@@ -1,0 +1,374 @@
+#include "controller.h"
+
+#include <algorithm>
+#include <cassert>
+#include <cstring>
+#include <sstream>
+#include <unordered_set>
+
+#include "logging.h"
+#include "parameter_manager.h"
+
+namespace hvdtpu {
+
+// Fused buffers are carved at 64-byte granularity so hierarchical ops can
+// split them evenly across local ranks without misaligned segments.
+static constexpr int64_t kFusionBufferAtomicUnit = 64;
+
+Controller::Controller(ResponseCache& response_cache, TensorQueue& tensor_queue,
+                       Timeline& timeline, ParameterManager& parameter_manager)
+    : response_cache_(response_cache),
+      tensor_queue_(tensor_queue),
+      timeline_(timeline),
+      parameter_manager_(parameter_manager) {}
+
+int64_t Controller::TensorFusionThresholdBytes() const {
+  int64_t proposed = parameter_manager_.TensorFusionThresholdBytes();
+  if (proposed <= 0) return 0;
+  // Round so a fused buffer splits into local_size_ aligned chunks.
+  int64_t unit = kFusionBufferAtomicUnit * local_size_;
+  if (parameter_manager_.HierarchicalAllreduce() && proposed % unit != 0) {
+    proposed = std::max<int64_t>(unit, (proposed / unit) * unit);
+  }
+  return proposed;
+}
+
+void Controller::SynchronizeParameters() {
+  ParameterManager::Params params;
+  std::memset(&params, 0, sizeof(params));
+  if (is_coordinator()) params = parameter_manager_.GetParams();
+  std::string blob(reinterpret_cast<char*>(&params), sizeof(params));
+  BroadcastBlob(&blob);
+  if (!is_coordinator() && blob.size() == sizeof(params)) {
+    std::memcpy(&params, blob.data(), sizeof(params));
+    parameter_manager_.SetParams(params);
+  }
+}
+
+bool Controller::IncrementTensorCount(const Request& msg, int rank) {
+  const std::string& name = msg.tensor_name();
+  auto it = message_table_.find(name);
+  if (it == message_table_.end()) {
+    timeline_.NegotiateStart(name, msg.request_type());
+    it = message_table_.emplace(name, std::vector<Request>()).first;
+  }
+  timeline_.NegotiateRankReady(name, rank);
+  stall_inspector_.RecordUncachedTensorStart(name, rank, size_);
+  it->second.push_back(msg);
+  return static_cast<int>(it->second.size()) == size_;
+}
+
+Response Controller::ConstructResponse(const std::string& name) {
+  auto it = message_table_.find(name);
+  assert(it != message_table_.end());
+  std::vector<Request> requests = std::move(it->second);
+  message_table_.erase(it);
+  stall_inspector_.RemoveUncachedTensor(name);
+  timeline_.NegotiateEnd(name);
+
+  const Request& first = requests[0];
+  std::ostringstream error;
+  bool error_found = false;
+
+  // All ranks must agree on op type, dtype, and scaling.
+  for (const auto& req : requests) {
+    if (req.request_type() != first.request_type()) {
+      error << "Mismatched collective operations: one rank did "
+            << Request::RequestTypeName(first.request_type())
+            << " while another did "
+            << Request::RequestTypeName(req.request_type()) << ".";
+      error_found = true;
+      break;
+    }
+    if (req.tensor_type() != first.tensor_type()) {
+      error << "Mismatched data types: one rank had "
+            << DataTypeName(first.tensor_type()) << " while another had "
+            << DataTypeName(req.tensor_type()) << ".";
+      error_found = true;
+      break;
+    }
+    if (req.prescale_factor() != first.prescale_factor() ||
+        req.postscale_factor() != first.postscale_factor()) {
+      error << "Mismatched prescale/postscale factors across ranks.";
+      error_found = true;
+      break;
+    }
+  }
+
+  if (!error_found && (first.request_type() == Request::ALLREDUCE ||
+                       first.request_type() == Request::BROADCAST)) {
+    for (const auto& req : requests) {
+      if (req.tensor_shape() != first.tensor_shape()) {
+        TensorShape a(first.tensor_shape()), b(req.tensor_shape());
+        error << "Mismatched " << Request::RequestTypeName(first.request_type())
+              << " tensor shapes: one rank sent " << a.DebugString()
+              << " while another sent " << b.DebugString() << ".";
+        error_found = true;
+        break;
+      }
+    }
+  }
+
+  if (!error_found && first.request_type() == Request::BROADCAST) {
+    for (const auto& req : requests) {
+      if (req.root_rank() != first.root_rank()) {
+        error << "Mismatched broadcast root ranks: one rank specified "
+              << first.root_rank() << " while another specified "
+              << req.root_rank() << ".";
+        error_found = true;
+        break;
+      }
+    }
+  }
+
+  std::vector<int64_t> tensor_sizes;
+  if (!error_found && first.request_type() == Request::ALLGATHER) {
+    // All dims but the first must match; gather per-rank first dims.
+    tensor_sizes.resize(requests.size(), 0);
+    for (const auto& req : requests) {
+      if (req.tensor_shape().size() != first.tensor_shape().size() ||
+          req.tensor_shape().empty()) {
+        error << "Mismatched allgather tensor ranks (dimensionality).";
+        error_found = true;
+        break;
+      }
+      for (std::size_t d = 1; d < req.tensor_shape().size(); ++d) {
+        if (req.tensor_shape()[d] != first.tensor_shape()[d]) {
+          error << "Mismatched allgather non-first dimensions.";
+          error_found = true;
+          break;
+        }
+      }
+      if (error_found) break;
+      if (req.request_rank() < 0 ||
+          req.request_rank() >= static_cast<int>(tensor_sizes.size())) {
+        error << "Invalid request rank " << req.request_rank() << ".";
+        error_found = true;
+        break;
+      }
+      tensor_sizes[req.request_rank()] = req.tensor_shape()[0];
+    }
+  }
+
+  Response response;
+  response.add_tensor_name(name);
+  if (error_found) {
+    response.set_response_type(Response::ERROR);
+    response.set_error_message(error.str());
+    return response;
+  }
+  response.set_tensor_type(first.tensor_type());
+  response.set_devices(first.device());
+  switch (first.request_type()) {
+    case Request::ALLREDUCE: {
+      response.set_response_type(Response::ALLREDUCE);
+      TensorShape shape(first.tensor_shape());
+      response.add_tensor_size(shape.num_elements());
+      break;
+    }
+    case Request::ALLGATHER:
+      response.set_response_type(Response::ALLGATHER);
+      response.set_tensor_sizes(tensor_sizes);
+      break;
+    case Request::BROADCAST: {
+      response.set_response_type(Response::BROADCAST);
+      TensorShape shape(first.tensor_shape());
+      response.add_tensor_size(shape.num_elements());
+      break;
+    }
+  }
+  return response;
+}
+
+void Controller::FuseResponses(std::deque<Response>& responses,
+                               ResponseList& response_list) {
+  int64_t threshold = TensorFusionThresholdBytes();
+  while (!responses.empty()) {
+    Response response = std::move(responses.front());
+    responses.pop_front();
+    if (response.response_type() == Response::ALLREDUCE && threshold > 0) {
+      int64_t dtype_size =
+          static_cast<int64_t>(DataTypeSize(response.tensor_type()));
+      int64_t total_bytes = 0;
+      for (int64_t n : response.tensor_sizes()) total_bytes += n * dtype_size;
+      // Look-ahead scan: merge any later allreduce with identical
+      // (dtype, devices) while under threshold; preserve order of the rest.
+      std::deque<Response> skipped;
+      while (!responses.empty()) {
+        Response next = std::move(responses.front());
+        responses.pop_front();
+        bool merged = false;
+        if (next.response_type() == Response::ALLREDUCE &&
+            next.tensor_type() == response.tensor_type() &&
+            next.devices() == response.devices()) {
+          int64_t next_bytes = 0;
+          for (int64_t n : next.tensor_sizes()) next_bytes += n * dtype_size;
+          if (total_bytes + next_bytes <= threshold) {
+            total_bytes += next_bytes;
+            for (const auto& nm : next.tensor_names())
+              response.add_tensor_name(nm);
+            for (int64_t n : next.tensor_sizes()) response.add_tensor_size(n);
+            merged = true;
+          }
+        }
+        if (!merged) skipped.push_back(std::move(next));
+      }
+      responses = std::move(skipped);
+    }
+    response_list.add_response(std::move(response));
+  }
+}
+
+ResponseList Controller::FinishCycle(std::deque<Response> responses,
+                                     std::vector<Request>& non_cached_messages,
+                                     bool should_shut_down) {
+  ResponseList response_list;
+  if (is_coordinator()) {
+    std::vector<std::string> ready_names;
+    for (auto& msg : non_cached_messages) {
+      if (IncrementTensorCount(msg, rank_)) {
+        ready_names.push_back(msg.tensor_name());
+      }
+    }
+    // Gather worker RequestLists (rank 0's own slot is unused).
+    std::vector<std::string> blobs;
+    GatherBlobs(std::string(), &blobs);
+    for (int r = 1; r < size_; ++r) {
+      RequestList list;
+      if (!list.ParseFrom(blobs[r].data(), blobs[r].size())) {
+        LOG(ERROR) << "Failed to parse RequestList from rank " << r;
+        continue;
+      }
+      if (list.shutdown()) should_shut_down = true;
+      for (const auto& msg : list.requests()) {
+        if (IncrementTensorCount(msg, r)) {
+          ready_names.push_back(msg.tensor_name());
+        }
+      }
+    }
+    if (stall_inspector_.ShouldPerformCheck()) {
+      if (stall_inspector_.CheckForStalledTensors(size_)) {
+        should_shut_down = true;
+      }
+      stall_inspector_.UpdateCheckTime();
+    }
+    for (const auto& name : ready_names) {
+      responses.push_back(ConstructResponse(name));
+    }
+    response_list.set_shutdown(should_shut_down);
+    FuseResponses(responses, response_list);
+    std::string blob;
+    response_list.SerializeTo(&blob);
+    BroadcastBlob(&blob);
+  } else {
+    RequestList message_list;
+    message_list.set_shutdown(should_shut_down);
+    for (auto& msg : non_cached_messages) {
+      message_list.add_request(msg);
+    }
+    std::string blob;
+    message_list.SerializeTo(&blob);
+    GatherBlobs(blob, nullptr);
+    std::string response_blob;
+    BroadcastBlob(&response_blob);
+    if (!response_list.ParseFrom(response_blob.data(), response_blob.size())) {
+      LOG(FATAL) << "Failed to parse ResponseList from coordinator";
+    }
+  }
+  return response_list;
+}
+
+ResponseList Controller::ComputeResponseList(
+    bool this_process_requested_shutdown) {
+  CacheCoordinator cache_coordinator(response_cache_.num_active_bits());
+
+  std::deque<Request> message_queue_tmp;
+  tensor_queue_.PopMessagesFromQueue(message_queue_tmp);
+
+  std::vector<Request> non_cached_messages;
+  // bit -> locally-hit message, pending global agreement.
+  std::unordered_map<uint32_t, Request> hit_messages;
+
+  bool cache_on = response_cache_.capacity() > 0 &&
+                  parameter_manager_.CacheEnabled();
+  for (auto& message : message_queue_tmp) {
+    if (cache_on) {
+      auto state = response_cache_.cached(message);
+      if (state == ResponseCache::CacheState::HIT) {
+        uint32_t bit = response_cache_.peek_cache_bit(message);
+        cache_coordinator.record_hit(bit);
+        stall_inspector_.RecordCachedTensorStart(message.tensor_name());
+        hit_messages.emplace(bit, std::move(message));
+        continue;
+      }
+      if (state == ResponseCache::CacheState::INVALID) {
+        uint32_t bit = response_cache_.peek_cache_bit(message);
+        cache_coordinator.record_invalid_bit(bit);
+      }
+    }
+    cache_coordinator.set_uncached_in_queue(true);
+    non_cached_messages.push_back(std::move(message));
+  }
+  cache_coordinator.set_should_shut_down(this_process_requested_shutdown);
+
+  // Invalidate cached tensors that have been waiting on missing ranks.
+  if (cache_on && stall_inspector_.ShouldPerformCheck()) {
+    std::vector<uint32_t> stalled_bits;
+    stall_inspector_.InvalidateStalledCachedTensors(response_cache_,
+                                                    stalled_bits);
+    for (uint32_t bit : stalled_bits) cache_coordinator.record_invalid_bit(bit);
+  }
+
+  bool should_shut_down = this_process_requested_shutdown;
+  std::deque<Response> cached_responses;
+  bool all_cached = false;
+
+  if (cache_on) {
+    cache_coordinator.sync(this, timeline_.Initialized());
+    should_shut_down = cache_coordinator.should_shut_down();
+
+    // Locally-hit tensors that lost the global AND wait for the other ranks:
+    // re-queue them for a later cycle. Invalidated ones renegotiate now.
+    for (auto& kv : hit_messages) {
+      if (cache_coordinator.cache_hits().count(kv.first)) continue;
+      if (cache_coordinator.invalid_bits().count(kv.first)) {
+        stall_inspector_.RemoveCachedTensor(kv.second.tensor_name());
+        non_cached_messages.push_back(std::move(kv.second));
+      } else {
+        tensor_queue_.PushMessageToQueue(kv.second);
+      }
+    }
+
+    // Materialize + LRU-touch globally-hit responses before any erase can
+    // perturb bit numbering. Identical motion on every rank keeps future
+    // evictions consistent.
+    for (uint32_t bit : cache_coordinator.cache_hits()) {
+      cached_responses.push_back(response_cache_.get_response(bit));
+      stall_inspector_.RemoveCachedTensor(
+          cached_responses.back().tensor_names()[0]);
+    }
+
+    // Drop invalidated entries identically on every rank, then re-pack bits.
+    std::vector<uint32_t> invalid(cache_coordinator.invalid_bits().begin(),
+                                  cache_coordinator.invalid_bits().end());
+    std::sort(invalid.rbegin(), invalid.rend());
+    for (uint32_t bit : invalid) response_cache_.erase_response(bit);
+    response_cache_.update_cache_bits();
+
+    all_cached = !cache_coordinator.uncached_in_queue();
+  }
+
+  if (cache_on && all_cached) {
+    // Fast path: everything queued this cycle was globally cached; no
+    // coordinator round trip. Every rank builds the identical list locally.
+    ResponseList response_list;
+    response_list.set_shutdown(should_shut_down);
+    FuseResponses(cached_responses, response_list);
+    return response_list;
+  }
+
+  return FinishCycle(std::move(cached_responses), non_cached_messages,
+                     should_shut_down);
+}
+
+}  // namespace hvdtpu

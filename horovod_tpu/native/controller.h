@@ -1,0 +1,123 @@
+// Coordinator/worker negotiation protocol.
+//
+// The protocol (capability parity with /root/reference
+// horovod/common/controller.{h,cc}, documented there at controller.h:62-97):
+// every cycle, all ranks synchronously:
+//   a) check queued requests against the response cache and agree on globally
+//      cached-and-ready tensors with one bitwise-AND bit-vector allreduce;
+//   b) if everything queued was cached everywhere, execute straight from the
+//      cache (fast path — no coordinator round trip);
+//   c) otherwise workers send their ready-tensor RequestLists to rank 0,
+//      which counts readiness per tensor name; when a tensor has been
+//      announced by all ranks it is ready;
+//   d) rank 0 validates (shape/dtype/op/root-rank consistency), fuses small
+//      responses up to the fusion threshold, and broadcasts the final
+//      ResponseList; every rank executes the same responses in order.
+//
+// Subclasses provide the rank-discovery and the four cross-rank primitives
+// (gather / broadcast / bitwise AND / bitwise OR). TcpController implements
+// them over the host network; a single-process build short-circuits.
+#ifndef HVD_TPU_CONTROLLER_H
+#define HVD_TPU_CONTROLLER_H
+
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "message.h"
+#include "response_cache.h"
+#include "stall_inspector.h"
+#include "tensor_queue.h"
+#include "timeline.h"
+
+namespace hvdtpu {
+
+class ParameterManager;
+
+class Controller {
+ public:
+  Controller(ResponseCache& response_cache, TensorQueue& tensor_queue,
+             Timeline& timeline, ParameterManager& parameter_manager);
+  virtual ~Controller() = default;
+
+  // Rank discovery / communicator construction.
+  virtual void Initialize() = 0;
+
+  virtual int rank() const { return rank_; }
+  virtual int local_rank() const { return local_rank_; }
+  virtual int cross_rank() const { return cross_rank_; }
+  virtual int size() const { return size_; }
+  virtual int local_size() const { return local_size_; }
+  virtual int cross_size() const { return cross_size_; }
+  bool is_coordinator() const { return rank_ == 0; }
+  bool is_homogeneous() const { return is_homogeneous_; }
+  const std::vector<int>& local_sizes() const { return local_sizes_; }
+
+  // The per-cycle negotiation. Returns the agreed list of operations to
+  // perform this cycle (identical on every rank, in identical order).
+  ResponseList ComputeResponseList(bool this_process_requested_shutdown);
+
+  // Fusion threshold rounded so fused allreduce buffers divide evenly across
+  // local ranks (needed by hierarchical ops).
+  int64_t TensorFusionThresholdBytes() const;
+
+  // Broadcasts autotuned parameters from rank 0 (wraps Bcast).
+  void SynchronizeParameters();
+
+  StallInspector& stall_inspector() { return stall_inspector_; }
+
+  // --- cross-rank primitives, implemented per transport ---
+  // Gathers every rank's serialized blob at rank 0 (out: indexed by rank).
+  virtual void GatherBlobs(const std::string& mine,
+                           std::vector<std::string>* all) = 0;
+  // Rank 0 sends `blob` to everyone; other ranks receive into `blob`.
+  virtual void BroadcastBlob(std::string* blob) = 0;
+  virtual void CrossRankBitwiseAnd(std::vector<uint64_t>& bits) = 0;
+  virtual void CrossRankBitwiseOr(std::vector<uint64_t>& bits) = 0;
+  virtual void Barrier() = 0;
+
+ protected:
+  // Coordinator: record that `rank` reported readiness of msg's tensor.
+  // Returns true when all ranks have reported it.
+  bool IncrementTensorCount(const Request& msg, int rank);
+
+  // Coordinator: build the validated Response for a fully-ready tensor,
+  // checking cross-rank consistency of shape/dtype/op/root rank.
+  Response ConstructResponse(const std::string& name);
+
+  // Coordinator: fuse eligible same-type/dtype responses under the threshold.
+  void FuseResponses(std::deque<Response>& responses, ResponseList& out);
+
+  // The negotiation round-trip (request gather -> validate/fuse -> response
+  // broadcast). `responses` seeds the list with globally-cached responses.
+  ResponseList FinishCycle(std::deque<Response> responses,
+                           std::vector<Request>& non_cached_messages,
+                           bool should_shut_down);
+
+  int rank_ = 0;
+  int local_rank_ = 0;
+  int cross_rank_ = 0;
+  int size_ = 1;
+  int local_size_ = 1;
+  int cross_size_ = 1;
+  bool is_homogeneous_ = true;
+  std::vector<int> local_sizes_;
+
+  // Coordinator-side table: tensor name -> one Request per reported rank.
+  std::unordered_map<std::string, std::vector<Request>> message_table_;
+
+  ResponseCache& response_cache_;
+  TensorQueue& tensor_queue_;
+  Timeline& timeline_;
+  ParameterManager& parameter_manager_;
+  StallInspector stall_inspector_;
+
+  uint32_t cache_capacity_ = 1024;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_CONTROLLER_H

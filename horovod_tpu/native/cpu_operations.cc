@@ -1,0 +1,343 @@
+#include "cpu_operations.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "global_state.h"
+#include "half.h"
+#include "logging.h"
+
+namespace hvdtpu {
+
+template <typename T>
+static void ReduceSumT(T* dst, const T* src, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
+}
+
+void ReduceSum(void* dst, const void* src, int64_t count, DataType dtype) {
+  switch (dtype) {
+    case DataType::HVD_UINT8:
+      ReduceSumT(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(src),
+                 count);
+      break;
+    case DataType::HVD_INT8:
+      ReduceSumT(static_cast<int8_t*>(dst), static_cast<const int8_t*>(src),
+                 count);
+      break;
+    case DataType::HVD_UINT16:
+      ReduceSumT(static_cast<uint16_t*>(dst),
+                 static_cast<const uint16_t*>(src), count);
+      break;
+    case DataType::HVD_INT16:
+      ReduceSumT(static_cast<int16_t*>(dst), static_cast<const int16_t*>(src),
+                 count);
+      break;
+    case DataType::HVD_INT32:
+      ReduceSumT(static_cast<int32_t*>(dst), static_cast<const int32_t*>(src),
+                 count);
+      break;
+    case DataType::HVD_INT64:
+      ReduceSumT(static_cast<int64_t*>(dst), static_cast<const int64_t*>(src),
+                 count);
+      break;
+    case DataType::HVD_FLOAT32:
+      ReduceSumT(static_cast<float*>(dst), static_cast<const float*>(src),
+                 count);
+      break;
+    case DataType::HVD_FLOAT64:
+      ReduceSumT(static_cast<double*>(dst), static_cast<const double*>(src),
+                 count);
+      break;
+    case DataType::HVD_FLOAT16: {
+      auto* d = static_cast<uint16_t*>(dst);
+      const auto* s = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < count; ++i) {
+        d[i] = FloatToHalf(HalfToFloat(d[i]) + HalfToFloat(s[i]));
+      }
+      break;
+    }
+    case DataType::HVD_BFLOAT16: {
+      auto* d = static_cast<uint16_t*>(dst);
+      const auto* s = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < count; ++i) {
+        d[i] = FloatToBFloat16(BFloat16ToFloat(d[i]) + BFloat16ToFloat(s[i]));
+      }
+      break;
+    }
+    case DataType::HVD_BOOL: {
+      auto* d = static_cast<uint8_t*>(dst);
+      const auto* s = static_cast<const uint8_t*>(src);
+      for (int64_t i = 0; i < count; ++i) d[i] = d[i] || s[i];
+      break;
+    }
+  }
+}
+
+template <typename T>
+static void ScaleT(T* buf, int64_t n, double factor) {
+  for (int64_t i = 0; i < n; ++i) {
+    buf[i] = static_cast<T>(buf[i] * factor);
+  }
+}
+
+void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor) {
+  if (factor == 1.0) return;
+  switch (dtype) {
+    case DataType::HVD_UINT8:
+      ScaleT(static_cast<uint8_t*>(buf), count, factor);
+      break;
+    case DataType::HVD_INT8:
+      ScaleT(static_cast<int8_t*>(buf), count, factor);
+      break;
+    case DataType::HVD_UINT16:
+      ScaleT(static_cast<uint16_t*>(buf), count, factor);
+      break;
+    case DataType::HVD_INT16:
+      ScaleT(static_cast<int16_t*>(buf), count, factor);
+      break;
+    case DataType::HVD_INT32:
+      ScaleT(static_cast<int32_t*>(buf), count, factor);
+      break;
+    case DataType::HVD_INT64:
+      ScaleT(static_cast<int64_t*>(buf), count, factor);
+      break;
+    case DataType::HVD_FLOAT32:
+      ScaleT(static_cast<float*>(buf), count, factor);
+      break;
+    case DataType::HVD_FLOAT64:
+      ScaleT(static_cast<double*>(buf), count, factor);
+      break;
+    case DataType::HVD_FLOAT16: {
+      auto* b = static_cast<uint16_t*>(buf);
+      for (int64_t i = 0; i < count; ++i) {
+        b[i] = FloatToHalf(static_cast<float>(HalfToFloat(b[i]) * factor));
+      }
+      break;
+    }
+    case DataType::HVD_BFLOAT16: {
+      auto* b = static_cast<uint16_t*>(buf);
+      for (int64_t i = 0; i < count; ++i) {
+        b[i] = FloatToBFloat16(
+            static_cast<float>(BFloat16ToFloat(b[i]) * factor));
+      }
+      break;
+    }
+    case DataType::HVD_BOOL:
+      break;  // scaling a bool is meaningless; ignore
+  }
+}
+
+bool CpuRingAllreduce::Enabled(const std::vector<TensorTableEntry>& entries,
+                               const Response& response) const {
+  return entries[0].device == HOST_DEVICE_ID;
+}
+
+Status CpuRingAllreduce::RingAllreduce(void* buffer, int64_t count,
+                                       DataType dtype) {
+  int n = ctx_.size();
+  if (n == 1 || count == 0) return Status::OK();
+  int rank = ctx_.rank();
+  std::size_t elem = DataTypeSize(dtype);
+
+  // Partition elements into n near-equal chunks.
+  std::vector<int64_t> counts(n), offsets(n);
+  int64_t base = count / n, rem = count % n;
+  int64_t off = 0;
+  for (int i = 0; i < n; ++i) {
+    counts[i] = base + (i < rem ? 1 : 0);
+    offsets[i] = off;
+    off += counts[i];
+  }
+  char* buf = static_cast<char*>(buffer);
+  std::vector<char> tmp(static_cast<std::size_t>(counts[0]) * elem);
+
+  // Reduce-scatter phase: after n-1 steps rank r owns chunk (r+1) % n.
+  for (int step = 0; step < n - 1; ++step) {
+    int send_chunk = (rank - step + n) % n;
+    int recv_chunk = (rank - step - 1 + n) % n;
+    if (!ctx_.RingExchange(buf + offsets[send_chunk] * elem,
+                           counts[send_chunk] * elem, tmp.data(),
+                           counts[recv_chunk] * elem)) {
+      return Status::UnknownError("ring allreduce exchange failed");
+    }
+    ReduceSum(buf + offsets[recv_chunk] * elem, tmp.data(), counts[recv_chunk],
+              dtype);
+  }
+  // Allgather phase: circulate fully-reduced chunks.
+  for (int step = 0; step < n - 1; ++step) {
+    int send_chunk = (rank + 1 - step + n) % n;
+    int recv_chunk = (rank - step + n) % n;
+    if (!ctx_.RingExchange(buf + offsets[send_chunk] * elem,
+                           counts[send_chunk] * elem,
+                           buf + offsets[recv_chunk] * elem,
+                           counts[recv_chunk] * elem)) {
+      return Status::UnknownError("ring allgather exchange failed");
+    }
+  }
+  return Status::OK();
+}
+
+Status CpuRingAllreduce::Execute(std::vector<TensorTableEntry>& entries,
+                                 const Response& response) {
+  auto& timeline = global_state_->timeline;
+  void* buffer = nullptr;
+  std::size_t buffer_len = 0;
+  int64_t total_elements = NumElements(entries);
+
+  if (entries.size() > 1) {
+    std::vector<std::string> names = response.tensor_names();
+    timeline.ActivityStartAll(names, "MEMCPY_IN_FUSION_BUFFER");
+    Status s = MemcpyInFusionBuffer(entries, &buffer, &buffer_len);
+    timeline.ActivityEndAll(names);
+    if (!s.ok()) return s;
+  } else {
+    auto& e = entries[0];
+    if (e.output != e.data) {
+      std::memcpy(e.output, e.data, e.SizeBytes());
+    }
+    buffer = e.output;
+    buffer_len = e.SizeBytes();
+  }
+
+  // Per-entry prescale on its segment (factors may differ across fused
+  // tensors; scaling commutes with the sum).
+  {
+    char* p = static_cast<char*>(buffer);
+    for (auto& e : entries) {
+      if (e.prescale_factor != 1.0) {
+        ScaleBuffer(p, e.NumElements(), e.dtype, e.prescale_factor);
+      }
+      p += e.SizeBytes();
+    }
+  }
+
+  timeline.ActivityStartAll(response.tensor_names(), "ALLREDUCE_RING");
+  Status s = RingAllreduce(buffer, total_elements, entries[0].dtype);
+  timeline.ActivityEndAll(response.tensor_names());
+  if (!s.ok()) return s;
+
+  {
+    char* p = static_cast<char*>(buffer);
+    for (auto& e : entries) {
+      if (e.postscale_factor != 1.0) {
+        ScaleBuffer(p, e.NumElements(), e.dtype, e.postscale_factor);
+      }
+      p += e.SizeBytes();
+    }
+  }
+
+  if (entries.size() > 1) {
+    timeline.ActivityStartAll(response.tensor_names(),
+                              "MEMCPY_OUT_FUSION_BUFFER");
+    MemcpyOutFusionBuffer(buffer, entries);
+    timeline.ActivityEndAll(response.tensor_names());
+  }
+  return Status::OK();
+}
+
+bool CpuRingAllgather::Enabled(const std::vector<TensorTableEntry>& entries,
+                               const Response& response) const {
+  return entries[0].device == HOST_DEVICE_ID;
+}
+
+Status CpuRingAllgather::Execute(std::vector<TensorTableEntry>& entries,
+                                 const Response& response) {
+  int n = ctx_.size();
+  int rank = ctx_.rank();
+  auto& timeline = global_state_->timeline;
+  timeline.ActivityStartAll(response.tensor_names(), "ALLGATHER_RING");
+  for (auto& e : entries) {
+    const auto& first_dims = response.tensor_sizes();
+    if (static_cast<int>(first_dims.size()) != n) {
+      return Status::UnknownError("allgather sizes missing");
+    }
+    int64_t slice_elems = 1;
+    for (int d = 1; d < e.shape.ndims(); ++d) slice_elems *= e.shape.dim_size(d);
+    std::size_t elem = DataTypeSize(e.dtype);
+
+    std::vector<int64_t> block_bytes(n), block_offsets(n);
+    int64_t total_bytes = 0;
+    for (int r = 0; r < n; ++r) {
+      block_bytes[r] = first_dims[r] * slice_elems * static_cast<int64_t>(elem);
+      block_offsets[r] = total_bytes;
+      total_bytes += block_bytes[r];
+    }
+    e.gathered = std::make_shared<std::vector<char>>(
+        static_cast<std::size_t>(total_bytes));
+    e.gathered_sizes =
+        std::make_shared<std::vector<int64_t>>(first_dims);
+    char* out = e.gathered->data();
+    std::memcpy(out + block_offsets[rank], e.data,
+                static_cast<std::size_t>(block_bytes[rank]));
+    // Ring circulation: at step s, forward the block originally owned by
+    // (rank - s) and receive the block owned by (rank - s - 1).
+    for (int step = 0; step < n - 1; ++step) {
+      int send_block = (rank - step + n) % n;
+      int recv_block = (rank - step - 1 + n) % n;
+      if (!ctx_.RingExchange(out + block_offsets[send_block],
+                             static_cast<std::size_t>(block_bytes[send_block]),
+                             out + block_offsets[recv_block],
+                             static_cast<std::size_t>(block_bytes[recv_block]))) {
+        timeline.ActivityEndAll(response.tensor_names());
+        return Status::UnknownError("ring allgather exchange failed");
+      }
+    }
+  }
+  timeline.ActivityEndAll(response.tensor_names());
+  return Status::OK();
+}
+
+bool CpuBroadcast::Enabled(const std::vector<TensorTableEntry>& entries,
+                           const Response& response) const {
+  return entries[0].device == HOST_DEVICE_ID;
+}
+
+Status CpuBroadcast::Execute(std::vector<TensorTableEntry>& entries,
+                             const Response& response) {
+  auto& timeline = global_state_->timeline;
+  timeline.ActivityStartAll(response.tensor_names(), "BROADCAST_STAR");
+  int rank = ctx_.rank();
+  for (auto& e : entries) {
+    std::size_t len = e.SizeBytes();
+    // Relay to rank 0 if the root is elsewhere, then star fan-out from 0.
+    // Ops run in lockstep on the coordination thread, so borrowing the
+    // control star for bulk data is race-free.
+    if (e.root_rank != 0) {
+      if (rank == e.root_rank) {
+        if (!ctx_.StarSend(0, e.data, len)) {
+          timeline.ActivityEndAll(response.tensor_names());
+          return Status::UnknownError("broadcast relay to rank 0 failed");
+        }
+      } else if (rank == 0) {
+        if (!ctx_.StarRecv(e.root_rank, e.output, len)) {
+          timeline.ActivityEndAll(response.tensor_names());
+          return Status::UnknownError("broadcast recv at rank 0 failed");
+        }
+      }
+    }
+    if (rank == 0) {
+      const void* src = (e.root_rank == 0) ? e.data : e.output;
+      for (int r = 1; r < ctx_.size(); ++r) {
+        if (r == e.root_rank) continue;
+        if (!ctx_.StarSend(r, src, len)) {
+          timeline.ActivityEndAll(response.tensor_names());
+          return Status::UnknownError("broadcast fan-out failed");
+        }
+      }
+      if (e.root_rank == 0 && e.output != e.data) {
+        std::memcpy(e.output, e.data, len);
+      }
+    } else if (rank != e.root_rank) {
+      if (!ctx_.StarRecv(0, e.output, len)) {
+        timeline.ActivityEndAll(response.tensor_names());
+        return Status::UnknownError("broadcast recv failed");
+      }
+    } else if (e.output != e.data) {
+      std::memcpy(e.output, e.data, len);
+    }
+  }
+  timeline.ActivityEndAll(response.tensor_names());
+  return Status::OK();
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,70 @@
+// Host-CPU collective op implementations over the TCP data ring:
+//   - CpuRingAllreduce: bandwidth-optimal ring (reduce-scatter + allgather)
+//     over the fused buffer, dtype-aware reduction (16-bit floats accumulate
+//     in fp32).
+//   - CpuRingAllgather: ring allgatherv with per-rank first-dim sizes.
+//   - CpuBroadcast: root -> rank 0 relay -> star fan-out on the control
+//     channel (safe: ops run lockstep on the single coordination thread).
+//
+// Role parity with /root/reference horovod/common/ops/mpi_operations.cc and
+// gloo_operations.cc (the host data plane); the TPU in-jit data plane rides
+// XLA collectives and never enters this code.
+#ifndef HVD_TPU_CPU_OPERATIONS_H
+#define HVD_TPU_CPU_OPERATIONS_H
+
+#include <vector>
+
+#include "collective_operations.h"
+#include "tcp_context.h"
+
+namespace hvdtpu {
+
+class CpuRingAllreduce : public AllreduceOp {
+ public:
+  CpuRingAllreduce(TcpContext& ctx, HorovodGlobalState* state)
+      : AllreduceOp(state), ctx_(ctx) {}
+  bool Enabled(const std::vector<TensorTableEntry>& entries,
+               const Response& response) const override;
+  Status Execute(std::vector<TensorTableEntry>& entries,
+                 const Response& response) override;
+
+ private:
+  // In-place ring allreduce on `buffer` (count elements of dtype).
+  Status RingAllreduce(void* buffer, int64_t count, DataType dtype);
+  TcpContext& ctx_;
+};
+
+class CpuRingAllgather : public AllgatherOp {
+ public:
+  CpuRingAllgather(TcpContext& ctx, HorovodGlobalState* state)
+      : AllgatherOp(state), ctx_(ctx) {}
+  bool Enabled(const std::vector<TensorTableEntry>& entries,
+               const Response& response) const override;
+  Status Execute(std::vector<TensorTableEntry>& entries,
+                 const Response& response) override;
+
+ private:
+  TcpContext& ctx_;
+};
+
+class CpuBroadcast : public BroadcastOp {
+ public:
+  CpuBroadcast(TcpContext& ctx, HorovodGlobalState* state)
+      : BroadcastOp(state), ctx_(ctx) {}
+  bool Enabled(const std::vector<TensorTableEntry>& entries,
+               const Response& response) const override;
+  Status Execute(std::vector<TensorTableEntry>& entries,
+                 const Response& response) override;
+
+ private:
+  TcpContext& ctx_;
+};
+
+// Elementwise `dst += src` with dtype dispatch (fp16/bf16 via fp32).
+void ReduceSum(void* dst, const void* src, int64_t count, DataType dtype);
+// Elementwise scale in place (used for prescale/postscale/average).
+void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor);
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_CPU_OPERATIONS_H

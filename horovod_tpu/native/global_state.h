@@ -1,0 +1,46 @@
+// Process-wide singleton state for the coordination runtime: the background
+// thread, its components, and the knobs they share.
+// Capability parity with /root/reference horovod/common/global_state.h.
+#ifndef HVD_TPU_GLOBAL_STATE_H
+#define HVD_TPU_GLOBAL_STATE_H
+
+#include <atomic>
+#include <memory>
+#include <thread>
+
+#include "fusion_buffer_manager.h"
+#include "parameter_manager.h"
+#include "response_cache.h"
+#include "tcp_context.h"
+#include "tensor_queue.h"
+#include "timeline.h"
+
+namespace hvdtpu {
+
+class Controller;
+class OperationManager;
+
+struct HorovodGlobalState {
+  // Background coordination thread (the only thread that talks cross-rank).
+  std::thread background_thread;
+  std::atomic<bool> initialize_flag{false};
+  std::atomic<bool> initialization_done{false};
+  std::atomic<bool> initialization_failed{false};
+  std::atomic<bool> shut_down{false};
+
+  TcpContext tcp_context;
+  TensorQueue tensor_queue;
+  Timeline timeline;
+  bool mark_cycles_in_timeline = false;
+  ParameterManager parameter_manager;
+  ResponseCache response_cache;
+  FusionBufferManager fusion_buffer;
+  std::unique_ptr<Controller> controller;
+  std::unique_ptr<OperationManager> op_manager;
+
+  ~HorovodGlobalState();
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_GLOBAL_STATE_H

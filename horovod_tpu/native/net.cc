@@ -1,0 +1,236 @@
+#include "net.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <thread>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+static constexpr uint32_t kHandshakeMagic = 0x48564454;  // "HVDT"
+
+Conn::~Conn() { Close(); }
+
+Conn& Conn::operator=(Conn&& o) noexcept {
+  if (this != &o) {
+    Close();
+    fd_ = o.fd_;
+    o.fd_ = -1;
+  }
+  return *this;
+}
+
+void Conn::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+bool Conn::SendAll(const void* buf, std::size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::send(fd_, p, len, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<std::size_t>(n);
+  }
+  return true;
+}
+
+bool Conn::RecvAll(void* buf, std::size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::recv(fd_, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<std::size_t>(n);
+  }
+  return true;
+}
+
+bool Conn::SendFrame(uint32_t tag, const void* payload, std::size_t len) {
+  char hdr[12];
+  uint64_t len64 = len;
+  std::memcpy(hdr, &tag, 4);
+  std::memcpy(hdr + 4, &len64, 8);
+  if (!SendAll(hdr, 12)) return false;
+  if (len > 0 && !SendAll(payload, len)) return false;
+  return true;
+}
+
+bool Conn::RecvFrame(uint32_t* tag, std::string* payload) {
+  char hdr[12];
+  if (!RecvAll(hdr, 12)) return false;
+  uint64_t len64;
+  std::memcpy(tag, hdr, 4);
+  std::memcpy(&len64, hdr + 4, 8);
+  payload->resize(len64);
+  if (len64 > 0 && !RecvAll(&(*payload)[0], len64)) return false;
+  return true;
+}
+
+bool Conn::RecvFrameInto(uint32_t* tag, void* buf, std::size_t expected_len) {
+  char hdr[12];
+  if (!RecvAll(hdr, 12)) return false;
+  uint64_t len64;
+  std::memcpy(tag, hdr, 4);
+  std::memcpy(&len64, hdr + 4, 8);
+  if (len64 != expected_len) {
+    LOG(ERROR) << "frame length mismatch: got " << len64 << " expected "
+               << expected_len;
+    return false;
+  }
+  return expected_len == 0 || RecvAll(buf, expected_len);
+}
+
+Listener::~Listener() { Close(); }
+
+void Listener::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+bool Listener::Start(int port) {
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) return false;
+  int one = 1;
+  ::setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    LOG(ERROR) << "bind failed on port " << port << ": " << strerror(errno);
+    Close();
+    return false;
+  }
+  if (::listen(fd_, 128) != 0) {
+    Close();
+    return false;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+  port_ = ntohs(addr.sin_port);
+  return true;
+}
+
+int Listener::AcceptPeer(int* peer_rank, Channel* channel, int timeout_ms) {
+  if (timeout_ms >= 0) {
+    struct pollfd pfd = {fd_, POLLIN, 0};
+    int r = ::poll(&pfd, 1, timeout_ms);
+    if (r <= 0) return -1;
+  }
+  int cfd = ::accept(fd_, nullptr, nullptr);
+  if (cfd < 0) return -1;
+  int one = 1;
+  ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  char hs[9];
+  std::size_t got = 0;
+  while (got < sizeof(hs)) {
+    ssize_t n = ::recv(cfd, hs + got, sizeof(hs) - got, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      ::close(cfd);
+      return -1;
+    }
+    got += static_cast<std::size_t>(n);
+  }
+  uint32_t magic;
+  int32_t rank;
+  std::memcpy(&magic, hs, 4);
+  std::memcpy(&rank, hs + 4, 4);
+  if (magic != kHandshakeMagic) {
+    LOG(ERROR) << "bad handshake magic";
+    ::close(cfd);
+    return -1;
+  }
+  *peer_rank = rank;
+  *channel = static_cast<Channel>(hs[8]);
+  return cfd;
+}
+
+Conn ConnectPeer(const std::string& host, int port, int my_rank,
+                 Channel channel, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    struct addrinfo hints;
+    std::memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string port_s = std::to_string(port);
+    int fd = -1;
+    if (::getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) == 0) {
+      for (auto* ai = res; ai != nullptr; ai = ai->ai_next) {
+        fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) continue;
+        if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+        ::close(fd);
+        fd = -1;
+      }
+      ::freeaddrinfo(res);
+    }
+    if (fd >= 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      Conn c(fd);
+      char hs[9];
+      std::memcpy(hs, &kHandshakeMagic, 4);
+      int32_t r32 = my_rank;
+      std::memcpy(hs + 4, &r32, 4);
+      hs[8] = static_cast<char>(channel);
+      if (c.SendAll(hs, 9)) return c;
+    }
+    if (std::chrono::steady_clock::now() >= deadline) {
+      LOG(ERROR) << "connect to " << host << ":" << port << " timed out";
+      return Conn();
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+bool ParseHostPort(const std::string& s, std::string* host, int* port) {
+  auto pos = s.rfind(':');
+  if (pos == std::string::npos) return false;
+  *host = s.substr(0, pos);
+  *port = std::atoi(s.c_str() + pos + 1);
+  return *port > 0;
+}
+
+std::vector<std::string> SplitString(const std::string& s, char sep) {
+  std::vector<std::string> out;
+  std::size_t start = 0;
+  while (start <= s.size()) {
+    auto pos = s.find(sep, start);
+    if (pos == std::string::npos) {
+      out.push_back(s.substr(start));
+      break;
+    }
+    out.push_back(s.substr(start, pos - start));
+    start = pos + 1;
+  }
+  if (!out.empty() && out.back().empty()) out.pop_back();
+  return out;
+}
+
+}  // namespace hvdtpu

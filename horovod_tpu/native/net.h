@@ -1,0 +1,84 @@
+// Host-network transport for the TPU build: framed blocking TCP sockets.
+//
+// Fills the role the reference fills with MPI communicators / Gloo TCP
+// contexts (/root/reference horovod/common/mpi/mpi_context.cc,
+// gloo/gloo_context.cc): a control star (every worker <-> rank 0) used by the
+// coordinator protocol, and a data ring (rank i <-> rank i+1 mod N) used by
+// the CPU collective ops. Rendezvous is launcher-injected env:
+//   HVD_TPU_ADDRS = "host:port,host:port,..."  (index == rank)
+// Each rank listens on its own port; connections carry a one-byte channel tag.
+#ifndef HVD_TPU_NET_H
+#define HVD_TPU_NET_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+enum class Channel : uint8_t {
+  CONTROL = 0,  // worker -> coordinator star
+  RING = 1,     // prev -> next data ring
+};
+
+// Framed duplex connection. Frame = [u32 tag][u64 len][payload].
+class Conn {
+ public:
+  Conn() = default;
+  explicit Conn(int fd) : fd_(fd) {}
+  ~Conn();
+  Conn(const Conn&) = delete;
+  Conn& operator=(const Conn&) = delete;
+  Conn(Conn&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  Conn& operator=(Conn&& o) noexcept;
+
+  bool valid() const { return fd_ >= 0; }
+  void Close();
+
+  // Raw exact-length I/O; false on error/EOF.
+  bool SendAll(const void* buf, std::size_t len);
+  bool RecvAll(void* buf, std::size_t len);
+
+  bool SendFrame(uint32_t tag, const void* payload, std::size_t len);
+  bool SendFrame(uint32_t tag, const std::string& payload) {
+    return SendFrame(tag, payload.data(), payload.size());
+  }
+  bool RecvFrame(uint32_t* tag, std::string* payload);
+  // Receives a frame directly into a caller buffer; fails if length differs.
+  bool RecvFrameInto(uint32_t* tag, void* buf, std::size_t expected_len);
+
+  int fd() const { return fd_; }
+
+ private:
+  int fd_ = -1;
+};
+
+// Listening socket bound to a port; accepts handshaked peer connections.
+class Listener {
+ public:
+  ~Listener();
+  // Binds and listens; port==0 picks an ephemeral port. Returns false on error.
+  bool Start(int port);
+  int port() const { return port_; }
+  void Close();
+  // Accepts one connection and reads its handshake. Returns fd or -1.
+  // timeout_ms < 0 means block indefinitely.
+  int AcceptPeer(int* peer_rank, Channel* channel, int timeout_ms);
+
+ private:
+  int fd_ = -1;
+  int port_ = 0;
+};
+
+// Connects to host:port with retry until timeout, then handshakes
+// (magic, my_rank, channel). Returns an invalid Conn on failure.
+Conn ConnectPeer(const std::string& host, int port, int my_rank,
+                 Channel channel, int timeout_ms);
+
+// Splits "host:port" / "h1:p1,h2:p2,..." forms.
+bool ParseHostPort(const std::string& s, std::string* host, int* port);
+std::vector<std::string> SplitString(const std::string& s, char sep);
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_NET_H

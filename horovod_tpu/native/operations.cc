@@ -1,0 +1,493 @@
+// Core runtime entry points: the process-wide singleton state, the
+// background coordination thread (the only thread that talks cross-rank),
+// the enqueue API, and the extern "C" surface loaded by Python via ctypes.
+//
+// Capability parity with /root/reference horovod/common/operations.cc
+// (InitializeHorovodOnce / BackgroundThreadLoop / RunLoopOnce /
+// PerformOperation / EnqueueTensor* / horovod_* C API), redesigned for the
+// TPU build: completion is handle-based (HandleManager, mirroring the
+// reference torch binding's handle_manager.h) so no foreign thread re-enters
+// Python, and the data plane is the host TCP ring — TPU-resident tensors
+// ride XLA collectives inside jit and never enter this core.
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <thread>
+
+#include "collective_operations.h"
+#include "common.h"
+#include "controller.h"
+#include "cpu_operations.h"
+#include "global_state.h"
+#include "logging.h"
+#include "tcp_controller.h"
+
+namespace hvdtpu {
+
+HorovodGlobalState::~HorovodGlobalState() = default;
+
+namespace {
+
+HorovodGlobalState g_state;
+std::mutex g_init_mutex;
+
+// ---------------- HandleManager ----------------
+
+struct HandleEntry {
+  bool done = false;
+  Status status;
+  std::shared_ptr<std::vector<char>> gathered;
+  std::shared_ptr<std::vector<int64_t>> gathered_sizes;
+};
+
+class HandleManager {
+ public:
+  int AllocateHandle() {
+    std::lock_guard<std::mutex> lk(mutex_);
+    int handle = next_handle_++;
+    entries_[handle] = std::make_shared<HandleEntry>();
+    return handle;
+  }
+
+  void MarkDone(int handle, const Status& status,
+                std::shared_ptr<std::vector<char>> gathered = nullptr,
+                std::shared_ptr<std::vector<int64_t>> sizes = nullptr) {
+    {
+      std::lock_guard<std::mutex> lk(mutex_);
+      auto it = entries_.find(handle);
+      if (it == entries_.end()) return;
+      it->second->done = true;
+      it->second->status = status;
+      it->second->gathered = std::move(gathered);
+      it->second->gathered_sizes = std::move(sizes);
+    }
+    cv_.notify_all();
+  }
+
+  bool Poll(int handle) {
+    std::lock_guard<std::mutex> lk(mutex_);
+    auto it = entries_.find(handle);
+    return it == entries_.end() || it->second->done;
+  }
+
+  std::shared_ptr<HandleEntry> Wait(int handle) {
+    std::unique_lock<std::mutex> lk(mutex_);
+    auto it = entries_.find(handle);
+    if (it == entries_.end()) return nullptr;
+    auto entry = it->second;
+    cv_.wait(lk, [&] { return entry->done; });
+    return entry;
+  }
+
+  std::shared_ptr<HandleEntry> Get(int handle) {
+    std::lock_guard<std::mutex> lk(mutex_);
+    auto it = entries_.find(handle);
+    return it == entries_.end() ? nullptr : it->second;
+  }
+
+  void Release(int handle) {
+    std::lock_guard<std::mutex> lk(mutex_);
+    entries_.erase(handle);
+  }
+
+  void FailAll(const Status& status) {
+    {
+      std::lock_guard<std::mutex> lk(mutex_);
+      for (auto& kv : entries_) {
+        if (!kv.second->done) {
+          kv.second->done = true;
+          kv.second->status = status;
+        }
+      }
+    }
+    cv_.notify_all();
+  }
+
+ private:
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  int next_handle_ = 0;
+  std::map<int, std::shared_ptr<HandleEntry>> entries_;
+};
+
+HandleManager g_handles;
+
+// ---------------- env helpers ----------------
+
+int64_t EnvInt64(const char* name, int64_t dflt, bool* present = nullptr) {
+  const char* v = std::getenv(name);
+  if (present != nullptr) *present = v != nullptr;
+  return v == nullptr ? dflt : std::strtoll(v, nullptr, 10);
+}
+
+double EnvDouble(const char* name, double dflt, bool* present = nullptr) {
+  const char* v = std::getenv(name);
+  if (present != nullptr) *present = v != nullptr;
+  return v == nullptr ? dflt : std::strtod(v, nullptr);
+}
+
+bool EnvBool(const char* name, bool dflt, bool* present = nullptr) {
+  const char* v = std::getenv(name);
+  if (present != nullptr) *present = v != nullptr;
+  if (v == nullptr) return dflt;
+  return std::strtol(v, nullptr, 10) != 0;
+}
+
+// ---------------- background loop ----------------
+
+void PerformOperation(HorovodGlobalState& state, const Response& response) {
+  // Cache the negotiated response while entries are still in the table.
+  if (response.response_type() != Response::ERROR) {
+    state.response_cache.put(response, state.tensor_queue);
+  }
+  std::vector<TensorTableEntry> entries;
+  state.tensor_queue.GetTensorEntriesFromResponse(response, entries);
+  if (entries.empty()) return;
+  for (const auto& e : entries) {
+    state.timeline.Start(e.tensor_name, response.response_type());
+  }
+  Status status;
+  try {
+    status = state.op_manager->ExecuteOperation(entries, response);
+  } catch (const std::exception& ex) {
+    status = Status::UnknownError(ex.what());
+  }
+  for (auto& e : entries) {
+    state.timeline.End(e.tensor_name, status.ok());
+    if (e.callback) e.callback(status, e);
+  }
+}
+
+int64_t ResponseListByteTotal(HorovodGlobalState& state,
+                              const ResponseList& list) {
+  int64_t total = 0;
+  for (const auto& response : list.responses()) {
+    int64_t dtype_size =
+        static_cast<int64_t>(DataTypeSize(response.tensor_type()));
+    for (int64_t n : response.tensor_sizes()) total += n * dtype_size;
+  }
+  return total;
+}
+
+bool RunLoopOnce(HorovodGlobalState& state,
+                 std::chrono::steady_clock::time_point& last_cycle_start) {
+  // Pace the cycle.
+  auto cycle =
+      std::chrono::duration<double, std::milli>(
+          state.parameter_manager.CycleTimeMs());
+  auto next_start = last_cycle_start +
+                    std::chrono::duration_cast<std::chrono::steady_clock::duration>(cycle);
+  auto now = std::chrono::steady_clock::now();
+  if (next_start > now) {
+    std::this_thread::sleep_for(next_start - now);
+  }
+  last_cycle_start = std::chrono::steady_clock::now();
+
+  if (state.mark_cycles_in_timeline) {
+    state.timeline.MarkCycleStart();
+  }
+
+  bool was_tuning = state.parameter_manager.IsAutoTuning();
+
+  ResponseList response_list =
+      state.controller->ComputeResponseList(state.shut_down.load());
+
+  for (const auto& response : response_list.responses()) {
+    PerformOperation(state, response);
+  }
+
+  if (was_tuning) {
+    if (state.controller->is_coordinator()) {
+      std::vector<std::string> names;
+      state.parameter_manager.Update(names,
+                                     ResponseListByteTotal(state,
+                                                           response_list));
+    }
+    state.controller->SynchronizeParameters();
+  }
+
+  return !response_list.shutdown();
+}
+
+void BackgroundThreadLoop(HorovodGlobalState& state) {
+  if (!state.tcp_context.Initialize()) {
+    state.initialization_failed.store(true);
+    state.initialization_done.store(true);
+    return;
+  }
+
+  state.controller = std::make_unique<TcpController>(
+      state.response_cache, state.tensor_queue, state.timeline,
+      state.parameter_manager, state.tcp_context);
+  state.controller->Initialize();
+
+  // Runtime knobs (env; autotuner may override non-fixed ones later).
+  bool fixed;
+  int64_t fusion_threshold =
+      EnvInt64(HVD_TPU_FUSION_THRESHOLD, 64 * 1024 * 1024, &fixed);
+  state.parameter_manager.SetTensorFusionThresholdBytes(fusion_threshold,
+                                                        fixed);
+  double cycle_time = EnvDouble(HVD_TPU_CYCLE_TIME, 5.0, &fixed);
+  state.parameter_manager.SetCycleTimeMs(cycle_time, fixed);
+  int64_t cache_capacity = EnvInt64(HVD_TPU_CACHE_CAPACITY, 1024, &fixed);
+  state.response_cache.set_capacity(static_cast<uint32_t>(cache_capacity));
+  state.parameter_manager.SetCacheEnabled(cache_capacity > 0, fixed);
+  bool hier_ar = EnvBool(HVD_TPU_HIERARCHICAL_ALLREDUCE, false, &fixed);
+  state.parameter_manager.SetHierarchicalAllreduce(hier_ar, fixed);
+  bool hier_ag = EnvBool(HVD_TPU_HIERARCHICAL_ALLGATHER, false, &fixed);
+  state.parameter_manager.SetHierarchicalAllgather(hier_ag, fixed);
+
+  state.controller->stall_inspector().SetStallWarningTimeSeconds(
+      static_cast<int>(EnvInt64(HVD_TPU_STALL_CHECK_TIME, 60)));
+  state.controller->stall_inspector().SetStallShutdownTimeSeconds(
+      static_cast<int>(EnvInt64(HVD_TPU_STALL_SHUTDOWN_TIME, 0)));
+
+  const char* timeline_path = std::getenv(HVD_TPU_TIMELINE);
+  if (timeline_path != nullptr) {
+    state.timeline.Initialize(timeline_path,
+                              static_cast<unsigned>(state.controller->rank()));
+    state.timeline.SetMarkCycles(
+        EnvBool(HVD_TPU_TIMELINE_MARK_CYCLES, false));
+    state.mark_cycles_in_timeline =
+        EnvBool(HVD_TPU_TIMELINE_MARK_CYCLES, false);
+  }
+
+  const char* autotune_log = std::getenv(HVD_TPU_AUTOTUNE_LOG);
+  state.parameter_manager.Initialize(state.controller->rank(),
+                                     autotune_log ? autotune_log : "");
+  if (EnvBool(HVD_TPU_AUTOTUNE, false)) {
+    state.parameter_manager.SetAutoTuning(true);
+  }
+
+  // Data-plane op registry: first Enabled() op per type executes. Ordered
+  // most-specific first, CPU ring last, mirroring the reference's registry
+  // construction (operations.cc:137-207). The XLA/ICI path for TPU-resident
+  // tensors lives inside jit (horovod_tpu/jax) and is deliberately not a
+  // registry entry here — it never crosses the host boundary.
+  std::vector<std::shared_ptr<AllreduceOp>> allreduce_ops = {
+      std::make_shared<CpuRingAllreduce>(state.tcp_context, &state)};
+  std::vector<std::shared_ptr<AllgatherOp>> allgather_ops = {
+      std::make_shared<CpuRingAllgather>(state.tcp_context, &state)};
+  std::vector<std::shared_ptr<BroadcastOp>> broadcast_ops = {
+      std::make_shared<CpuBroadcast>(state.tcp_context, &state)};
+  state.op_manager = std::make_unique<OperationManager>(
+      std::move(allreduce_ops), std::move(allgather_ops),
+      std::move(broadcast_ops), std::make_shared<ErrorOp>(&state));
+
+  state.initialization_done.store(true);
+  LOG(DEBUG) << "background loop starting";
+
+  auto last_cycle_start = std::chrono::steady_clock::now();
+  try {
+    while (RunLoopOnce(state, last_cycle_start)) {
+    }
+  } catch (const std::exception& ex) {
+    LOG(ERROR) << "background loop terminated: " << ex.what();
+  }
+
+  LOG(DEBUG) << "background loop shutting down";
+  state.shut_down.store(true);
+  state.tensor_queue.FinalizeTensorQueue(Status::Aborted(SHUT_DOWN_ERROR));
+  g_handles.FailAll(Status::Aborted(SHUT_DOWN_ERROR));
+  state.timeline.Shutdown();
+  state.tcp_context.Finalize();
+}
+
+bool InitializeHorovodOnce() {
+  std::lock_guard<std::mutex> lk(g_init_mutex);
+  if (!g_state.initialize_flag.load()) {
+    g_state.initialize_flag.store(true);
+    g_state.shut_down.store(false);
+    g_state.background_thread =
+        std::thread(BackgroundThreadLoop, std::ref(g_state));
+  }
+  while (!g_state.initialization_done.load()) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  return !g_state.initialization_failed.load();
+}
+
+Status EnqueueTensor(Request::RequestType type, const char* name,
+                     const void* data, void* output, int ndim,
+                     const int64_t* shape, int dtype, int root_rank,
+                     double prescale, double postscale, int handle) {
+  if (!g_state.initialization_done.load() ||
+      g_state.initialization_failed.load()) {
+    return Status::PreconditionError("Horovod-TPU has not been initialized.");
+  }
+  if (g_state.shut_down.load()) {
+    return Status::Aborted(SHUT_DOWN_ERROR);
+  }
+  TensorShape tensor_shape;
+  for (int i = 0; i < ndim; ++i) tensor_shape.AddDim(shape[i]);
+
+  Request message;
+  message.set_request_rank(g_state.controller->rank());
+  message.set_request_type(type);
+  message.set_tensor_name(name);
+  message.set_tensor_type(static_cast<DataType>(dtype));
+  message.set_tensor_shape(tensor_shape.dims());
+  message.set_root_rank(root_rank);
+  message.set_device(HOST_DEVICE_ID);
+  message.set_prescale_factor(prescale);
+  message.set_postscale_factor(postscale);
+
+  TensorTableEntry entry;
+  entry.tensor_name = name;
+  entry.data = data;
+  entry.output = output;
+  entry.dtype = static_cast<DataType>(dtype);
+  entry.shape = tensor_shape;
+  entry.root_rank = root_rank;
+  entry.prescale_factor = prescale;
+  entry.postscale_factor = postscale;
+  entry.callback = [handle](const Status& status,
+                            const TensorTableEntry& done_entry) {
+    g_handles.MarkDone(handle, status, done_entry.gathered,
+                       done_entry.gathered_sizes);
+  };
+  return g_state.tensor_queue.AddToTensorQueue(std::move(entry),
+                                               std::move(message));
+}
+
+}  // namespace
+
+}  // namespace hvdtpu
+
+// ---------------- extern "C" API ----------------
+
+using namespace hvdtpu;
+
+extern "C" {
+
+int horovod_tpu_init() { return InitializeHorovodOnce() ? 1 : 0; }
+
+void horovod_tpu_request_shutdown() { g_state.shut_down.store(true); }
+
+void horovod_tpu_shutdown() {
+  std::lock_guard<std::mutex> lk(g_init_mutex);
+  if (!g_state.initialize_flag.load()) return;
+  g_state.shut_down.store(true);
+  if (g_state.background_thread.joinable()) {
+    g_state.background_thread.join();
+  }
+  g_state.initialize_flag.store(false);
+  g_state.initialization_done.store(false);
+}
+
+int horovod_tpu_initialized() {
+  return g_state.initialization_done.load() &&
+                 !g_state.initialization_failed.load()
+             ? 1
+             : 0;
+}
+
+int horovod_tpu_rank() {
+  return g_state.controller ? g_state.controller->rank() : -1;
+}
+int horovod_tpu_local_rank() {
+  return g_state.controller ? g_state.controller->local_rank() : -1;
+}
+int horovod_tpu_cross_rank() {
+  return g_state.controller ? g_state.controller->cross_rank() : -1;
+}
+int horovod_tpu_size() {
+  return g_state.controller ? g_state.controller->size() : -1;
+}
+int horovod_tpu_local_size() {
+  return g_state.controller ? g_state.controller->local_size() : -1;
+}
+int horovod_tpu_cross_size() {
+  return g_state.controller ? g_state.controller->cross_size() : -1;
+}
+int horovod_tpu_is_homogeneous() {
+  return g_state.controller && g_state.controller->is_homogeneous() ? 1 : 0;
+}
+
+// Build/capability probes (reference: horovod_mpi_built etc.).
+int horovod_tpu_tcp_built() { return 1; }
+int horovod_tpu_cpu_ops_built() { return 1; }
+
+int horovod_tpu_enqueue_allreduce(const char* name, const void* data,
+                                  void* output, int ndim, const int64_t* shape,
+                                  int dtype, double prescale,
+                                  double postscale) {
+  int handle = g_handles.AllocateHandle();
+  Status s = EnqueueTensor(Request::ALLREDUCE, name, data, output, ndim, shape,
+                           dtype, 0, prescale, postscale, handle);
+  if (!s.ok()) {
+    g_handles.MarkDone(handle, s);
+  }
+  return handle;
+}
+
+int horovod_tpu_enqueue_allgather(const char* name, const void* data, int ndim,
+                                  const int64_t* shape, int dtype) {
+  int handle = g_handles.AllocateHandle();
+  // The op writes the gathered result into core-owned buffers; the entry
+  // callback surfaces them through the handle for copy-out.
+  Status s = EnqueueTensor(Request::ALLGATHER, name, data, nullptr, ndim,
+                           shape, dtype, 0, 1.0, 1.0, handle);
+  if (!s.ok()) {
+    g_handles.MarkDone(handle, s);
+  }
+  return handle;
+}
+
+int horovod_tpu_enqueue_broadcast(const char* name, const void* data,
+                                  void* output, int ndim, const int64_t* shape,
+                                  int dtype, int root_rank) {
+  int handle = g_handles.AllocateHandle();
+  Status s = EnqueueTensor(Request::BROADCAST, name, data, output, ndim, shape,
+                           dtype, root_rank, 1.0, 1.0, handle);
+  if (!s.ok()) {
+    g_handles.MarkDone(handle, s);
+  }
+  return handle;
+}
+
+int horovod_tpu_poll(int handle) { return g_handles.Poll(handle) ? 1 : 0; }
+
+int horovod_tpu_wait(int handle) {
+  auto entry = g_handles.Wait(handle);
+  if (entry == nullptr) return static_cast<int>(StatusType::INVALID_ARGUMENT);
+  return static_cast<int>(entry->status.type());
+}
+
+const char* horovod_tpu_error_string(int handle) {
+  static thread_local std::string err;
+  auto entry = g_handles.Get(handle);
+  err = entry ? entry->status.reason() : "unknown handle";
+  return err.c_str();
+}
+
+int64_t horovod_tpu_allgather_bytes(int handle) {
+  auto entry = g_handles.Get(handle);
+  if (entry == nullptr || entry->gathered == nullptr) return -1;
+  return static_cast<int64_t>(entry->gathered->size());
+}
+
+int64_t horovod_tpu_allgather_rank_dim(int handle, int rank) {
+  auto entry = g_handles.Get(handle);
+  if (entry == nullptr || entry->gathered_sizes == nullptr ||
+      rank >= static_cast<int>(entry->gathered_sizes->size())) {
+    return -1;
+  }
+  return (*entry->gathered_sizes)[rank];
+}
+
+int horovod_tpu_allgather_copy(int handle, void* out) {
+  auto entry = g_handles.Get(handle);
+  if (entry == nullptr || entry->gathered == nullptr) return 0;
+  std::memcpy(out, entry->gathered->data(), entry->gathered->size());
+  return 1;
+}
+
+void horovod_tpu_release(int handle) { g_handles.Release(handle); }
+
+}  // extern "C"

@@ -1,0 +1,212 @@
+#include "parameter_manager.h"
+
+#include <chrono>
+
+#include "bayesian_optimization.h"
+#include "logging.h"
+
+namespace hvdtpu {
+
+static double NowMicros() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+ParameterManager::ParameterManager() = default;
+ParameterManager::~ParameterManager() = default;
+
+void ParameterManager::Initialize(int32_t rank,
+                                  const std::string& autotune_log_file) {
+  rank_ = rank;
+  if (rank == 0 && !autotune_log_file.empty()) {
+    log_.open(autotune_log_file, std::ios::out | std::ios::trunc);
+    if (log_.is_open()) {
+      log_ << "fusion_mb,cycle_time_ms,cache_enabled,hierarchical_allreduce,"
+              "hierarchical_allgather,score_bytes_per_us\n";
+    }
+  }
+  // Categorical combos to sweep: (cache, hier_allreduce, hier_allgather).
+  // Fixed knobs collapse their dimension.
+  categorical_combos_.clear();
+  std::vector<bool> cache_opts =
+      cache_fixed_ ? std::vector<bool>{cache_enabled_}
+                   : std::vector<bool>{true, false};
+  std::vector<bool> har_opts =
+      hier_ar_fixed_ ? std::vector<bool>{hierarchical_allreduce_}
+                     : std::vector<bool>{false, true};
+  std::vector<bool> hag_opts =
+      hier_ag_fixed_ ? std::vector<bool>{hierarchical_allgather_}
+                     : std::vector<bool>{false, true};
+  for (bool c : cache_opts) {
+    for (bool ar : har_opts) {
+      for (bool ag : hag_opts) {
+        categorical_combos_.push_back({c, ar, ag});
+      }
+    }
+  }
+  optimizers_.clear();
+  for (std::size_t i = 0; i < categorical_combos_.size(); ++i) {
+    optimizers_.push_back(std::make_unique<BayesianOptimizer>(
+        std::vector<std::pair<double, double>>{{0.0, 64.0}, {1.0, 100.0}},
+        /*seed=*/1234 + i));
+  }
+}
+
+void ParameterManager::SetAutoTuning(bool active) {
+  active_ = active;
+  if (active) {
+    warmup_remaining_ = 3;
+    cycles_in_sample_ = 0;
+    bytes_in_sample_ = 0;
+    sample_count_ = 0;
+    combo_index_ = 0;
+    samples_in_combo_ = 0;
+    ReadyTune();
+  }
+}
+
+int64_t ParameterManager::TensorFusionThresholdBytes() const {
+  return static_cast<int64_t>(fusion_mb_ * 1024.0 * 1024.0);
+}
+
+void ParameterManager::SetTensorFusionThresholdBytes(int64_t threshold,
+                                                     bool fixed) {
+  fusion_mb_ = static_cast<double>(threshold) / (1024.0 * 1024.0);
+  fusion_fixed_ = fusion_fixed_ || fixed;
+}
+
+double ParameterManager::CycleTimeMs() const { return cycle_time_ms_; }
+
+void ParameterManager::SetCycleTimeMs(double cycle_time_ms, bool fixed) {
+  cycle_time_ms_ = cycle_time_ms;
+  cycle_fixed_ = cycle_fixed_ || fixed;
+}
+
+bool ParameterManager::CacheEnabled() const { return cache_enabled_; }
+
+void ParameterManager::SetCacheEnabled(bool enabled, bool fixed) {
+  cache_enabled_ = enabled;
+  cache_fixed_ = cache_fixed_ || fixed;
+}
+
+bool ParameterManager::HierarchicalAllreduce() const {
+  return hierarchical_allreduce_;
+}
+
+void ParameterManager::SetHierarchicalAllreduce(bool enabled, bool fixed) {
+  hierarchical_allreduce_ = enabled;
+  hier_ar_fixed_ = hier_ar_fixed_ || fixed;
+}
+
+bool ParameterManager::HierarchicalAllgather() const {
+  return hierarchical_allgather_;
+}
+
+void ParameterManager::SetHierarchicalAllgather(bool enabled, bool fixed) {
+  hierarchical_allgather_ = enabled;
+  hier_ag_fixed_ = hier_ag_fixed_ || fixed;
+}
+
+void ParameterManager::ReadyTune() {
+  // Apply the next sample point of the current categorical combo.
+  if (combo_index_ >= categorical_combos_.size()) return;
+  const auto& combo = categorical_combos_[combo_index_];
+  if (!cache_fixed_) cache_enabled_ = combo[0];
+  if (!hier_ar_fixed_) hierarchical_allreduce_ = combo[1];
+  if (!hier_ag_fixed_) hierarchical_allgather_ = combo[2];
+  auto next = optimizers_[combo_index_]->NextSample();
+  if (!fusion_fixed_) fusion_mb_ = next[0];
+  if (!cycle_fixed_) cycle_time_ms_ = next[1];
+}
+
+void ParameterManager::LogSample(double score) {
+  if (!log_.is_open()) return;
+  log_ << fusion_mb_ << "," << cycle_time_ms_ << "," << cache_enabled_ << ","
+       << hierarchical_allreduce_ << "," << hierarchical_allgather_ << ","
+       << score << "\n";
+  log_.flush();
+}
+
+bool ParameterManager::Update(const std::vector<std::string>& tensor_names,
+                              int64_t bytes) {
+  if (!active_) return false;
+  if (cycles_in_sample_ == 0 && bytes_in_sample_ == 0) {
+    sample_start_us_ = NowMicros();
+  }
+  bytes_in_sample_ += bytes;
+  ++cycles_in_sample_;
+  (void)tensor_names;
+  if (cycles_in_sample_ < kCyclesPerSample) return false;
+
+  double elapsed_us = NowMicros() - sample_start_us_;
+  double score = elapsed_us > 0
+                     ? static_cast<double>(bytes_in_sample_) / elapsed_us
+                     : 0.0;
+  cycles_in_sample_ = 0;
+  bytes_in_sample_ = 0;
+
+  if (warmup_remaining_ > 0) {
+    --warmup_remaining_;
+    return false;
+  }
+  return Tune(score);
+}
+
+bool ParameterManager::Tune(double score) {
+  LogSample(score);
+  if (score > best_score_) {
+    best_score_ = score;
+    best_fusion_mb_ = fusion_mb_;
+    best_cycle_ms_ = cycle_time_ms_;
+    best_cache_ = cache_enabled_;
+    best_hier_ar_ = hierarchical_allreduce_;
+    best_hier_ag_ = hierarchical_allgather_;
+  }
+  optimizers_[combo_index_]->AddSample({fusion_mb_, cycle_time_ms_}, score);
+  ++sample_count_;
+  ++samples_in_combo_;
+  if (samples_in_combo_ >= kSamplesPerCombo) {
+    samples_in_combo_ = 0;
+    ++combo_index_;
+  }
+  if (sample_count_ >= kMaxSamples ||
+      combo_index_ >= categorical_combos_.size()) {
+    // Converged: adopt the best configuration and stop tuning.
+    if (!fusion_fixed_) fusion_mb_ = best_fusion_mb_;
+    if (!cycle_fixed_) cycle_time_ms_ = best_cycle_ms_;
+    if (!cache_fixed_) cache_enabled_ = best_cache_;
+    if (!hier_ar_fixed_) hierarchical_allreduce_ = best_hier_ar_;
+    if (!hier_ag_fixed_) hierarchical_allgather_ = best_hier_ag_;
+    active_ = false;
+    LOG(INFO) << "autotune converged: fusion_mb=" << fusion_mb_
+              << " cycle_ms=" << cycle_time_ms_
+              << " cache=" << cache_enabled_
+              << " score=" << best_score_ << " bytes/us";
+    return true;
+  }
+  ReadyTune();
+  return true;
+}
+
+ParameterManager::Params ParameterManager::GetParams() const {
+  Params p;
+  p.fusion_mb = fusion_mb_;
+  p.cycle_time_ms = cycle_time_ms_;
+  p.cache_enabled = cache_enabled_ ? 1 : 0;
+  p.hierarchical_allreduce = hierarchical_allreduce_ ? 1 : 0;
+  p.hierarchical_allgather = hierarchical_allgather_ ? 1 : 0;
+  p.active = active_ ? 1 : 0;
+  return p;
+}
+
+void ParameterManager::SetParams(const Params& p) {
+  fusion_mb_ = p.fusion_mb;
+  cycle_time_ms_ = p.cycle_time_ms;
+  cache_enabled_ = p.cache_enabled != 0;
+  hierarchical_allreduce_ = p.hierarchical_allreduce != 0;
+  hierarchical_allgather_ = p.hierarchical_allgather != 0;
+  active_ = p.active != 0;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,114 @@
+// Autotuner for the runtime knobs that govern negotiation efficiency:
+//   - tensor fusion threshold (MB, continuous in [0, 64])
+//   - cycle time (ms, continuous in [1, 100])
+//   - response cache enabled (categorical)
+//   - hierarchical allreduce / allgather (categorical)
+// Joint search: for each categorical combination, Bayesian optimization
+// (Gaussian process + expected improvement) over the two continuous knobs.
+// Score = bytes processed per microsecond over a sampling window; warmup
+// discards the first samples. Best parameters are broadcast from rank 0 via
+// Controller::SynchronizeParameters.
+//
+// Capability parity with /root/reference
+// horovod/common/parameter_manager.{h,cc} + optim/bayesian_optimization.cc;
+// fresh implementation with hand-rolled small-matrix GP math (no Eigen).
+#ifndef HVD_TPU_PARAMETER_MANAGER_H
+#define HVD_TPU_PARAMETER_MANAGER_H
+
+#include <array>
+#include <cstdint>
+#include <fstream>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+class BayesianOptimizer;
+
+class ParameterManager {
+ public:
+  ParameterManager();
+  ~ParameterManager();
+
+  void Initialize(int32_t rank, const std::string& autotune_log_file);
+  void SetAutoTuning(bool active);
+  bool IsAutoTuning() const { return active_; }
+
+  int64_t TensorFusionThresholdBytes() const;
+  void SetTensorFusionThresholdBytes(int64_t threshold, bool fixed = false);
+  double CycleTimeMs() const;
+  void SetCycleTimeMs(double cycle_time_ms, bool fixed = false);
+  bool CacheEnabled() const;
+  void SetCacheEnabled(bool enabled, bool fixed = false);
+  bool HierarchicalAllreduce() const;
+  void SetHierarchicalAllreduce(bool enabled, bool fixed = false);
+  bool HierarchicalAllgather() const;
+  void SetHierarchicalAllgather(bool enabled, bool fixed = false);
+
+  // Called once per cycle with the bytes negotiated+executed this cycle.
+  // Returns true when tuned parameter values changed (caller re-syncs ranks).
+  bool Update(const std::vector<std::string>& tensor_names, int64_t bytes);
+
+  // POD snapshot for cross-rank parameter broadcast.
+  struct Params {
+    double fusion_mb;
+    double cycle_time_ms;
+    uint8_t cache_enabled;
+    uint8_t hierarchical_allreduce;
+    uint8_t hierarchical_allgather;
+    uint8_t active;
+  };
+  Params GetParams() const;
+  void SetParams(const Params& p);
+
+ private:
+  bool Tune(double score);
+  void ReadyTune();
+  void LogSample(double score);
+
+  // Current values.
+  double fusion_mb_ = 64.0;
+  double cycle_time_ms_ = 5.0;
+  bool cache_enabled_ = true;
+  bool hierarchical_allreduce_ = false;
+  bool hierarchical_allgather_ = false;
+
+  // Fixed-by-env flags exclude a knob from tuning.
+  bool fusion_fixed_ = false;
+  bool cycle_fixed_ = false;
+  bool cache_fixed_ = false;
+  bool hier_ar_fixed_ = false;
+  bool hier_ag_fixed_ = false;
+
+  bool active_ = false;
+  int32_t rank_ = -1;
+  int warmup_remaining_ = 3;
+  int cycles_in_sample_ = 0;
+  int64_t bytes_in_sample_ = 0;
+  double sample_start_us_ = 0.0;
+  int sample_count_ = 0;
+  static constexpr int kCyclesPerSample = 10;
+  static constexpr int kMaxSamples = 40;
+
+  // Best seen.
+  double best_score_ = 0.0;
+  double best_fusion_mb_ = 64.0;
+  double best_cycle_ms_ = 5.0;
+  bool best_cache_ = true;
+  bool best_hier_ar_ = false;
+  bool best_hier_ag_ = false;
+
+  // Categorical sweep state: index into combos; each combo gets its own BO.
+  std::vector<std::array<bool, 3>> categorical_combos_;
+  std::size_t combo_index_ = 0;
+  int samples_in_combo_ = 0;
+  static constexpr int kSamplesPerCombo = 10;
+
+  std::vector<std::unique_ptr<BayesianOptimizer>> optimizers_;
+  std::ofstream log_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_PARAMETER_MANAGER_H

@@ -1,0 +1,237 @@
+#include "response_cache.h"
+
+#include <cassert>
+
+#include "controller.h"
+#include "logging.h"
+#include "tensor_queue.h"
+
+namespace hvdtpu {
+
+void ResponseCache::set_capacity(uint32_t capacity) {
+  capacity_ = capacity;
+  cache_.reserve(capacity);
+  cache_iters_.reserve(capacity);
+}
+
+uint32_t ResponseCache::num_active_bits() const {
+  return static_cast<uint32_t>(cache_.size());
+}
+
+ResponseCache::CacheState ResponseCache::cached(const Request& request) const {
+  auto it = name_to_bit_.find(request.tensor_name());
+  if (it == name_to_bit_.end()) return CacheState::MISS;
+  const CacheEntry& e = cache_[it->second];
+  bool same = e.dtype == request.tensor_type() &&
+              e.shape == request.tensor_shape() &&
+              e.root_rank == request.root_rank() &&
+              e.prescale_factor == request.prescale_factor() &&
+              e.postscale_factor == request.postscale_factor();
+  // Response type must match the request type too.
+  same = same && static_cast<int>(e.response.response_type()) ==
+                     static_cast<int>(request.request_type());
+  return same ? CacheState::HIT : CacheState::INVALID;
+}
+
+void ResponseCache::put_entry(const std::string& name, CacheEntry entry) {
+  auto it = name_to_bit_.find(name);
+  if (it != name_to_bit_.end()) {
+    uint32_t bit = it->second;
+    cache_[bit] = std::move(entry);
+    lru_.erase(cache_iters_[bit]);
+    lru_.push_front(bit);
+    cache_iters_[bit] = lru_.begin();
+    return;
+  }
+  uint32_t bit;
+  if (cache_.size() < capacity_) {
+    bit = static_cast<uint32_t>(cache_.size());
+    cache_.push_back(std::move(entry));
+    lru_.push_front(bit);
+    cache_iters_.push_back(lru_.begin());
+  } else {
+    // Evict the LRU entry; its bit is recycled, so positions shift — all
+    // ranks evict identically because they run identical put sequences.
+    bit = lru_.back();
+    lru_.pop_back();
+    for (auto& kv : name_to_bit_) {
+      if (kv.second == bit) {
+        name_to_bit_.erase(kv.first);
+        break;
+      }
+    }
+    cache_[bit] = std::move(entry);
+    lru_.push_front(bit);
+    cache_iters_[bit] = lru_.begin();
+    bits_outdated_ = true;
+  }
+  name_to_bit_[name] = bit;
+}
+
+void ResponseCache::put(const Response& response, TensorQueue& tensor_queue) {
+  if (capacity_ == 0) return;
+  if (response.response_type() == Response::ERROR) return;
+  // Fused responses are cached per-tensor so each tensor can hit alone.
+  for (const auto& name : response.tensor_names()) {
+    Response single;
+    single.set_response_type(response.response_type());
+    single.set_tensor_type(response.tensor_type());
+    single.set_devices(response.devices());
+    single.add_tensor_name(name);
+    CacheEntry entry;
+    // Capture validation params from the table entry if it still exists;
+    // callers invoke put() before callbacks fire, so it does.
+    if (tensor_queue.HasEntry(name)) {
+      const TensorTableEntry& te = tensor_queue.GetTensorEntry(name);
+      entry.dtype = te.dtype;
+      entry.shape = te.shape.dims();
+      entry.root_rank = te.root_rank;
+      entry.prescale_factor = te.prescale_factor;
+      entry.postscale_factor = te.postscale_factor;
+      if (response.response_type() == Response::ALLGATHER) {
+        single.set_tensor_sizes(response.tensor_sizes());
+      }
+    } else {
+      continue;
+    }
+    entry.response = single;
+    put_entry(name, std::move(entry));
+  }
+}
+
+const Response& ResponseCache::get_response(uint32_t cache_bit) {
+  assert(cache_bit < cache_.size());
+  lru_.erase(cache_iters_[cache_bit]);
+  lru_.push_front(cache_bit);
+  cache_iters_[cache_bit] = lru_.begin();
+  return cache_[cache_bit].response;
+}
+
+const Response& ResponseCache::peek_response(uint32_t cache_bit) const {
+  assert(cache_bit < cache_.size());
+  return cache_[cache_bit].response;
+}
+
+uint32_t ResponseCache::peek_cache_bit(const Request& request) const {
+  return peek_cache_bit(request.tensor_name());
+}
+
+uint32_t ResponseCache::peek_cache_bit(const std::string& tensor_name) const {
+  auto it = name_to_bit_.find(tensor_name);
+  assert(it != name_to_bit_.end());
+  return it->second;
+}
+
+void ResponseCache::erase_response(uint32_t cache_bit) {
+  if (cache_bit >= cache_.size()) return;
+  const std::string name = cache_[cache_bit].response.tensor_names()[0];
+  name_to_bit_.erase(name);
+  lru_.erase(cache_iters_[cache_bit]);
+  // Compact: move last entry into the freed slot to keep bits dense.
+  uint32_t last = static_cast<uint32_t>(cache_.size()) - 1;
+  if (cache_bit != last) {
+    cache_[cache_bit] = std::move(cache_[last]);
+    cache_iters_[cache_bit] = cache_iters_[last];
+    *cache_iters_[cache_bit] = cache_bit;
+    const std::string moved = cache_[cache_bit].response.tensor_names()[0];
+    name_to_bit_[moved] = cache_bit;
+  }
+  cache_.pop_back();
+  cache_iters_.pop_back();
+  bits_outdated_ = true;
+}
+
+void ResponseCache::update_cache_bits() {
+  if (!bits_outdated_) return;
+  // Reassign bits by LRU order (most recent = 0) so bit positions are a pure
+  // function of the (identical) access history on every rank.
+  std::vector<CacheEntry> new_cache;
+  new_cache.reserve(cache_.size());
+  std::list<uint32_t> new_lru;
+  std::vector<std::list<uint32_t>::iterator> new_iters(cache_.size());
+  uint32_t new_bit = 0;
+  for (uint32_t old_bit : lru_) {
+    new_cache.push_back(std::move(cache_[old_bit]));
+    new_lru.push_back(new_bit);
+    ++new_bit;
+  }
+  uint32_t i = 0;
+  for (auto it = new_lru.begin(); it != new_lru.end(); ++it, ++i) {
+    new_iters[i] = it;
+  }
+  cache_ = std::move(new_cache);
+  lru_ = std::move(new_lru);
+  cache_iters_ = std::move(new_iters);
+  name_to_bit_.clear();
+  for (uint32_t bit = 0; bit < cache_.size(); ++bit) {
+    name_to_bit_[cache_[bit].response.tensor_names()[0]] = bit;
+  }
+  bits_outdated_ = false;
+}
+
+CacheCoordinator::CacheCoordinator(std::size_t num_active_bits)
+    : num_active_bits_(num_active_bits) {}
+
+void CacheCoordinator::record_hit(uint32_t bit) {
+  assert(!synced_);
+  cache_hits_.insert(bit);
+}
+
+void CacheCoordinator::record_invalid_bit(uint32_t bit) {
+  assert(!synced_);
+  invalid_bits_.insert(bit);
+  invalid_in_queue_ = true;
+}
+
+void CacheCoordinator::erase_hit(uint32_t bit) { cache_hits_.erase(bit); }
+
+void CacheCoordinator::sync(Controller* controller, bool timeline_enabled) {
+  assert(!synced_);
+  // Layout: word 0 = status bits (inverted semantics for AND: a bit survives
+  // the AND only if *every* rank set it; for "any rank wants X" flags we set
+  // the bit when X is FALSE locally and invert after, i.e. surviving bit
+  // means "no rank wants X").
+  std::size_t num_words = (num_active_bits_ + 63) / 64 + 1;
+  std::vector<uint64_t> bits(num_words, 0);
+  if (!should_shut_down_) bits[0] |= 1ull << SHOULD_SHUT_DOWN;
+  if (!uncached_in_queue_) bits[0] |= 1ull << UNCACHED_IN_QUEUE;
+  if (!invalid_in_queue_) bits[0] |= 1ull << INVALID_IN_QUEUE;
+  for (uint32_t bit : cache_hits_) {
+    bits[1 + bit / 64] |= 1ull << (bit % 64);
+  }
+  controller->CrossRankBitwiseAnd(bits);
+
+  should_shut_down_ = (bits[0] & (1ull << SHOULD_SHUT_DOWN)) == 0;
+  uncached_in_queue_ = (bits[0] & (1ull << UNCACHED_IN_QUEUE)) == 0;
+  invalid_in_queue_ = (bits[0] & (1ull << INVALID_IN_QUEUE)) == 0;
+
+  std::set<uint32_t> global_hits;
+  for (uint32_t bit = 0; bit < num_active_bits_; ++bit) {
+    bool global = (bits[1 + bit / 64] & (1ull << (bit % 64))) != 0;
+    if (global) {
+      global_hits.insert(bit);
+    } else if (timeline_enabled && cache_hits_.count(bit)) {
+      timeline_bits_.insert(bit);
+    }
+  }
+  cache_hits_ = std::move(global_hits);
+
+  if (invalid_in_queue_) {
+    // Second pass: union of invalid bits so every rank drops the same set.
+    std::vector<uint64_t> inv(num_words, 0);
+    for (uint32_t bit : invalid_bits_) {
+      inv[1 + bit / 64] |= 1ull << (bit % 64);
+    }
+    controller->CrossRankBitwiseOr(inv);
+    invalid_bits_.clear();
+    for (uint32_t bit = 0; bit < num_active_bits_; ++bit) {
+      if (inv[1 + bit / 64] & (1ull << (bit % 64))) {
+        invalid_bits_.insert(bit);
+        cache_hits_.erase(bit);
+      }
+    }
+  }
+  synced_ = true;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,124 @@
+// LRU cache of negotiated Responses + cross-rank cache-bit coordinator.
+//
+// Why it exists: once a training loop reaches steady state, every cycle
+// queues the same tensors with the same params. Caching the negotiated
+// Response lets every cycle skip the coordinator round-trip entirely: ranks
+// only exchange one fixed-size bit vector (bitwise AND) to agree on which
+// cached entries are globally ready. This is the critical negotiation-latency
+// optimization at large rank counts.
+//
+// Capability parity with /root/reference horovod/common/response_cache.{h,cc}
+// (ResponseCache + CacheCoordinator); fresh implementation.
+#ifndef HVD_TPU_RESPONSE_CACHE_H
+#define HVD_TPU_RESPONSE_CACHE_H
+
+#include <cstdint>
+#include <list>
+#include <set>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+
+namespace hvdtpu {
+
+class TensorQueue;
+class Controller;
+
+class ResponseCache {
+ public:
+  enum class CacheState { MISS = 0, HIT = 1, INVALID = 2 };
+
+  void set_capacity(uint32_t capacity);
+  uint32_t capacity() const { return capacity_; }
+  uint32_t num_active_bits() const;
+
+  // MISS if never seen; HIT if cached with identical params; INVALID if the
+  // name is cached but shape/dtype/op params changed (entry must be dropped
+  // and renegotiated).
+  CacheState cached(const Request& request) const;
+
+  // Inserts (or refreshes) the response after a successful execution.
+  void put(const Response& response, TensorQueue& tensor_queue);
+
+  // Bit <-> response lookups for the fast path.
+  const Response& get_response(uint32_t cache_bit);
+  const Response& peek_response(uint32_t cache_bit) const;
+  uint32_t peek_cache_bit(const Request& request) const;
+  uint32_t peek_cache_bit(const std::string& tensor_name) const;
+
+  void erase_response(uint32_t cache_bit);
+  // Re-packs cache bits 0..N-1 in LRU order after evictions/erases so all
+  // ranks agree on bit positions (called while ranks are in sync).
+  void update_cache_bits();
+
+ private:
+  struct CacheEntry {
+    Response response;
+    // Params captured from the Request for validity checking.
+    DataType dtype;
+    std::vector<int64_t> shape;
+    int32_t root_rank;
+    double prescale_factor;
+    double postscale_factor;
+  };
+
+  void put_entry(const std::string& name, CacheEntry entry);
+
+  uint32_t capacity_ = 1024;
+  // LRU list of cache bits; most recent at front. cache_[bit] = entry.
+  std::vector<CacheEntry> cache_;
+  std::vector<std::list<uint32_t>::iterator> cache_iters_;
+  std::list<uint32_t> lru_;
+  std::unordered_map<std::string, uint32_t> name_to_bit_;
+  bool bits_outdated_ = false;
+};
+
+// Packs per-cycle cache hit/invalid bit sets plus status flags and syncs them
+// across ranks with one bitwise-AND allreduce (+ a second OR pass when any
+// rank reports invalid entries).
+class CacheCoordinator {
+ public:
+  explicit CacheCoordinator(std::size_t num_active_bits);
+
+  void record_hit(uint32_t bit);
+  void record_invalid_bit(uint32_t bit);
+  void erase_hit(uint32_t bit);
+
+  void set_should_shut_down(bool v) { should_shut_down_ = v; }
+  void set_uncached_in_queue(bool v) { uncached_in_queue_ = v; }
+
+  const std::set<uint32_t>& cache_hits() const { return cache_hits_; }
+  const std::set<uint32_t>& invalid_bits() const { return invalid_bits_; }
+  const std::set<uint32_t>& timeline_bits() const { return timeline_bits_; }
+  bool should_shut_down() const { return should_shut_down_; }
+  bool uncached_in_queue() const { return uncached_in_queue_; }
+
+  // Performs the cross-rank sync through the controller's bit-allreduce.
+  // After this call, cache_hits() is the global intersection, and
+  // invalid_bits() the global union (when any rank had invalids).
+  void sync(Controller* controller, bool timeline_enabled);
+
+ private:
+  enum StatusBit {
+    SHOULD_SHUT_DOWN = 0,
+    UNCACHED_IN_QUEUE = 1,
+    INVALID_IN_QUEUE = 2,
+  };
+
+  std::size_t num_active_bits_;
+  std::set<uint32_t> cache_hits_;
+  std::set<uint32_t> invalid_bits_;
+  // Bits that were hits locally but lost globally — timeline shows these as
+  // still negotiating.
+  std::set<uint32_t> timeline_bits_;
+  bool should_shut_down_ = false;
+  bool uncached_in_queue_ = false;
+  bool invalid_in_queue_ = false;
+  bool synced_ = false;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_RESPONSE_CACHE_H

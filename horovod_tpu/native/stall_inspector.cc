@@ -1,0 +1,99 @@
+#include "stall_inspector.h"
+
+#include <sstream>
+
+#include "logging.h"
+#include "response_cache.h"
+
+namespace hvdtpu {
+
+void StallInspector::RecordUncachedTensorStart(const std::string& tensor_name,
+                                               int rank, int global_size) {
+  auto it = uncached_.find(tensor_name);
+  if (it == uncached_.end()) {
+    uncached_[tensor_name] = {Clock::now(), {rank}};
+  } else {
+    it->second.second.insert(rank);
+  }
+  (void)global_size;
+}
+
+void StallInspector::RemoveUncachedTensor(const std::string& tensor_name) {
+  uncached_.erase(tensor_name);
+}
+
+void StallInspector::RecordCachedTensorStart(const std::string& tensor_name) {
+  if (cached_.find(tensor_name) == cached_.end()) {
+    cached_[tensor_name] = Clock::now();
+  }
+}
+
+void StallInspector::RemoveCachedTensor(const std::string& tensor_name) {
+  cached_.erase(tensor_name);
+}
+
+bool StallInspector::CheckForStalledTensors(int global_size) {
+  bool should_shut_down = false;
+  auto now = Clock::now();
+  std::ostringstream warn;
+  bool any = false;
+  for (const auto& kv : uncached_) {
+    auto age = std::chrono::duration_cast<std::chrono::seconds>(
+                   now - kv.second.first)
+                   .count();
+    if (age < warning_seconds_) continue;
+    any = true;
+    std::ostringstream missing;
+    bool first = true;
+    for (int r = 0; r < global_size; ++r) {
+      if (kv.second.second.count(r) == 0) {
+        if (!first) missing << ", ";
+        missing << r;
+        first = false;
+      }
+    }
+    warn << "\n" << kv.first << " [missing ranks: " << missing.str() << "]";
+    if (shutdown_seconds_ > 0 && age >= shutdown_seconds_) {
+      should_shut_down = true;
+    }
+  }
+  if (any) {
+    LOG(WARNING)
+        << "One or more tensors were submitted to be reduced, gathered or "
+           "broadcasted by subset of ranks and are waiting for remainder of "
+           "ranks for more than " << warning_seconds_ << " seconds. This may "
+           "indicate that different ranks are trying to submit different "
+           "tensors or that only subset of ranks is submitting tensors, which "
+           "will cause deadlock."
+        << warn.str();
+    if (should_shut_down) {
+      LOG(ERROR) << "Stall threshold exceeded; initiating coordinated "
+                    "shutdown.";
+    }
+  }
+  return should_shut_down;
+}
+
+void StallInspector::InvalidateStalledCachedTensors(
+    ResponseCache& cache, std::vector<uint32_t>& invalid_bits) {
+  auto now = Clock::now();
+  for (const auto& kv : cached_) {
+    auto age =
+        std::chrono::duration_cast<std::chrono::seconds>(now - kv.second)
+            .count();
+    if (age >= warning_seconds_) {
+      invalid_bits.push_back(cache.peek_cache_bit(kv.first));
+    }
+  }
+}
+
+bool StallInspector::ShouldPerformCheck() {
+  auto age = std::chrono::duration_cast<std::chrono::seconds>(Clock::now() -
+                                                              last_check_)
+                 .count();
+  return warning_seconds_ > 0 && age >= warning_seconds_;
+}
+
+void StallInspector::UpdateCheckTime() { last_check_ = Clock::now(); }
+
+}  // namespace hvdtpu

@@ -1,0 +1,282 @@
+#include "tcp_context.h"
+
+#include <poll.h>
+#include <sys/socket.h>
+
+#include <cstdlib>
+#include <cstring>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+static int EnvInt(const char* name, int dflt) {
+  const char* v = std::getenv(name);
+  return v == nullptr ? dflt : std::atoi(v);
+}
+
+static constexpr uint32_t kTagGather = 0x11;
+static constexpr uint32_t kTagBcast = 0x12;
+static constexpr uint32_t kTagBits = 0x13;
+static constexpr uint32_t kTagBarrier = 0x14;
+static constexpr uint32_t kTagRing = 0x20;
+
+bool TcpContext::Initialize() {
+  rank_ = EnvInt("HVD_TPU_RANK", 0);
+  size_ = EnvInt("HVD_TPU_SIZE", 1);
+  local_rank_ = EnvInt("HVD_TPU_LOCAL_RANK", rank_);
+  local_size_ = EnvInt("HVD_TPU_LOCAL_SIZE", size_);
+  cross_rank_ = EnvInt("HVD_TPU_CROSS_RANK", 0);
+  cross_size_ = EnvInt("HVD_TPU_CROSS_SIZE", 1);
+  SetLogRank(rank_);
+
+  if (size_ == 1) {
+    initialized_ = true;
+    return true;
+  }
+
+  const char* addrs_env = std::getenv("HVD_TPU_ADDRS");
+  if (addrs_env == nullptr) {
+    LOG(ERROR) << "HVD_TPU_ADDRS not set but size > 1";
+    return false;
+  }
+  std::vector<std::string> addrs = SplitString(addrs_env, ',');
+  if (static_cast<int>(addrs.size()) != size_) {
+    LOG(ERROR) << "HVD_TPU_ADDRS has " << addrs.size() << " entries, expected "
+               << size_;
+    return false;
+  }
+  std::string my_host;
+  int my_port = 0;
+  if (!ParseHostPort(addrs[rank_], &my_host, &my_port)) {
+    LOG(ERROR) << "bad address " << addrs[rank_];
+    return false;
+  }
+  if (!listener_.Start(my_port)) return false;
+
+  int timeout_ms = EnvInt("HVD_TPU_START_TIMEOUT", 60) * 1000;
+
+  // Expected inbound connections: the ring predecessor, plus (rank 0 only)
+  // every worker's control connection.
+  int expected = 1 + (rank_ == 0 ? size_ - 1 : 0);
+  control_conns_.resize(rank_ == 0 ? size_ : 1);
+
+  std::atomic<int> accepted{0};
+  std::atomic<bool> accept_ok{true};
+  std::thread acceptor([&] {
+    for (int i = 0; i < expected; ++i) {
+      int peer_rank;
+      Channel channel;
+      int fd = listener_.AcceptPeer(&peer_rank, &channel, timeout_ms);
+      if (fd < 0) {
+        accept_ok.store(false);
+        return;
+      }
+      if (channel == Channel::RING) {
+        ring_prev_ = Conn(fd);
+      } else if (rank_ == 0 && peer_rank >= 1 && peer_rank < size_) {
+        control_conns_[peer_rank] = Conn(fd);
+      } else {
+        LOG(ERROR) << "unexpected control connection from rank " << peer_rank;
+        accept_ok.store(false);
+        return;
+      }
+      ++accepted;
+    }
+  });
+
+  // Outbound: ring successor, and (workers) control to rank 0.
+  bool ok = true;
+  {
+    int next = (rank_ + 1) % size_;
+    std::string host;
+    int port;
+    ParseHostPort(addrs[next], &host, &port);
+    ring_next_ = ConnectPeer(host, port, rank_, Channel::RING, timeout_ms);
+    ok = ok && ring_next_.valid();
+  }
+  if (ok && rank_ != 0) {
+    std::string host;
+    int port;
+    ParseHostPort(addrs[0], &host, &port);
+    control_conns_[0] =
+        ConnectPeer(host, port, rank_, Channel::CONTROL, timeout_ms);
+    ok = ok && control_conns_[0].valid();
+  }
+  acceptor.join();
+  if (!ok || !accept_ok.load()) {
+    LOG(ERROR) << "rendezvous failed (rank " << rank_ << ")";
+    return false;
+  }
+  initialized_ = true;
+  LOG(DEBUG) << "TcpContext initialized: rank " << rank_ << "/" << size_;
+  return true;
+}
+
+void TcpContext::Finalize() {
+  for (auto& c : control_conns_) c.Close();
+  control_conns_.clear();
+  ring_next_.Close();
+  ring_prev_.Close();
+  listener_.Close();
+  initialized_ = false;
+}
+
+bool TcpContext::GatherBlobs(const std::string& mine,
+                             std::vector<std::string>* all) {
+  if (size_ == 1) {
+    if (all != nullptr) {
+      all->assign(1, mine);
+    }
+    return true;
+  }
+  if (rank_ == 0) {
+    all->assign(size_, std::string());
+    (*all)[0] = mine;
+    for (int r = 1; r < size_; ++r) {
+      uint32_t tag;
+      if (!control_conns_[r].RecvFrame(&tag, &(*all)[r]) ||
+          tag != kTagGather) {
+        return false;
+      }
+    }
+    return true;
+  }
+  return control_conns_[0].SendFrame(kTagGather, mine);
+}
+
+bool TcpContext::BroadcastBlob(std::string* blob) {
+  if (size_ == 1) return true;
+  if (rank_ == 0) {
+    for (int r = 1; r < size_; ++r) {
+      if (!control_conns_[r].SendFrame(kTagBcast, *blob)) return false;
+    }
+    return true;
+  }
+  uint32_t tag;
+  return control_conns_[0].RecvFrame(&tag, blob) && tag == kTagBcast;
+}
+
+bool TcpContext::BitwiseSync(std::vector<uint64_t>& bits, bool is_or) {
+  if (size_ == 1) return true;
+  std::size_t nbytes = bits.size() * sizeof(uint64_t);
+  if (rank_ == 0) {
+    std::vector<uint64_t> peer(bits.size());
+    for (int r = 1; r < size_; ++r) {
+      uint32_t tag;
+      if (!control_conns_[r].RecvFrameInto(&tag, peer.data(), nbytes) ||
+          tag != kTagBits) {
+        return false;
+      }
+      for (std::size_t i = 0; i < bits.size(); ++i) {
+        bits[i] = is_or ? (bits[i] | peer[i]) : (bits[i] & peer[i]);
+      }
+    }
+    for (int r = 1; r < size_; ++r) {
+      if (!control_conns_[r].SendFrame(kTagBits, bits.data(), nbytes)) {
+        return false;
+      }
+    }
+    return true;
+  }
+  uint32_t tag;
+  return control_conns_[0].SendFrame(kTagBits, bits.data(), nbytes) &&
+         control_conns_[0].RecvFrameInto(&tag, bits.data(), nbytes) &&
+         tag == kTagBits;
+}
+
+static constexpr uint32_t kTagData = 0x21;
+
+bool TcpContext::StarSend(int peer, const void* data, std::size_t len) {
+  if (rank_ == 0) {
+    if (peer <= 0 || peer >= size_) return false;
+    return control_conns_[peer].SendFrame(kTagData, data, len);
+  }
+  if (peer != 0) return false;
+  return control_conns_[0].SendFrame(kTagData, data, len);
+}
+
+bool TcpContext::StarRecv(int peer, void* buf, std::size_t len) {
+  uint32_t tag;
+  if (rank_ == 0) {
+    if (peer <= 0 || peer >= size_) return false;
+    return control_conns_[peer].RecvFrameInto(&tag, buf, len) &&
+           tag == kTagData;
+  }
+  if (peer != 0) return false;
+  return control_conns_[0].RecvFrameInto(&tag, buf, len) && tag == kTagData;
+}
+
+bool TcpContext::Barrier() {
+  std::vector<uint64_t> bits(1, ~0ull);
+  return BitwiseSync(bits, false);
+}
+
+bool TcpContext::RingExchange(const void* send_buf, std::size_t send_len,
+                              void* recv_buf, std::size_t recv_len) {
+  if (size_ == 1) {
+    if (recv_len > 0 && recv_buf != send_buf) {
+      std::memcpy(recv_buf, send_buf, std::min(send_len, recv_len));
+    }
+    return true;
+  }
+  // Frame headers first (blocking, tiny), then pump payloads full-duplex so
+  // a ring of simultaneous large sends can't deadlock on socket buffers.
+  char shdr[12];
+  uint64_t slen = send_len;
+  std::memcpy(shdr, &kTagRing, 4);
+  std::memcpy(shdr + 4, &slen, 8);
+  if (!ring_next_.SendAll(shdr, 12)) return false;
+  char rhdr[12];
+  if (!ring_prev_.RecvAll(rhdr, 12)) return false;
+  uint32_t rtag;
+  uint64_t rlen;
+  std::memcpy(&rtag, rhdr, 4);
+  std::memcpy(&rlen, rhdr + 4, 8);
+  if (rtag != kTagRing || rlen != recv_len) {
+    LOG(ERROR) << "ring exchange mismatch: tag " << rtag << " len " << rlen
+               << " expected " << recv_len;
+    return false;
+  }
+
+  const char* sp = static_cast<const char*>(send_buf);
+  char* rp = static_cast<char*>(recv_buf);
+  std::size_t sent = 0, received = 0;
+  while (sent < send_len || received < recv_len) {
+    struct pollfd pfds[2];
+    int n = 0;
+    int send_idx = -1, recv_idx = -1;
+    if (sent < send_len) {
+      pfds[n] = {ring_next_.fd(), POLLOUT, 0};
+      send_idx = n++;
+    }
+    if (received < recv_len) {
+      pfds[n] = {ring_prev_.fd(), POLLIN, 0};
+      recv_idx = n++;
+    }
+    if (::poll(pfds, n, 60000) <= 0) {
+      LOG(ERROR) << "ring exchange poll timeout/error";
+      return false;
+    }
+    if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR))) {
+      ssize_t w = ::send(ring_next_.fd(), sp + sent, send_len - sent,
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        return false;
+      }
+      if (w > 0) sent += static_cast<std::size_t>(w);
+    }
+    if (recv_idx >= 0 && (pfds[recv_idx].revents & (POLLIN | POLLERR))) {
+      ssize_t r = ::recv(ring_prev_.fd(), rp + received, recv_len - received,
+                         MSG_DONTWAIT);
+      if (r == 0) return false;
+      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        return false;
+      }
+      if (r > 0) received += static_cast<std::size_t>(r);
+    }
+  }
+  return true;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,77 @@
+// Owns the process's host-network communicator state: the listener, the
+// control star (worker <-> rank 0) and the data ring (rank i <-> i+1 mod N),
+// plus rank/local/cross topology read from launcher-injected env.
+//
+// Role parity with /root/reference horovod/common/mpi/mpi_context.{h,cc} and
+// gloo/gloo_context.{h,cc} (communicator ownership + rendezvous); transport
+// here is plain TCP with launcher-assigned ports:
+//   HVD_TPU_RANK / HVD_TPU_SIZE / HVD_TPU_LOCAL_RANK / HVD_TPU_LOCAL_SIZE /
+//   HVD_TPU_CROSS_RANK / HVD_TPU_CROSS_SIZE
+//   HVD_TPU_ADDRS = host:port per rank, comma-separated, index == rank.
+#ifndef HVD_TPU_TCP_CONTEXT_H
+#define HVD_TPU_TCP_CONTEXT_H
+
+#include <atomic>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "net.h"
+
+namespace hvdtpu {
+
+class TcpContext {
+ public:
+  // Reads env, opens the listener, and builds the star + ring connections.
+  // Blocking; returns false on rendezvous failure.
+  bool Initialize();
+  void Finalize();
+  bool initialized() const { return initialized_; }
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+  int local_rank() const { return local_rank_; }
+  int local_size() const { return local_size_; }
+  int cross_rank() const { return cross_rank_; }
+  int cross_size() const { return cross_size_; }
+
+  // --- control star (coordinator protocol) ---
+  // Worker sends its blob to rank 0; rank 0 fills all[r] for r=1..N-1.
+  bool GatherBlobs(const std::string& mine, std::vector<std::string>* all);
+  bool BroadcastBlob(std::string* blob);
+  // Elementwise bitwise AND / OR across ranks (fixed-size u64 vectors).
+  bool BitwiseSync(std::vector<uint64_t>& bits, bool is_or);
+  bool Barrier();
+
+  // Bulk point-to-point on the control star (workers may only address rank
+  // 0; rank 0 may address anyone). Used by broadcast; safe because ops run
+  // lockstep on the single coordination thread.
+  bool StarSend(int peer, const void* data, std::size_t len);
+  bool StarRecv(int peer, void* buf, std::size_t len);
+
+  // --- data ring (collective ops) ---
+  // Full-duplex neighbor exchange: sends send_len bytes to rank+1 while
+  // receiving recv_len bytes from rank-1, pumping both directions so large
+  // transfers can't deadlock on full socket buffers.
+  bool RingExchange(const void* send_buf, std::size_t send_len, void* recv_buf,
+                    std::size_t recv_len);
+
+ private:
+  int rank_ = 0;
+  int size_ = 1;
+  int local_rank_ = 0;
+  int local_size_ = 1;
+  int cross_rank_ = 0;
+  int cross_size_ = 1;
+  bool initialized_ = false;
+
+  Listener listener_;
+  // Rank 0: control_conns_[r] for r=1..N-1; workers: control_conns_[0].
+  std::vector<Conn> control_conns_;
+  Conn ring_next_;  // connected to (rank+1) % size
+  Conn ring_prev_;  // accepted from (rank-1+size) % size
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_TCP_CONTEXT_H

@@ -1,0 +1,82 @@
+#include "tcp_controller.h"
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+void TcpController::Initialize() {
+  rank_ = tcp_context_.rank();
+  size_ = tcp_context_.size();
+  local_rank_ = tcp_context_.local_rank();
+  local_size_ = tcp_context_.local_size();
+  cross_rank_ = tcp_context_.cross_rank();
+  cross_size_ = tcp_context_.cross_size();
+
+  // Gather every rank's local_size to detect heterogeneous placements
+  // (affects hierarchical op eligibility, mirroring the reference's
+  // homogeneity check in mpi_controller.cc:25-81).
+  std::string mine = std::to_string(local_size_);
+  std::vector<std::string> all;
+  if (is_coordinator()) {
+    tcp_context_.GatherBlobs(mine, &all);
+    all[0] = mine;
+    std::string packed;
+    for (auto& s : all) {
+      packed += s;
+      packed.push_back(',');
+    }
+    tcp_context_.BroadcastBlob(&packed);
+    local_sizes_.clear();
+    for (auto& s : SplitString(packed, ',')) {
+      if (!s.empty()) local_sizes_.push_back(std::atoi(s.c_str()));
+    }
+  } else {
+    tcp_context_.GatherBlobs(mine, nullptr);
+    std::string packed;
+    tcp_context_.BroadcastBlob(&packed);
+    local_sizes_.clear();
+    for (auto& s : SplitString(packed, ',')) {
+      if (!s.empty()) local_sizes_.push_back(std::atoi(s.c_str()));
+    }
+  }
+  is_homogeneous_ = true;
+  for (int ls : local_sizes_) {
+    if (ls != local_size_) is_homogeneous_ = false;
+  }
+  LOG(DEBUG) << "TcpController initialized: rank " << rank_ << " size "
+             << size_ << " local " << local_rank_ << "/" << local_size_
+             << " cross " << cross_rank_ << "/" << cross_size_;
+}
+
+void TcpController::GatherBlobs(const std::string& mine,
+                                std::vector<std::string>* all) {
+  if (!tcp_context_.GatherBlobs(mine, all)) {
+    LOG(FATAL) << "control-plane gather failed";
+  }
+}
+
+void TcpController::BroadcastBlob(std::string* blob) {
+  if (!tcp_context_.BroadcastBlob(blob)) {
+    LOG(FATAL) << "control-plane broadcast failed";
+  }
+}
+
+void TcpController::CrossRankBitwiseAnd(std::vector<uint64_t>& bits) {
+  if (!tcp_context_.BitwiseSync(bits, /*is_or=*/false)) {
+    LOG(FATAL) << "bitwise AND sync failed";
+  }
+}
+
+void TcpController::CrossRankBitwiseOr(std::vector<uint64_t>& bits) {
+  if (!tcp_context_.BitwiseSync(bits, /*is_or=*/true)) {
+    LOG(FATAL) << "bitwise OR sync failed";
+  }
+}
+
+void TcpController::Barrier() {
+  if (!tcp_context_.Barrier()) {
+    LOG(FATAL) << "barrier failed";
+  }
+}
+
+}  // namespace hvdtpu
