@@ -9,11 +9,30 @@ use (reference analogue: the torch binding's handle manager,
 
 import ctypes
 import os
+import subprocess
+import threading
 
 import numpy as np
 
 _MOD_DIR = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_MOD_DIR, "..", "native", "libhorovod_tpu.so")
+_NATIVE_DIR = os.path.join(_MOD_DIR, "..", "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libhorovod_tpu.so")
+_build_lock = threading.Lock()
+
+
+def _ensure_built():
+    """Builds the native core on first use (the .so is not checked in)."""
+    with _build_lock:
+        if os.path.exists(_LIB_PATH):
+            return
+        try:
+            subprocess.run(["make", "-j", str(os.cpu_count() or 4)],
+                           cwd=_NATIVE_DIR, check=True,
+                           stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                "failed to build libhorovod_tpu.so:\n" +
+                e.stdout.decode("utf-8", "replace")) from e
 
 # DataType enum values must match native/message.h.
 _NUMPY_TO_DTYPE = {
@@ -54,6 +73,7 @@ class HorovodBasics:
     """Wraps the extern "C" API exported by the native core."""
 
     def __init__(self, lib_path=_LIB_PATH):
+        _ensure_built()
         self.lib = ctypes.CDLL(os.path.abspath(lib_path),
                                mode=ctypes.RTLD_GLOBAL)
         lib = self.lib

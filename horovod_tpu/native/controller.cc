@@ -246,12 +246,6 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
         }
       }
     }
-    if (stall_inspector_.ShouldPerformCheck()) {
-      if (stall_inspector_.CheckForStalledTensors(size_)) {
-        should_shut_down = true;
-      }
-      stall_inspector_.UpdateCheckTime();
-    }
     for (const auto& name : ready_names) {
       responses.push_back(ConstructResponse(name));
     }
@@ -309,15 +303,26 @@ ResponseList Controller::ComputeResponseList(
     cache_coordinator.set_uncached_in_queue(true);
     non_cached_messages.push_back(std::move(message));
   }
-  cache_coordinator.set_should_shut_down(this_process_requested_shutdown);
-
-  // Invalidate cached tensors that have been waiting on missing ranks.
-  if (cache_on && stall_inspector_.ShouldPerformCheck()) {
-    std::vector<uint32_t> stalled_bits;
-    stall_inspector_.InvalidateStalledCachedTensors(response_cache_,
-                                                    stalled_bits);
-    for (uint32_t bit : stalled_bits) cache_coordinator.record_invalid_bit(bit);
+  // Periodic stall inspection — must run every cycle type (stalls surface
+  // precisely when no negotiation is happening): warn about tensors waiting
+  // on missing ranks, invalidate stalled cached tensors so they renegotiate,
+  // and escalate to coordinated shutdown past the threshold.
+  if (stall_inspector_.ShouldPerformCheck()) {
+    if (cache_on) {
+      std::vector<uint32_t> stalled_bits;
+      stall_inspector_.InvalidateStalledCachedTensors(response_cache_,
+                                                      stalled_bits);
+      for (uint32_t bit : stalled_bits) {
+        cache_coordinator.record_invalid_bit(bit);
+      }
+    }
+    if (is_coordinator() &&
+        stall_inspector_.CheckForStalledTensors(size_)) {
+      this_process_requested_shutdown = true;
+    }
+    stall_inspector_.UpdateCheckTime();
   }
+  cache_coordinator.set_should_shut_down(this_process_requested_shutdown);
 
   bool should_shut_down = this_process_requested_shutdown;
   std::deque<Response> cached_responses;

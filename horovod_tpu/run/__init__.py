@@ -1,0 +1,2 @@
+from .run import main, run_command  # noqa: F401
+from .util import allocate_slots, parse_hostfile, parse_hosts  # noqa: F401

@@ -1,0 +1,220 @@
+"""``horovodrun_tpu`` — the launcher.
+
+Starts N copies of a training script with rank/local/cross topology and
+rendezvous env injected, the way the reference ``horovodrun`` does for its
+Gloo path (/root/reference horovod/run/run.py:379-508 + gloo_run.py:156-233):
+local slots via subprocess, remote slots via ssh, TPU pod slices via
+metadata auto-discovery. SIGINT/SIGTERM fan out to every launched process.
+
+Env injected per rank:
+  HVD_TPU_RANK / HVD_TPU_SIZE / HVD_TPU_LOCAL_RANK / HVD_TPU_LOCAL_SIZE /
+  HVD_TPU_CROSS_RANK / HVD_TPU_CROSS_SIZE / HVD_TPU_ADDRS
+"""
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+from . import util
+
+
+def check_build(out=sys.stdout):
+    """Prints the capability matrix (reference: run.py:262-298)."""
+    import horovod_tpu as hvd
+
+    def flag(v):
+        return "X" if v else " "
+
+    out.write("""\
+Horovod-TPU v%s:
+
+Available frameworks:
+    [%s] JAX
+    [%s] PyTorch
+    [%s] TensorFlow
+    [%s] Keras
+    [%s] MXNet
+
+Available controllers:
+    [X] TCP
+
+Available data planes:
+    [X] CPU (TCP ring)
+    [%s] XLA/ICI (in-jit)
+""" % (hvd.__version__,
+       flag(_importable("jax")), flag(_importable("torch")),
+       flag(_importable("tensorflow")),
+       flag(_importable("tensorflow") or _importable("keras")),
+       flag(_importable("mxnet")), flag(_importable("jax"))))
+
+
+def _importable(mod):
+    import importlib.util
+    return importlib.util.find_spec(mod) is not None
+
+
+def discover_tpu_pod():
+    """TPU pod-slice auto-discovery from TPU VM metadata env.
+
+    On TPU VMs, `TPU_WORKER_HOSTNAMES` lists every host in the slice and
+    `TPU_WORKER_ID` identifies this one; one worker process per host drives
+    all local chips through JAX. Returns a hosts string or None.
+    """
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if not hostnames:
+        return None
+    return ",".join("%s:1" % h for h in hostnames.split(","))
+
+
+def make_parser():
+    parser = argparse.ArgumentParser(
+        prog="horovodrun_tpu",
+        description="Launch a horovod_tpu distributed job.")
+    parser.add_argument("-np", "--num-proc", type=int, default=None,
+                        help="number of processes to launch")
+    parser.add_argument("-H", "--hosts", default=None,
+                        help='host slots, e.g. "localhost:4,host2:4"')
+    parser.add_argument("--hostfile", default=None,
+                        help='hostfile; lines "hostname slots=N"')
+    parser.add_argument("--tpu-pod", action="store_true",
+                        help="auto-discover hosts from TPU pod metadata")
+    parser.add_argument("--start-port", type=int, default=0,
+                        help="base port for rendezvous (0 = auto for local)")
+    parser.add_argument("--ssh-port", type=int, default=None)
+    parser.add_argument("--start-timeout", type=int, default=60,
+                        help="seconds to wait for all ranks to connect")
+    parser.add_argument("--check-build", action="store_true")
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run, e.g. python train.py")
+    return parser
+
+
+def build_env(slot, addrs, base_env=None):
+    env = dict(base_env if base_env is not None else os.environ)
+    env.update({
+        "HVD_TPU_RANK": str(slot.rank),
+        "HVD_TPU_SIZE": str(slot.size),
+        "HVD_TPU_LOCAL_RANK": str(slot.local_rank),
+        "HVD_TPU_LOCAL_SIZE": str(slot.local_size),
+        "HVD_TPU_CROSS_RANK": str(slot.cross_rank),
+        "HVD_TPU_CROSS_SIZE": str(slot.cross_size),
+        "HVD_TPU_ADDRS": ",".join(addrs),
+    })
+    return env
+
+
+def launch(slots, addrs, command, ssh_port=None, verbose=False, env=None):
+    """Launches one process per slot; returns the list of Popens."""
+    procs = []
+    for slot in slots:
+        rank_env = build_env(slot, addrs, env)
+        if util.is_local_host(slot.hostname):
+            if verbose:
+                sys.stderr.write("[launcher] rank %d local: %s\n" %
+                                 (slot.rank, " ".join(command)))
+            procs.append(subprocess.Popen(command, env=rank_env,
+                                          start_new_session=True))
+        else:
+            # Remote launch over ssh with explicit env exports.
+            exports = " ".join(
+                "%s=%s" % (k, shlex.quote(v))
+                for k, v in rank_env.items()
+                if k.startswith("HVD_TPU_") or k in ("PYTHONPATH", "PATH"))
+            ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+            if ssh_port:
+                ssh_cmd += ["-p", str(ssh_port)]
+            remote = "cd %s && env %s %s" % (
+                shlex.quote(os.getcwd()), exports,
+                " ".join(shlex.quote(c) for c in command))
+            if verbose:
+                sys.stderr.write("[launcher] rank %d ssh %s\n" %
+                                 (slot.rank, slot.hostname))
+            procs.append(subprocess.Popen(ssh_cmd + [slot.hostname, remote],
+                                          start_new_session=True))
+    return procs
+
+
+def run_command(np, hosts, command, start_port=0, ssh_port=None,
+                start_timeout=60, verbose=False, env=None):
+    """Programmatic entry: launch and wait; returns max exit code."""
+    host_list = util.parse_hosts(hosts) if isinstance(hosts, str) else hosts
+    slots = util.allocate_slots(host_list, np)
+
+    all_local = all(util.is_local_host(s.hostname) for s in slots)
+    if start_port:
+        ports = [start_port + i for i in range(np)]
+    elif all_local:
+        ports = util.find_free_ports(np)
+    else:
+        ports = [29500 + i for i in range(np)]
+    addrs = ["%s:%d" % (slot.hostname if not util.is_local_host(slot.hostname)
+                        else "127.0.0.1", port)
+             for slot, port in zip(slots, ports)]
+
+    base_env = dict(env if env is not None else os.environ)
+    base_env.setdefault("HVD_TPU_START_TIMEOUT", str(start_timeout))
+    procs = launch(slots, addrs, command, ssh_port=ssh_port, verbose=verbose,
+                   env=base_env)
+
+    def kill_all(signum, frame):
+        for p in procs:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        sys.exit(1)
+
+    old_int = signal.signal(signal.SIGINT, kill_all)
+    old_term = signal.signal(signal.SIGTERM, kill_all)
+    try:
+        exit_code = 0
+        for p in procs:
+            rc = p.wait()
+            if rc != 0:
+                exit_code = max(exit_code, rc if rc > 0 else 1)
+                # One failed rank: tear down the rest (they would hang in
+                # negotiation otherwise).
+                for q in procs:
+                    if q.poll() is None:
+                        try:
+                            os.killpg(os.getpgid(q.pid), signal.SIGTERM)
+                        except (ProcessLookupError, PermissionError):
+                            pass
+        return exit_code
+    finally:
+        signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGTERM, old_term)
+
+
+def main(argv=None):
+    parser = make_parser()
+    args = parser.parse_args(argv)
+    if args.check_build:
+        check_build()
+        return 0
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given")
+    if args.tpu_pod:
+        hosts = discover_tpu_pod()
+        if hosts is None:
+            parser.error("--tpu-pod given but no TPU pod metadata found")
+        if args.num_proc is None:
+            args.num_proc = len(util.parse_hosts(hosts))
+    else:
+        hosts = args.hosts or "localhost:%d" % (args.num_proc or 1)
+    if args.num_proc is None:
+        parser.error("-np is required")
+    return run_command(args.num_proc, hosts, command,
+                       start_port=args.start_port, ssh_port=args.ssh_port,
+                       start_timeout=args.start_timeout, verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""Launcher utilities: host parsing, slot allocation, free ports.
+
+Capability parity with the reference launcher internals
+(``horovod/run/run.py:384-398`` host parsing and
+``horovod/run/gloo_run.py:51-109`` slot allocation); fresh implementation.
+"""
+
+import collections
+import socket
+
+HostInfo = collections.namedtuple("HostInfo", ["hostname", "slots"])
+
+SlotInfo = collections.namedtuple(
+    "SlotInfo",
+    ["hostname", "rank", "local_rank", "cross_rank", "size", "local_size",
+     "cross_size"])
+
+
+def parse_hosts(hosts_string):
+    """Parses "host1:2,host2:2" into HostInfo list ("host" implies 1 slot)."""
+    hosts = []
+    for part in hosts_string.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, slots = part.rsplit(":", 1)
+            hosts.append(HostInfo(name, int(slots)))
+        else:
+            hosts.append(HostInfo(part, 1))
+    return hosts
+
+
+def parse_hostfile(path):
+    """Hostfile lines: "hostname slots=N" (or just "hostname")."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            fields = line.split()
+            slots = 1
+            for field in fields[1:]:
+                if field.startswith("slots="):
+                    slots = int(field[len("slots="):])
+            hosts.append(HostInfo(fields[0], slots))
+    return hosts
+
+
+def allocate_slots(hosts, np):
+    """Assigns np ranks to host slots in order; computes local/cross ranks.
+
+    Mirrors the reference allocation semantics (gloo_run.py:51-109): ranks
+    fill hosts in order, local_rank counts within a host, cross_rank indexes
+    the host among hosts that have a slot at that local_rank.
+    """
+    total_slots = sum(h.slots for h in hosts)
+    if np > total_slots:
+        raise ValueError(
+            "requested %d processes but only %d slots available" %
+            (np, total_slots))
+    slots = []
+    rank = 0
+    host_idx_assigned = []  # (host_index, local_rank) per rank
+    local_sizes = collections.defaultdict(int)
+    for hi, host in enumerate(hosts):
+        for local_rank in range(host.slots):
+            if rank >= np:
+                break
+            host_idx_assigned.append((hi, local_rank, host.hostname))
+            local_sizes[hi] += 1
+            rank += 1
+    # cross structures: for a given local_rank, ranks across hosts.
+    cross_groups = collections.defaultdict(list)  # local_rank -> [host_index]
+    for hi, local_rank, _ in host_idx_assigned:
+        if hi not in cross_groups[local_rank]:
+            cross_groups[local_rank].append(hi)
+    for rank, (hi, local_rank, hostname) in enumerate(host_idx_assigned):
+        cross_ranks = cross_groups[local_rank]
+        slots.append(SlotInfo(
+            hostname=hostname,
+            rank=rank,
+            local_rank=local_rank,
+            cross_rank=cross_ranks.index(hi),
+            size=np,
+            local_size=local_sizes[hi],
+            cross_size=len(cross_ranks),
+        ))
+    return slots
+
+
+def find_free_ports(count, host="127.0.0.1"):
+    """Reserves `count` distinct free TCP ports (bind-then-release)."""
+    socks = []
+    ports = []
+    try:
+        for _ in range(count):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((host, 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def is_local_host(hostname):
+    return hostname in ("localhost", "127.0.0.1", socket.gethostname())

@@ -1,0 +1,36 @@
+"""Test config: provide 8 virtual CPU devices so multi-device sharding paths
+compile and run without TPU hardware (the driver separately dry-runs the
+multi-chip path). If a TPU plugin is present it may still register; tests
+use `cpu_devices()` / the `cpu_mesh` fixture to target the CPU backend
+explicitly."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = os.environ.get("HVD_TPU_TEST_PLATFORM", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def cpu_devices():
+    import jax
+    return jax.devices("cpu")
+
+
+@pytest.fixture
+def cpu_mesh_1d():
+    """8-device mesh over axis 'hvd' on the CPU backend."""
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices("cpu")), ("hvd",))

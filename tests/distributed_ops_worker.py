@@ -1,0 +1,171 @@
+"""Self-verifying distributed collective matrix, run under the launcher with
+N >= 2 ranks. Mirrors the reference's test strategy (test/test_tensorflow.py
+/ test_torch.py): real multi-process collectives on localhost, rank-aware
+assertions, size-dependent fp tolerance, error-case checks.
+
+Run: python -m horovod_tpu.run.run -np 2 -- python tests/distributed_ops_worker.py
+"""
+
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common.ops import HorovodInternalError
+
+
+def tolerance(dtype, n):
+    if dtype == np.float16:
+        return 1e-2 * n
+    if dtype in (np.float32,):
+        return 1e-5 * n
+    if dtype == np.float64:
+        return 1e-10 * n
+    return 0
+
+
+def test_allreduce_matrix(r, n):
+    dtypes = [np.uint8, np.int8, np.int32, np.int64, np.float16, np.float32,
+              np.float64]
+    rng = np.random.RandomState(1234)
+    for dtype in dtypes:
+        for ndim in range(1, 4):
+            shape = (5,) * ndim
+            # Identical pseudo-random base on every rank, offset by rank.
+            base = rng.uniform(-50, 50, size=shape)
+            x = (base + r).astype(dtype)
+            result = hvd.allreduce(x, "ar.%s.%d" % (np.dtype(dtype).name,
+                                                    ndim))
+            # Accumulate in the same dtype so integer wraparound matches.
+            expected = np.zeros(shape, dtype=dtype)
+            for rr in range(n):
+                expected = expected + (base + rr).astype(dtype)
+            expected = expected.astype(np.float64)
+            got = result.astype(np.float64)
+            tol = tolerance(dtype, n) * np.abs(expected).max() + 1e-6
+            assert np.allclose(got, expected, atol=max(tol, 1e-6)), (
+                dtype, ndim, got, expected)
+
+
+def test_allreduce_average(r, n):
+    x = np.arange(20, dtype=np.float32) + r
+    result = hvd.allreduce(x, "avg", average=True)
+    expected = np.arange(20, dtype=np.float32) + (n - 1) / 2.0
+    assert np.allclose(result, expected, atol=1e-5), (result, expected)
+
+
+def test_allreduce_bool(r, n):
+    x = np.array([r == 0, True, False])
+    result = hvd.allreduce(x, "bool")
+    assert result.dtype == np.bool_
+    assert list(result) == [True, True, False], result
+
+
+def test_fusion(r, n):
+    handles = [hvd.allreduce_async(np.full(4, i + r, dtype=np.float32),
+                                   "fuse.%d" % i) for i in range(64)]
+    for i, h in enumerate(handles):
+        out = hvd.synchronize(h)
+        exp = sum(i + rr for rr in range(n))
+        assert np.allclose(out, exp), (i, out, exp)
+
+
+def test_allgather_variable(r, n):
+    x = np.full((r + 2, 3), r, dtype=np.int32)
+    result = hvd.allgather(x, "ag_var")
+    assert result.shape == (sum(rr + 2 for rr in range(n)), 3)
+    off = 0
+    for rr in range(n):
+        block = result[off:off + rr + 2]
+        assert np.all(block == rr), (rr, block)
+        off += rr + 2
+
+
+def test_allgather_dtypes(r, n):
+    for dtype in (np.uint8, np.int64, np.float16, np.float64):
+        x = np.full((2, 2), r, dtype=dtype)
+        result = hvd.allgather(x, "ag.%s" % np.dtype(dtype).name)
+        assert result.shape == (2 * n, 2)
+        for rr in range(n):
+            assert np.all(result[2 * rr:2 * rr + 2].astype(np.int64) == rr)
+
+
+def test_broadcast(r, n):
+    for root in range(n):
+        for dtype in (np.int32, np.float32, np.float64):
+            x = np.full((3, 3), r + 1, dtype=dtype)
+            result = hvd.broadcast(x, root, "bc.%d.%s" %
+                                   (root, np.dtype(dtype).name))
+            assert np.all(result == root + 1), (root, result)
+
+
+def test_error_mismatched_shape(r, n):
+    x = np.zeros(3 + r, dtype=np.float32)  # different shape per rank
+    try:
+        hvd.allreduce(x, "mismatch_shape")
+    except HorovodInternalError as e:
+        assert "Mismatched" in str(e), e
+    else:
+        raise AssertionError("expected shape-mismatch error")
+
+
+def test_error_mismatched_dtype(r, n):
+    x = np.zeros(4, dtype=np.float32 if r == 0 else np.float64)
+    try:
+        hvd.allreduce(x, "mismatch_dtype")
+    except HorovodInternalError as e:
+        assert "Mismatched" in str(e), e
+    else:
+        raise AssertionError("expected dtype-mismatch error")
+
+
+def test_error_mismatched_root(r, n):
+    x = np.zeros(4, dtype=np.float32)
+    try:
+        hvd.broadcast(x, r % n, "mismatch_root")  # different root per rank
+    except HorovodInternalError as e:
+        assert "root" in str(e), e
+    else:
+        raise AssertionError("expected root-mismatch error")
+
+
+def test_duplicate_name(r, n):
+    h1 = hvd.allreduce_async(np.zeros(4, dtype=np.float32), "dup")
+    try:
+        h2 = hvd.allreduce_async(np.zeros(4, dtype=np.float32), "dup")
+        try:
+            hvd.synchronize(h2)
+        except HorovodInternalError:
+            pass
+        else:
+            raise AssertionError("expected duplicate-name error")
+    finally:
+        hvd.synchronize(h1)
+
+
+def test_cache_steady_state(r, n):
+    # Same names over many iterations: second-and-later cycles should ride
+    # the response-cache fast path; correctness must be identical.
+    for it in range(30):
+        x = np.full(8, it * (r + 1), dtype=np.float32)
+        out = hvd.allreduce(x, "steady")
+        exp = it * sum(rr + 1 for rr in range(n))
+        assert np.allclose(out, exp), (it, out, exp)
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n >= 2, "run under the launcher with -np >= 2"
+    tests = [v for k, v in sorted(globals().items())
+             if k.startswith("test_")]
+    for t in tests:
+        t(r, n)
+        if r == 0:
+            print("PASS %s" % t.__name__)
+    print("rank %d: all distributed op tests passed" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,21 @@
+"""Single-process short-circuit checks (size == 1 fast paths)."""
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    assert hvd.size() == 1 and hvd.rank() == 0
+    assert hvd.local_rank() == 0 and hvd.cross_rank() == 0
+    x = np.arange(6, dtype=np.float32)
+    assert np.allclose(hvd.allreduce(x, "x"), x)
+    assert np.allclose(hvd.allreduce(x, "xa", average=True), x)
+    assert np.allclose(hvd.allgather(x.reshape(2, 3), "g"), x.reshape(2, 3))
+    assert np.allclose(hvd.broadcast(x, 0, "b"), x)
+    print("single-process OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Stall-inspector e2e (reference analogue: test/test_stall.py): ranks != 0
+delay their second allreduce past the stall-shutdown threshold; the
+coordinator must warn (listing missing ranks) and then trigger a coordinated
+shutdown rather than deadlock. Run with HVD_TPU_STALL_CHECK_TIME_SECONDS=2
+and HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS=5."""
+
+import signal
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common.ops import HorovodInternalError
+
+
+def alarm(signum, frame):
+    sys.stderr.write("watchdog fired: job deadlocked\n")
+    sys.exit(3)
+
+
+signal.signal(signal.SIGALRM, alarm)
+signal.alarm(45)
+
+hvd.init()
+r = hvd.rank()
+hvd.allreduce(np.ones(4, dtype=np.float32), "warmup")
+if r != 0:
+    time.sleep(10)
+try:
+    hvd.allreduce(np.ones(4, dtype=np.float32), "stalled")
+except HorovodInternalError:
+    pass
+print("rank %d exited cleanly" % r)

@@ -1,0 +1,119 @@
+"""JAX binding tests: host-path collectives (size-1 short circuit), the
+in-jit psum plane over a shard_map'd mesh, and the optax
+DistributedOptimizer in both planes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+import horovod_tpu.jax as hvd
+
+
+@pytest.fixture(scope="module", autouse=True)
+def init_hvd():
+    hvd.init()
+    yield
+
+
+def test_rank_size():
+    assert hvd.size() == 1
+    assert hvd.rank() == 0
+
+
+def test_host_allreduce():
+    x = jnp.arange(10, dtype=jnp.float32)
+    out = hvd.allreduce(x, average=False)
+    assert np.allclose(out, x)
+    out = hvd.allreduce(x, average=True)
+    assert np.allclose(out, x)
+
+
+def test_host_allgather_broadcast():
+    x = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+    assert np.allclose(hvd.allgather(x), x)
+    assert np.allclose(hvd.broadcast(x, 0), x)
+
+
+def test_compression_fp16_roundtrip():
+    x = jnp.arange(8, dtype=jnp.float32)
+    out = hvd.allreduce(x, average=False, compression=hvd.Compression.fp16)
+    assert out.dtype == jnp.float32
+    assert np.allclose(out, x, atol=1e-2)
+
+
+def test_injit_psum_plane():
+    devices = jax.devices("cpu")
+    assert len(devices) == 8, "conftest should provide 8 virtual devices"
+    mesh = Mesh(np.array(devices), (hvd.AXIS_NAME,))
+
+    def step(x):
+        return hvd.allreduce(x, average=True)
+
+    f = shard_map(step, mesh=mesh, in_specs=P(hvd.AXIS_NAME),
+                  out_specs=P(hvd.AXIS_NAME))
+    x = jnp.arange(16, dtype=jnp.float32).reshape(8, 2)
+    out = jax.jit(f)(x)
+    # Average over the mapped axis: every row becomes the column mean
+    # broadcast back to its shard.
+    expected_mean = x.reshape(8, 2).mean(axis=0)
+    assert np.allclose(out, jnp.tile(expected_mean, (8, 1)))
+
+
+def test_injit_allgather():
+    devices = jax.devices("cpu")
+    mesh = Mesh(np.array(devices), (hvd.AXIS_NAME,))
+    f = shard_map(lambda x: hvd.allgather(x), mesh=mesh,
+                  in_specs=P(hvd.AXIS_NAME), out_specs=P(),
+                  check_rep=False)
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+    out = jax.jit(f)(x)
+    assert out.shape == (8, 1)
+    assert np.allclose(out.ravel(), np.arange(8))
+
+
+def test_distributed_optimizer_host():
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1))
+    params = {"w": jnp.ones(4), "b": jnp.zeros(2)}
+    state = opt.init(params)
+    grads = {"w": jnp.full(4, 2.0), "b": jnp.ones(2)}
+    updates, state = opt.update(grads, state, params)
+    new_params = optax.apply_updates(params, updates)
+    assert np.allclose(new_params["w"], 1.0 - 0.1 * 2.0)
+    assert np.allclose(new_params["b"], -0.1)
+
+
+def test_distributed_optimizer_injit():
+    devices = jax.devices("cpu")
+    mesh = Mesh(np.array(devices), (hvd.AXIS_NAME,))
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1))
+    params = jnp.ones(4)
+    state = opt.init(params)
+
+    def step(params, state, grads):
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state
+
+    f = shard_map(step, mesh=mesh,
+                  in_specs=(P(), P(), P(hvd.AXIS_NAME)),
+                  out_specs=(P(), P()))
+    # Per-device gradients 0..7 -> average 3.5.
+    grads = jnp.arange(8, dtype=jnp.float32).reshape(8, 1) * jnp.ones((8, 4))
+    grads = grads.reshape(8, 4)
+    new_params, _ = jax.jit(f)(params, state, grads)
+    assert np.allclose(new_params, 1.0 - 0.1 * 3.5)
+
+
+def test_broadcast_parameters():
+    params = {"w": jnp.arange(4, dtype=jnp.float32),
+              "b": jnp.ones(2, dtype=jnp.bfloat16)}
+    out = hvd.broadcast_parameters(params, root_rank=0)
+    assert out["b"].dtype == jnp.bfloat16
+    assert np.allclose(out["w"], params["w"])
+
+
+def test_metric_average():
+    assert hvd.metric_average(3.5) == 3.5

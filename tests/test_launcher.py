@@ -1,0 +1,55 @@
+"""Launcher unit tests: host parsing, slot allocation (rank/local/cross
+topology), hostfile parsing. Reference analogue: the allocation logic of
+gloo_run.py:51-109."""
+
+import pytest
+
+from horovod_tpu.run import util
+
+
+def test_parse_hosts():
+    hosts = util.parse_hosts("a:2,b:3,c")
+    assert [(h.hostname, h.slots) for h in hosts] == [("a", 2), ("b", 3),
+                                                      ("c", 1)]
+
+
+def test_parse_hostfile(tmp_path):
+    f = tmp_path / "hostfile"
+    f.write_text("hosta slots=2\n# comment\nhostb slots=4\nhostc\n")
+    hosts = util.parse_hostfile(str(f))
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("hosta", 2), ("hostb", 4), ("hostc", 1)]
+
+
+def test_allocate_slots_single_host():
+    slots = util.allocate_slots(util.parse_hosts("localhost:4"), 4)
+    assert [s.rank for s in slots] == [0, 1, 2, 3]
+    assert [s.local_rank for s in slots] == [0, 1, 2, 3]
+    assert all(s.local_size == 4 for s in slots)
+    assert all(s.cross_size == 1 for s in slots)
+    assert all(s.cross_rank == 0 for s in slots)
+
+
+def test_allocate_slots_two_hosts():
+    slots = util.allocate_slots(util.parse_hosts("a:2,b:2"), 4)
+    assert [(s.hostname, s.rank, s.local_rank, s.cross_rank) for s in slots] \
+        == [("a", 0, 0, 0), ("a", 1, 1, 0), ("b", 2, 0, 1), ("b", 3, 1, 1)]
+    assert all(s.local_size == 2 and s.cross_size == 2 for s in slots)
+
+
+def test_allocate_heterogeneous():
+    slots = util.allocate_slots(util.parse_hosts("a:1,b:2"), 3)
+    assert [(s.hostname, s.local_rank, s.local_size) for s in slots] == [
+        ("a", 0, 1), ("b", 0, 2), ("b", 1, 2)]
+    # local_rank 1 exists only on b.
+    assert slots[2].cross_size == 1 and slots[2].cross_rank == 0
+
+
+def test_allocate_too_many():
+    with pytest.raises(ValueError):
+        util.allocate_slots(util.parse_hosts("a:1"), 2)
+
+
+def test_find_free_ports_distinct():
+    ports = util.find_free_ports(4)
+    assert len(set(ports)) == 4

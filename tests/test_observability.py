@@ -1,0 +1,56 @@
+"""Timeline + stall-inspector e2e tests (reference analogues:
+test/test_timeline.py, test/test_stall.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def run_launcher(np_, script, extra_env=None, timeout=120):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run.run", "-np", str(np_), "--",
+         sys.executable, os.path.join(HERE, script)],
+        env=env, timeout=timeout, capture_output=True, text=True)
+
+
+def test_timeline(tmp_path):
+    timeline_file = str(tmp_path / "timeline.json")
+    proc = run_launcher(2, "timeline_worker.py", extra_env={
+        "HVD_TPU_TIMELINE": timeline_file,
+        "HVD_TPU_TIMELINE_MARK_CYCLES": "1",
+    })
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(timeline_file) as f:
+        content = f.read()
+    assert "NEGOTIATE_ALLREDUCE" in content
+    assert "ALLREDUCE" in content
+    assert "NEGOTIATE_ALLGATHER" in content
+    assert "CYCLE_START" in content
+    # Every emitted record must be valid JSON (file is a trailing-comma
+    # chrome-tracing array; validate record-wise).
+    for line in content.splitlines():
+        line = line.strip().rstrip(",")
+        if line in ("[", "") or line.startswith("]"):
+            continue
+        json.loads(line)
+
+
+def test_stall_detection_and_shutdown():
+    proc = run_launcher(2, "stall_worker.py", extra_env={
+        "HVD_TPU_STALL_CHECK_TIME_SECONDS": "2",
+        "HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS": "5",
+    }, timeout=60)
+    out = proc.stdout + proc.stderr
+    assert "rank 0 exited cleanly" in out, out
+    assert "rank 1 exited cleanly" in out, out
+    # Coordinator must have warned about the missing rank.
+    assert "missing ranks: 1" in out, out

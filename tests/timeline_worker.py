@@ -1,0 +1,10 @@
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+for i in range(5):
+    hvd.allreduce(np.arange(16, dtype=np.float32), "tl")
+    hvd.allgather(np.arange(4, dtype=np.float32), "tl_ag.%d" % i)
+hvd.shutdown()
+print("rank done")
