@@ -1,0 +1,98 @@
+"""ResNet-50 synthetic benchmark — the TPU equivalent of the reference's
+`examples/tensorflow2_synthetic_benchmark.py:110-131` (batch 64/device,
+synthetic ImageNet-shaped data, warmup then timed rounds, images/sec).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+Baseline: the reference's only published absolute throughput is ResNet-101
+at 1656.82 images/sec over 16 Pascal P100s (`docs/benchmarks.rst:43`) =
+103.55 images/sec/GPU; `vs_baseline` is images/sec/chip over that number.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64,
+                    help="per-chip batch size (reference default 64)")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-warmup", type=int, default=3)
+    ap.add_argument("--num-rounds", type=int, default=5)
+    ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--model", default="resnet50",
+                    choices=["resnet50", "resnet101", "resnet152"])
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu import models
+    from horovod_tpu.parallel import data_parallel_mesh, make_train_step
+    from horovod_tpu.parallel.train import cross_entropy_loss
+
+    devices = jax.devices()
+    n = len(devices)
+    print("bench: %d device(s), platform=%s" % (n, devices[0].platform),
+          file=sys.stderr)
+
+    model_cls = {"resnet50": models.ResNet50, "resnet101": models.ResNet101,
+                 "resnet152": models.ResNet152}[args.model]
+    model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
+
+    rng = jax.random.PRNGKey(0)
+    s = args.image_size
+    variables = model.init(rng, jnp.zeros((1, s, s, 3)), train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(params, batch):
+        logits, _ = model.apply(
+            {"params": params, "batch_stats": batch_stats}, batch["x"],
+            train=True, mutable=["batch_stats"])
+        return cross_entropy_loss(logits, batch["y"])
+
+    mesh = data_parallel_mesh(devices=devices)
+    opt = optax.sgd(0.01, momentum=0.9)
+    step = make_train_step(loss_fn, opt, mesh, donate=True)
+
+    global_batch = args.batch_size * n
+    x = jax.random.normal(rng, (global_batch, s, s, 3), jnp.float32)
+    y = jax.random.randint(rng, (global_batch,), 0, 1000)
+    params_p, opt_state, batch = step.place(params, opt.init(params),
+                                            {"x": x, "y": y})
+
+    for _ in range(args.num_warmup):
+        params_p, opt_state, loss = step(params_p, opt_state, batch)
+    jax.block_until_ready(loss)
+
+    rates = []
+    for r in range(args.num_rounds):
+        t0 = time.perf_counter()
+        for _ in range(args.num_iters):
+            params_p, opt_state, loss = step(params_p, opt_state, batch)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        rates.append(global_batch * args.num_iters / dt)
+        print("round %d: %.1f img/sec total" % (r, rates[-1]),
+              file=sys.stderr)
+
+    total = float(np.mean(rates))
+    per_chip = total / n
+    baseline_per_gpu = 1656.82 / 16.0
+    print(json.dumps({
+        "metric": "%s_synthetic_images_per_sec_per_chip" % args.model,
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / baseline_per_gpu, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

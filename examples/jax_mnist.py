@@ -1,0 +1,90 @@
+"""Data-parallel MNIST CNN in JAX — the flagship framework's analogue of
+the reference `examples/tensorflow2_mnist.py` (BASELINE.json config #1).
+
+Run single process:          python examples/jax_mnist.py
+Run 2-process CPU cluster:   python -m horovod_tpu.run.run -np 2 -- \
+                                 python examples/jax_mnist.py
+On a TPU slice the same script trains over all local chips via the mesh.
+
+Uses a deterministic synthetic MNIST-shaped dataset (this environment has
+no network egress; swap `synthetic_mnist` for a real loader in practice).
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def synthetic_mnist(n=2048, seed=0):
+    """Deterministic class-separable 28x28 data (same on every rank)."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, size=n)
+    templates = rng.randn(10, 28, 28, 1).astype(np.float32)
+    x = templates[y] + 0.3 * rng.randn(n, 28, 28, 1).astype(np.float32)
+    return x, y.astype(np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64,
+                    help="per-process batch size")
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    import horovod_tpu.jax as hvd_jax
+    from horovod_tpu.models import MnistCNN
+
+    # Horovod-style: init, then scale LR by world size.
+    hvd.init()
+    rank, world = hvd.rank(), hvd.size()
+
+    model = MnistCNN(dtype=jnp.float32)
+    rng = jax.random.PRNGKey(42)
+    params = model.init(rng, jnp.zeros((1, 28, 28, 1)), train=False)["params"]
+    opt = hvd_jax.DistributedOptimizer(optax.sgd(args.lr * world))
+    opt_state = opt.init(params)
+
+    # Consistent start across ranks (reference: BroadcastGlobalVariables).
+    params = hvd_jax.broadcast_parameters(params, root_rank=0)
+
+    @jax.jit
+    def forward_loss(params, x, y):
+        logits = model.apply({"params": params}, x, train=True)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    grad_fn = jax.jit(jax.value_and_grad(forward_loss))
+
+    x, y = synthetic_mnist()
+    # Shard the dataset by rank (each rank sees a distinct slice).
+    x_local, y_local = x[rank::world], y[rank::world]
+    steps = len(x_local) // args.batch_size
+
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        total = 0.0
+        for s in range(steps):
+            lo = s * args.batch_size
+            xb = jnp.asarray(x_local[lo:lo + args.batch_size])
+            yb = jnp.asarray(y_local[lo:lo + args.batch_size])
+            loss, grads = grad_fn(params, xb, yb)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            total += float(loss)
+        avg = hvd_jax.metric_average(total / steps, "epoch_loss.%d" % epoch)
+        if rank == 0:
+            print("epoch %d: loss=%.4f (%.1fs)" %
+                  (epoch, avg, time.time() - t0))
+    if rank == 0:
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Data-parallel skip-gram word2vec — reference analogue:
+`examples/tensorflow_word2vec.py` (BASELINE.json config #4: exercises the
+allgather + broadcast paths through sparse embedding gradients).
+
+Run: python -m horovod_tpu.run.run -np 2 -- python examples/jax_word2vec.py
+Synthetic Zipf-distributed corpus (no network egress in this environment).
+"""
+
+import argparse
+
+import numpy as np
+
+
+def synthetic_corpus(vocab_size, n_tokens=100000, seed=0):
+    rng = np.random.RandomState(seed)
+    # Zipf-ish unigram distribution like natural text.
+    p = 1.0 / np.arange(1, vocab_size + 1)
+    p /= p.sum()
+    return rng.choice(vocab_size, size=n_tokens, p=p).astype(np.int32)
+
+
+def batches(corpus, batch_size, window, rng):
+    centers = rng.randint(window, len(corpus) - window, size=batch_size)
+    offsets = rng.randint(1, window + 1, size=batch_size) * \
+        rng.choice([-1, 1], size=batch_size)
+    return corpus[centers], corpus[centers + offsets]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab-size", type=int, default=5000)
+    ap.add_argument("--embedding-dim", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-neg", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.5)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    import horovod_tpu.jax as hvd_jax
+    from horovod_tpu.jax.sparse import allreduce_sparse, apply_sparse
+    from horovod_tpu.models import SkipGram
+
+    hvd.init()
+    rank, world = hvd.rank(), hvd.size()
+
+    model = SkipGram(vocab_size=args.vocab_size,
+                     embedding_dim=args.embedding_dim)
+    rng_np = np.random.RandomState(1234 + rank)  # distinct samples per rank
+    corpus = synthetic_corpus(args.vocab_size)
+
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1,), jnp.int32))["params"]
+    # Consistent init across ranks (broadcast path).
+    params = hvd_jax.broadcast_parameters(params, root_rank=0)
+
+    @jax.jit
+    def loss_and_grads(params, center, context, neg):
+        def loss_fn(p):
+            return model.apply({"params": p}, center, context, neg,
+                               method=SkipGram.nce_loss)
+        return jax.value_and_grad(loss_fn)(params)
+
+    for step in range(args.steps):
+        center, context = batches(corpus, args.batch_size, 2, rng_np)
+        neg = rng_np.randint(0, args.vocab_size,
+                             size=args.num_neg).astype(np.int32)
+        loss, grads = loss_and_grads(params, jnp.asarray(center),
+                                     jnp.asarray(context), jnp.asarray(neg))
+
+        # Embedding-table grads are sparse: only the touched rows are
+        # nonzero. Ship (indices, values) via the allgather path instead
+        # of densifying — the IndexedSlices analogue.
+        emb_grad = grads["embedding"]["embedding"]
+        touched = np.unique(center)
+        idx, vals = allreduce_sparse(
+            jnp.asarray(touched),
+            emb_grad[jnp.asarray(touched)],
+            name="w2v.emb.%d" % step, average=True)
+        new_emb = apply_sparse(params["embedding"]["embedding"],
+                               idx, vals, scale=-args.lr)
+        params["embedding"]["embedding"] = new_emb
+
+        # NCE weights/biases: dense allreduce like any other gradient.
+        for key in ("nce_weight", "nce_bias"):
+            g = hvd_jax.allreduce(grads[key], average=True,
+                                  name="w2v.%s.%d" % (key, step))
+            params[key] = params[key] - args.lr * g
+
+        if step % 50 == 0:
+            avg = hvd_jax.metric_average(float(loss), "w2v_loss.%d" % step)
+            if rank == 0:
+                print("step %d: loss=%.4f" % (step, avg))
+
+    if rank == 0:
+        nearest = model.apply({"params": params}, jnp.arange(3), 4,
+                              method=SkipGram.nearest)
+        print("nearest neighbours of tokens 0..2:", np.asarray(nearest))
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

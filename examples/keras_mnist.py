@@ -1,0 +1,74 @@
+"""Data-parallel Keras MNIST — reference analogue:
+`examples/keras_mnist.py` / `examples/tensorflow2_keras_mnist.py`:
+wrapped optimizer, broadcast + metric-average + LR-warmup callbacks,
+rank-0-only checkpointing.
+
+Run: python -m horovod_tpu.run.run -np 2 -- python examples/keras_mnist.py
+"""
+
+import argparse
+import os
+
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+import numpy as np
+
+
+def synthetic_mnist(n=1024, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, size=n)
+    templates = rng.randn(10, 28, 28, 1).astype(np.float32)
+    x = templates[y] + 0.3 * rng.randn(n, 28, 28, 1).astype(np.float32)
+    return x, y.astype(np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    import keras
+
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+    rank, world = hvd.rank(), hvd.size()
+
+    keras.utils.set_random_seed(42)
+    model = keras.Sequential([
+        keras.layers.Input((28, 28, 1)),
+        keras.layers.Conv2D(32, 3, activation="relu"),
+        keras.layers.Conv2D(64, 3, activation="relu"),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Flatten(),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.01 * world))
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(warmup_epochs=1),
+    ]
+    # Only rank 0 writes checkpoints (reference convention).
+    if rank == 0:
+        callbacks.append(keras.callbacks.ModelCheckpoint(
+            "/tmp/hvd_tpu_keras_mnist.keras"))
+
+    x, y = synthetic_mnist()
+    x_local, y_local = x[rank::world], y[rank::world]
+    model.fit(x_local, y_local, batch_size=args.batch_size,
+              epochs=args.epochs, callbacks=callbacks,
+              verbose=1 if rank == 0 else 0)
+    if rank == 0:
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

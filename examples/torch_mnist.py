@@ -1,0 +1,87 @@
+"""Data-parallel MNIST CNN in PyTorch — reference analogue:
+`examples/pytorch_mnist.py` (and the torch leg of BASELINE.json #3).
+
+Run: python -m horovod_tpu.run.run -np 2 -- python examples/torch_mnist.py
+Synthetic data (no network egress in this environment).
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 32, 3, 1)
+        self.conv2 = nn.Conv2d(32, 64, 3, 1)
+        self.fc1 = nn.Linear(9216, 128)
+        self.fc2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        x = F.relu(self.conv1(x))
+        x = F.max_pool2d(F.relu(self.conv2(x)), 2)
+        x = torch.flatten(x, 1)
+        x = F.relu(self.fc1(x))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def synthetic_mnist(n=1024, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, size=n)
+    templates = rng.randn(10, 1, 28, 28).astype(np.float32)
+    x = templates[y] + 0.3 * rng.randn(n, 1, 28, 28).astype(np.float32)
+    return torch.from_numpy(x), torch.from_numpy(y.astype(np.int64))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    hvd.init()
+    rank, world = hvd.rank(), hvd.size()
+    torch.manual_seed(42)
+
+    model = Net()
+    # Horovod recipe: scale LR by world size, wrap optimizer, broadcast
+    # initial state (reference: examples/pytorch_mnist.py).
+    optimizer = torch.optim.SGD(model.parameters(), lr=args.lr * world,
+                                momentum=0.5)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    x, y = synthetic_mnist()
+    x_local, y_local = x[rank::world], y[rank::world]
+    steps = len(x_local) // args.batch_size
+
+    model.train()
+    for epoch in range(args.epochs):
+        total = 0.0
+        for s in range(steps):
+            lo = s * args.batch_size
+            optimizer.zero_grad()
+            out = model(x_local[lo:lo + args.batch_size])
+            loss = F.nll_loss(out, y_local[lo:lo + args.batch_size])
+            loss.backward()
+            optimizer.step()
+            total += float(loss)
+        avg = hvd.allreduce(torch.tensor(total / steps), average=True,
+                            name="epoch_loss.%d" % epoch)
+        if rank == 0:
+            print("epoch %d: loss=%.4f" % (epoch, float(avg)))
+    if rank == 0:
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

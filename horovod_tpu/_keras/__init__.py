@@ -1,0 +1,68 @@
+"""Shared Keras implementation layer (reference:
+``horovod/_keras/__init__.py`` — the common code behind both the
+standalone-Keras and tf.keras public shells).
+
+Keras-3 era: optimizers expose ``apply_gradients`` and models expose
+numpy ``get_weights``/``set_weights``, so the collectives ride the
+framework-agnostic numpy core directly.
+"""
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def create_distributed_optimizer(keras, optimizer, name=None,
+                                 compression=None, average=True):
+    """Dynamically subclasses `optimizer` so apply_gradients first
+    allreduces gradients (reference: _keras/__init__.py:20-80)."""
+    base = optimizer.__class__
+
+    class _DistributedOptimizer(base):
+        _HVD_WRAPPED = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            import tensorflow as tf
+            from horovod_tpu import tensorflow as hvd_tf
+            grads_and_vars = list(grads_and_vars)
+            reduced = []
+            for i, (g, v) in enumerate(grads_and_vars):
+                if g is not None:
+                    comp = compression or hvd_tf.Compression.none
+                    g = hvd_tf.allreduce(
+                        g, average=average, name="keras_grad.%d" % i,
+                        compression=comp)
+                    g = tf.convert_to_tensor(g) if isinstance(
+                        g, tf.IndexedSlices) else g
+                reduced.append((g, v))
+            return super().apply_gradients(reduced, *args, **kwargs)
+
+    cls = type("Distributed%s" % base.__name__, (_DistributedOptimizer,),
+               {})
+    opt = cls.from_config(optimizer.get_config())
+    return opt
+
+
+def broadcast_model_weights(model, root_rank=0):
+    """Broadcasts model weights from root via the numpy core."""
+    weights = model.get_weights()
+    out = []
+    for i, w in enumerate(weights):
+        arr = np.ascontiguousarray(w)
+        out.append(np.asarray(hvd.broadcast(
+            arr, root_rank, "keras_bc.%d" % i)).reshape(w.shape))
+    model.set_weights(out)
+
+
+def average_metrics(logs, prefix="metric"):
+    """Allreduce-averages every scalar in a Keras `logs` dict (reference:
+    MetricAverageCallbackImpl, _keras/callbacks.py:46-84)."""
+    if not logs:
+        return logs
+    for key in sorted(logs):
+        value = logs[key]
+        if isinstance(value, (int, float, np.floating, np.integer)):
+            arr = np.asarray(float(value), dtype=np.float64)
+            logs[key] = float(hvd.allreduce(
+                arr, "%s.%s" % (prefix, key), average=True))
+    return logs

@@ -1,0 +1,165 @@
+"""Keras callback implementations (reference:
+``horovod/_keras/callbacks.py``): broadcast-on-start, metric averaging,
+LR warmup/schedule with momentum correction."""
+
+import horovod_tpu as hvd
+from . import average_metrics, broadcast_model_weights
+
+
+class BroadcastGlobalVariablesCallbackImpl:
+    """Broadcasts initial model (and optimizer) state from root at train
+    start so all ranks begin identical (reference: callbacks.py:20-43)."""
+
+    def __init__(self, backend, root_rank, *args):
+        super().__init__(*args)
+        self.backend = backend
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        broadcast_model_weights(self.model, self.root_rank)
+        if hasattr(self.model, "optimizer") and \
+                hasattr(self.model.optimizer, "variables"):
+            import numpy as np
+            for i, v in enumerate(self.model.optimizer.variables):
+                try:
+                    val = np.asarray(v)
+                except Exception:
+                    continue
+                if val.dtype.kind in "fiu" and val.size:
+                    out = np.asarray(hvd.broadcast(
+                        np.ascontiguousarray(val), self.root_rank,
+                        "keras_bc_opt.%d" % i)).reshape(val.shape)
+                    v.assign(out)
+        self.broadcast_done = True
+
+
+class MetricAverageCallbackImpl:
+    """Averages epoch-end metrics over ranks so rank-0 logging/checkpoint
+    decisions see global values (reference: callbacks.py:46-84)."""
+
+    def __init__(self, backend, *args):
+        super().__init__(*args)
+        self.backend = backend
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs:
+            average_metrics(logs, prefix="metric.e%d" % epoch)
+
+
+class LearningRateScheduleCallbackImpl:
+    """Multiplies the initial LR by `multiplier` (callable or const) over
+    [start_epoch, end_epoch) (reference: callbacks.py:87-145)."""
+
+    def __init__(self, backend, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True, momentum_correction=True, steps_per_epoch=None,
+                 *args):
+        super().__init__(*args)
+        self.backend = backend
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.initial_lr = None
+        self.restore_momentum = None
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def _lr_var(self):
+        return self.model.optimizer.learning_rate
+
+    def _get_lr(self):
+        lr = self._lr_var()
+        return float(lr.numpy()) if hasattr(lr, "numpy") else float(lr)
+
+    def _adjust(self, epoch):
+        if self.initial_lr is None:
+            self.initial_lr = self._get_lr()
+        within = epoch >= self.start_epoch and \
+            (self.end_epoch is None or epoch < self.end_epoch)
+        if not within:
+            return
+        old_lr = self._get_lr()
+        lr = self.initial_lr * self.multiplier(epoch)
+        opt = self.model.optimizer
+        if hasattr(opt.learning_rate, "assign"):
+            opt.learning_rate.assign(lr)
+        else:
+            opt.learning_rate = lr
+        # Momentum correction (Goyal et al.): when the LR changes, scale
+        # SGD momentum by new_lr/old_lr for the next step, then restore
+        # (reference: _keras/callbacks.py _adjust_learning_rate).
+        if self.momentum_correction and old_lr > 0 and \
+                hasattr(opt, "momentum") and isinstance(
+                    getattr(opt, "momentum", None), (int, float)):
+            if self.restore_momentum is None:
+                self.restore_momentum = float(opt.momentum)
+            opt.momentum = self.restore_momentum * lr / old_lr
+
+    def _restore_momentum_if_needed(self):
+        if self.restore_momentum is not None:
+            self.model.optimizer.momentum = self.restore_momentum
+            self.restore_momentum = None
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase:
+            self._adjust(epoch)
+
+    def on_batch_begin(self, batch, logs=None):
+        if not self.staircase:
+            if self.steps_per_epoch is None:
+                # Keras populates params['steps'] once fit() starts.
+                self.steps_per_epoch = (self.params or {}).get("steps")
+            if self.steps_per_epoch:
+                self._adjust(self.current_epoch +
+                             float(batch) / self.steps_per_epoch)
+            else:
+                # No step count available: fall back to per-epoch
+                # (staircase) adjustment rather than silently never
+                # warming up.
+                self._adjust(self.current_epoch)
+
+    def on_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None and self.initial_lr is not None:
+            lr = self.model.optimizer.learning_rate
+            logs["lr"] = float(lr.numpy()) if hasattr(lr, "numpy") \
+                else float(lr)
+
+
+class LearningRateWarmupCallbackImpl(LearningRateScheduleCallbackImpl):
+    """Gradual LR warmup from lr to lr*size over `warmup_epochs`
+    (reference: callbacks.py:148-185 — the Goyal et al. recipe)."""
+
+    def __init__(self, backend, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0, *args):
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+        def multiplier(epoch):
+            # epoch may be fractional (per-batch warmup).
+            if epoch >= self.warmup_epochs:
+                return hvd.size()
+            return 1.0 + (hvd.size() - 1.0) * epoch / self.warmup_epochs
+
+        super().__init__(backend, multiplier, start_epoch=0,
+                         end_epoch=self.warmup_epochs + 1, staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch, *args)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.warmup_epochs - 1 and self.verbose and \
+                hvd.rank() == 0 and self.initial_lr is not None:
+            print("\nEpoch %d: finished gradual learning rate warmup to "
+                  "%g." % (epoch + 1, self.initial_lr * hvd.size()))

@@ -8,6 +8,7 @@ use (reference analogue: the torch binding's handle manager,
 """
 
 import ctypes
+import fcntl
 import os
 import subprocess
 import threading
@@ -21,18 +22,30 @@ _build_lock = threading.Lock()
 
 
 def _ensure_built():
-    """Builds the native core on first use (the .so is not checked in)."""
+    """Builds the native core on first use (the .so is not checked in).
+
+    Launcher-spawned worker processes hit this concurrently on a fresh
+    checkout, so an inter-process flock serializes the build (the
+    threading.Lock only covers threads within one process)."""
     with _build_lock:
         if os.path.exists(_LIB_PATH):
             return
-        try:
-            subprocess.run(["make", "-j", str(os.cpu_count() or 4)],
-                           cwd=_NATIVE_DIR, check=True,
-                           stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        except subprocess.CalledProcessError as e:
-            raise RuntimeError(
-                "failed to build libhorovod_tpu.so:\n" +
-                e.stdout.decode("utf-8", "replace")) from e
+        lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
+        with open(lock_path, "w") as lock_file:
+            fcntl.flock(lock_file, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(_LIB_PATH):
+                    return
+                subprocess.run(["make", "-j", str(os.cpu_count() or 4)],
+                               cwd=_NATIVE_DIR, check=True,
+                               stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT)
+            except subprocess.CalledProcessError as e:
+                raise RuntimeError(
+                    "failed to build libhorovod_tpu.so:\n" +
+                    e.stdout.decode("utf-8", "replace")) from e
+            finally:
+                fcntl.flock(lock_file, fcntl.LOCK_UN)
 
 # DataType enum values must match native/message.h.
 _NUMPY_TO_DTYPE = {

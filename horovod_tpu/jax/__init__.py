@@ -54,6 +54,22 @@ def _axis_in_scope(axis_name):
         return False
 
 
+def _multi_process():
+    return _hvd.is_initialized() and _hvd.size() > 1
+
+
+def _host_callback(fn, tensor):
+    """Routes a traced tensor through the host core from inside jit.
+
+    ``ordered=True`` is required for deadlock freedom: every rank traces
+    the same program, so ordered callbacks enqueue collectives in the same
+    sequence on all ranks while each callback blocks on its completion.
+    """
+    from jax.experimental import io_callback
+    out_shape = jax.ShapeDtypeStruct(tensor.shape, tensor.dtype)
+    return io_callback(fn, out_shape, tensor, ordered=True)
+
+
 class Compression:
     """Gradient compression codecs (reference: tensorflow/compression.py)."""
 
@@ -96,17 +112,35 @@ def allreduce(tensor, average=True, name=None, axis_name=AXIS_NAME,
               postscale_factor=1.0):
     """Allreduce across ranks (and, in-jit, across the mapped axis)."""
     if _is_traced(tensor):
-        # XLA/ICI plane: psum over the mapped axis; XLA emits an AllReduce
-        # that rides the TPU interconnect.
-        compressed, ctx = compression.compress(tensor)
-        if prescale_factor != 1.0:
-            compressed = compressed * prescale_factor
-        summed = jax.lax.psum(compressed, axis_name)
-        if average:
-            summed = summed / jax.lax.psum(1, axis_name)
-        if postscale_factor != 1.0:
-            summed = summed * postscale_factor
-        return compression.decompress(summed, ctx)
+        if _axis_in_scope(axis_name):
+            # XLA/ICI plane: psum over the mapped axis; XLA emits an
+            # AllReduce that rides the TPU interconnect.
+            compressed, ctx = compression.compress(tensor)
+            if prescale_factor != 1.0:
+                compressed = compressed * prescale_factor
+            summed = jax.lax.psum(compressed, axis_name)
+            if average:
+                summed = summed / jax.lax.psum(1, axis_name)
+            if postscale_factor != 1.0:
+                summed = summed * postscale_factor
+            return compression.decompress(summed, ctx)
+        if _multi_process():
+            # Plain jit, no mapped axis: ride the host core via an ordered
+            # callback (the reference's "CPU op inside the graph" shape).
+            op_name = name or _auto_name("allreduce")
+
+            def _cb(arr):
+                return np.asarray(_ops.allreduce(
+                    np.asarray(arr), op_name, average=average,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor)).astype(arr.dtype)
+
+            compressed, ctx = compression.compress(tensor)
+            return compression.decompress(
+                _host_callback(_cb, compressed), ctx)
+        # Single process: allreduce is identity up to scaling.
+        scale = prescale_factor * postscale_factor
+        return tensor * scale if scale != 1.0 else tensor
     compressed, ctx = compression.compress(tensor)
     arr = np.asarray(compressed)
     out = _ops.allreduce(arr, name or _auto_name("allreduce"),
@@ -117,9 +151,28 @@ def allreduce(tensor, average=True, name=None, axis_name=AXIS_NAME,
 
 
 def allgather(tensor, name=None, axis_name=AXIS_NAME):
-    """Concatenates tensors from all ranks along dim 0."""
+    """Concatenates tensors from all ranks along dim 0.
+
+    In plain jit without a mapped axis, all ranks must pass equal shapes
+    (the host path outside jit supports unequal first dims, like the
+    reference's allgatherv)."""
     if _is_traced(tensor):
-        return jax.lax.all_gather(tensor, axis_name, tiled=True)
+        if _axis_in_scope(axis_name):
+            return jax.lax.all_gather(tensor, axis_name, tiled=True)
+        if _multi_process():
+            from jax.experimental import io_callback
+            op_name = name or _auto_name("allgather")
+            if tensor.ndim == 0:  # match the host path's 0-d -> (1,)
+                tensor = tensor.reshape(1)
+
+            def _cb(arr):
+                return np.asarray(
+                    _ops.allgather(np.asarray(arr), op_name))
+
+            shape = (tensor.shape[0] * _hvd.size(),) + tuple(tensor.shape[1:])
+            out_shape = jax.ShapeDtypeStruct(shape, tensor.dtype)
+            return io_callback(_cb, out_shape, tensor, ordered=True)
+        return tensor
     arr = np.asarray(tensor)
     out = _ops.allgather(arr, name or _auto_name("allgather"))
     return jnp.asarray(out)
@@ -128,9 +181,19 @@ def allgather(tensor, name=None, axis_name=AXIS_NAME):
 def broadcast(tensor, root_rank=0, name=None, axis_name=AXIS_NAME):
     """Broadcasts the root rank's tensor to every rank."""
     if _is_traced(tensor):
-        # In-jit: select the root's shard and distribute it.
-        src = jax.lax.all_gather(tensor, axis_name)
-        return jax.tree_util.tree_map(lambda x: x[root_rank], src)
+        if _axis_in_scope(axis_name):
+            # In-jit: select the root's shard and distribute it.
+            src = jax.lax.all_gather(tensor, axis_name)
+            return jax.tree_util.tree_map(lambda x: x[root_rank], src)
+        if _multi_process():
+            op_name = name or _auto_name("broadcast")
+
+            def _cb(arr):
+                return np.asarray(_ops.broadcast(
+                    np.asarray(arr), root_rank, op_name)).astype(arr.dtype)
+
+            return _host_callback(_cb, tensor)
+        return tensor
     arr = np.asarray(tensor)
     out = _ops.broadcast(arr, root_rank, name or _auto_name("broadcast"))
     return jnp.asarray(out)

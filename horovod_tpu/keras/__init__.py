@@ -1,0 +1,41 @@
+"""Keras binding — public shell over the shared ``horovod_tpu._keras``
+implementation (reference: ``horovod/keras/__init__.py`` and
+``horovod/tensorflow/keras/__init__.py`` — thin shells over
+``horovod/_keras``)."""
+
+import keras
+
+import horovod_tpu as _hvd
+from horovod_tpu import (  # noqa: F401
+    init, shutdown, is_initialized, rank, local_rank, cross_rank, size,
+    local_size, cross_size, is_homogeneous,
+)
+from horovod_tpu.tensorflow import (  # noqa: F401
+    allreduce, allgather, broadcast, Compression,
+)
+
+from .. import _keras as _impl
+from . import callbacks  # noqa: F401
+
+
+def DistributedOptimizer(optimizer, name=None,
+                         compression=None, average=True):
+    """Wraps a Keras optimizer for synchronous data-parallel training
+    (reference: keras/__init__.py:34)."""
+    return _impl.create_distributed_optimizer(keras, optimizer, name,
+                                              compression, average)
+
+
+def broadcast_model_weights(model, root_rank=0):
+    return _impl.broadcast_model_weights(model, root_rank)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None):
+    """Loads a model saved with a wrapped optimizer, re-wrapping it
+    (reference: keras/__init__.py:117, _keras/__init__.py:107-123)."""
+    model = keras.models.load_model(filepath,
+                                    custom_objects=custom_objects or {})
+    if hasattr(model, "optimizer") and model.optimizer is not None and \
+            not getattr(model.optimizer, "_HVD_WRAPPED", False):
+        model.optimizer = DistributedOptimizer(model.optimizer)
+    return model

@@ -1,0 +1,35 @@
+"""Public Keras callbacks (reference: ``horovod/keras/callbacks.py`` —
+thin shells binding the shared impls to keras.callbacks.Callback)."""
+
+import keras
+
+from .._keras import callbacks as _impl
+
+
+class BroadcastGlobalVariablesCallback(
+        _impl.BroadcastGlobalVariablesCallbackImpl, keras.callbacks.Callback):
+    def __init__(self, root_rank=0):
+        super().__init__(keras.backend, root_rank)
+
+
+class MetricAverageCallback(
+        _impl.MetricAverageCallbackImpl, keras.callbacks.Callback):
+    def __init__(self):
+        super().__init__(keras.backend)
+
+
+class LearningRateScheduleCallback(
+        _impl.LearningRateScheduleCallbackImpl, keras.callbacks.Callback):
+    def __init__(self, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True, momentum_correction=True,
+                 steps_per_epoch=None):
+        super().__init__(keras.backend, multiplier, start_epoch, end_epoch,
+                         staircase, momentum_correction, steps_per_epoch)
+
+
+class LearningRateWarmupCallback(
+        _impl.LearningRateWarmupCallbackImpl, keras.callbacks.Callback):
+    def __init__(self, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0):
+        super().__init__(keras.backend, warmup_epochs, momentum_correction,
+                         steps_per_epoch, verbose)

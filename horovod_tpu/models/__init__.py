@@ -1,0 +1,21 @@
+"""Model zoo backing the reference's benchmark/example configs
+(`BASELINE.json` `configs`; reference examples/ directory):
+
+* :mod:`.resnet`   — ResNet-18/34/50/101/152 (flax), the flagship
+  benchmark model (reference `examples/tensorflow2_synthetic_benchmark.py`,
+  `examples/pytorch_imagenet_resnet50.py`).
+* :mod:`.mnist`    — 2-layer CNN (reference `examples/tensorflow2_mnist.py`).
+* :mod:`.word2vec` — skip-gram with negative sampling; sparse embedding
+  gradients exercise the allgather path (reference
+  `examples/tensorflow_word2vec.py`).
+* :mod:`.transformer` — decoder-only transformer with optional ring
+  attention for long-context sequence parallelism (TPU-first extension).
+
+All models are written TPU-first: NHWC conv layouts, bfloat16 compute with
+float32 parameters, static shapes, no data-dependent Python control flow.
+"""
+
+from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152  # noqa: F401
+from .mnist import MnistCNN  # noqa: F401
+from .word2vec import SkipGram  # noqa: F401
+from .transformer import Transformer, TransformerConfig  # noqa: F401

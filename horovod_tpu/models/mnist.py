@@ -1,0 +1,32 @@
+"""2-layer MNIST CNN — capability parity with the reference's MNIST
+examples (`examples/tensorflow2_mnist.py:21-33`: two conv layers, two dense
+layers; the canonical single-process/CPU functional config in
+BASELINE.json)."""
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    """conv(32,3x3) -> conv(64,3x3) -> maxpool -> dense(128) -> dense(10)."""
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), dtype=self.dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,124 @@
+"""Decoder-only transformer with pluggable long-context attention.
+
+The reference has no model layer (it only moves gradients); this model
+exists to exercise the TPU-first sequence-parallel path
+(`horovod_tpu.parallel.ring`) end-to-end: with ``attention="ring"`` or
+``"ulysses"`` the module must run inside ``shard_map`` with the sequence
+dimension sharded over ``sp_axis`` — each device holds [B, L/n, ...] and
+attention is exact over the full sequence.
+
+TPU-first: bf16 compute / f32 params, static shapes, pre-norm blocks,
+rotary position embeddings computed from *global* positions so sequence
+shards agree.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.ring import ring_attention, ulysses_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    num_heads: int = 12
+    embed_dim: int = 768
+    mlp_dim: int = 3072
+    max_seq_len: int = 8192
+    attention: str = "dense"      # dense | ring | ulysses
+    sp_axis: Optional[str] = None  # mesh axis holding the sequence shards
+    dtype: Any = jnp.bfloat16
+
+
+def _rotary(x, positions):
+    """Rotary embedding over the last dim; positions [B, L] global."""
+    d = x.shape[-1]
+    half = d // 2
+    freq = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = positions[..., None].astype(jnp.float32) * freq  # [B, L, half]
+    ang = ang[:, :, None, :]                               # [B, L, 1, half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+        axis=-1).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        head_dim = cfg.embed_dim // cfg.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.num_heads, head_dim), dtype=cfg.dtype,
+            param_dtype=jnp.float32, use_bias=False, name=name)
+        q = _rotary(dense("query")(x), positions)
+        k = _rotary(dense("key")(x), positions)
+        v = dense("value")(x)
+        if cfg.attention == "ring":
+            o = ring_attention(q, k, v, cfg.sp_axis, causal=True)
+        elif cfg.attention == "ulysses":
+            o = ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                           preferred_element_type=jnp.float32)
+            s = s * (head_dim ** -0.5)
+            L = s.shape[-1]
+            mask = lax.broadcasted_iota(jnp.int32, (L, L), 0) >= \
+                lax.broadcasted_iota(jnp.int32, (L, L), 1)
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return nn.DenseGeneral(cfg.embed_dim, axis=(-2, -1), dtype=cfg.dtype,
+                               param_dtype=jnp.float32, use_bias=False,
+                               name="out")(o)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        norm = lambda name: nn.RMSNorm(  # noqa: E731
+            dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+        x = x + Attention(cfg, name="attn")(norm("norm1")(x), positions)
+        h = norm("norm2")(x)
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     use_bias=False, name="mlp_in")(h)
+        h = nn.silu(h)
+        h = nn.Dense(cfg.embed_dim, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     use_bias=False, name="mlp_out")(h)
+        return x + h
+
+
+class Transformer(nn.Module):
+    """tokens [B, L_local] (+ global positions when sequence-sharded) ->
+    logits [B, L_local, vocab]."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1], dtype=jnp.int32)[None],
+                tokens.shape)
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim, param_dtype=jnp.float32,
+                     dtype=cfg.dtype, name="embed")(tokens)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name="block_%d" % i)(x, positions)
+        x = nn.RMSNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                       name="norm_f")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+                          param_dtype=jnp.float32, use_bias=False,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,68 @@
+"""Skip-gram word2vec with negative sampling.
+
+Capability parity: reference `examples/tensorflow_word2vec.py` (the
+BASELINE.json config that "exercises allgather + broadcast") — its
+embedding gradients are IndexedSlices, which the reference allreduces via
+the sparse allgather path (`horovod/tensorflow/__init__.py:65-76`).
+
+TPU-first: embedding lookups are one-hot-free `jnp.take` gathers (static
+shapes), NCE loss against `num_sampled` shared negative samples per batch.
+Sparse gradients surface as rows of the dense embedding table; the jax
+binding's `allreduce_sparse` gathers (indices, values) across ranks instead
+of densifying — see `horovod_tpu/jax/sparse.py`.
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class SkipGram(nn.Module):
+    """Skip-gram embedding + NCE output layer."""
+    vocab_size: int = 50000
+    embedding_dim: int = 200
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.embedding = nn.Embed(self.vocab_size, self.embedding_dim,
+                                  param_dtype=jnp.float32,
+                                  embedding_init=nn.initializers.uniform(2.0))
+        self.nce_weight = self.param(
+            "nce_weight",
+            nn.initializers.truncated_normal(1.0 / self.embedding_dim ** 0.5),
+            (self.vocab_size, self.embedding_dim), jnp.float32)
+        self.nce_bias = self.param("nce_bias", nn.initializers.zeros,
+                                   (self.vocab_size,), jnp.float32)
+
+    def __call__(self, center_ids):
+        """Embeds a batch of center-word ids -> [batch, embedding_dim]."""
+        return self.embedding(center_ids)
+
+    def nce_loss(self, center_ids, context_ids, negative_ids):
+        """Sampled-softmax/NCE loss.
+
+        center_ids [B], context_ids [B] (positives), negative_ids [K]
+        (shared negatives) — all int32, static shapes.
+        """
+        emb = self.embedding(center_ids)                        # [B, D]
+        pos_w = jnp.take(self.nce_weight, context_ids, axis=0)  # [B, D]
+        pos_b = jnp.take(self.nce_bias, context_ids, axis=0)    # [B]
+        neg_w = jnp.take(self.nce_weight, negative_ids, axis=0)  # [K, D]
+        neg_b = jnp.take(self.nce_bias, negative_ids, axis=0)    # [K]
+
+        pos_logit = jnp.sum(emb * pos_w, axis=-1) + pos_b        # [B]
+        neg_logit = emb @ neg_w.T + neg_b[None, :]               # [B, K]
+
+        pos_loss = -jax.nn.log_sigmoid(pos_logit)
+        neg_loss = -jnp.sum(jax.nn.log_sigmoid(-neg_logit), axis=-1)
+        return jnp.mean(pos_loss + neg_loss)
+
+    def nearest(self, word_ids, k=8):
+        """Cosine-nearest neighbours for eval (reference word2vec eval loop)."""
+        norm = self.embedding.embedding / (jnp.linalg.norm(
+            self.embedding.embedding, axis=1, keepdims=True) + 1e-8)
+        q = jnp.take(norm, word_ids, axis=0)
+        sim = q @ norm.T
+        return jax.lax.top_k(sim, k + 1)[1][:, 1:]

@@ -1,0 +1,12 @@
+"""MXNet binding slot (reference: ``horovod/mxnet/__init__.py``).
+
+MXNet reached end-of-life and is not shipped in this environment; the
+module exists to keep the binding registry complete (`--check-build`
+reports it absent). Importing raises with a clear message, mirroring how
+the reference gates unbuilt extensions
+(`horovod/common/util.py check_extension`)."""
+
+raise ImportError(
+    "horovod_tpu.mxnet requires MXNet, which is not installed in this "
+    "environment (MXNet is EOL upstream). Use horovod_tpu.jax (TPU-native), "
+    "horovod_tpu.torch, horovod_tpu.tensorflow, or horovod_tpu.keras.")

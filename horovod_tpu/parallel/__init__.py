@@ -1,0 +1,27 @@
+"""Parallelism strategies over JAX device meshes.
+
+The reference framework is data-parallel only (SURVEY.md §2.5); data
+parallelism here reproduces it natively (``make_train_step`` = the
+DistributedOptimizer loop lowered onto an ICI mesh). Long-context sequence
+parallelism (ring attention, Ulysses all-to-all) is a first-class TPU
+extension layered on the same mesh machinery.
+
+* :mod:`.mesh`  — topology discovery and Mesh construction (ICI within a
+  slice, DCN across slices — the TPU analogue of the reference's
+  local/cross communicator split, `common/mpi/mpi_context.cc:133-165`).
+* :mod:`.train` — jitted, shard_map'd data-parallel train-step builder
+  (the in-XLA equivalent of `_DistributedOptimizer.apply_gradients`,
+  reference `horovod/tensorflow/__init__.py:231-258`).
+* :mod:`.ring`  — ring attention (blockwise flash attention with k/v
+  blocks rotated over the ICI ring via ``ppermute``) and Ulysses-style
+  all-to-all sequence parallelism.
+"""
+
+from .mesh import (  # noqa: F401
+    data_parallel_mesh,
+    hybrid_mesh,
+    mesh_axis_size,
+    topology_summary,
+)
+from .ring import ring_attention, ulysses_attention  # noqa: F401
+from .train import make_train_step  # noqa: F401

@@ -1,0 +1,80 @@
+"""Device-mesh and topology utilities.
+
+The reference derives a three-level communicator structure — global, local
+(intra-node), cross (inter-node) — from MPI at init
+(`common/mpi/mpi_context.cc:133-165`, splits at :149-158). On TPU the
+analogous split is ICI (chips within a slice, fast torus links) vs DCN
+(hosts/slices over the data-center network); XLA routes collectives
+per-axis, so encoding the split in the Mesh axes is all that is needed —
+no hierarchical op implementations, the compiler emits the two-level
+reduction itself when the mesh is built contiguously.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def _devices(backend=None):
+    return jax.devices(backend) if backend else jax.devices()
+
+
+def data_parallel_mesh(axis_name="hvd", backend=None, devices=None):
+    """1-D mesh over every addressable device — the Horovod world.
+
+    `mesh_utils.create_device_mesh` orders devices so neighbouring ranks
+    are ICI neighbours (ring collectives ride the torus).
+    """
+    devs = list(devices) if devices is not None else _devices(backend)
+    try:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_device_mesh((len(devs),), devices=devs)
+    except Exception:  # CPU/virtual backends have no topology info
+        arr = np.array(devs)
+    return Mesh(arr, (axis_name,))
+
+
+def hybrid_mesh(axis_shape, axis_names, backend=None, devices=None):
+    """N-D mesh, e.g. ``hybrid_mesh((-1, 4), ("dp", "sp"))``.
+
+    One axis may be -1 (inferred). On multi-slice TPU deployments prefer
+    `mesh_utils.create_hybrid_device_mesh` semantics: the *leading* axes
+    span DCN (cross-slice — the reference's `cross_comm`), trailing axes
+    stay inside a slice on ICI (the reference's `local_comm`). Collectives
+    over trailing axes therefore ride ICI only.
+    """
+    devs = list(devices) if devices is not None else _devices(backend)
+    shape = list(axis_shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        if len(devs) % known != 0:
+            raise ValueError(
+                "cannot infer -1 in mesh shape %r over %d devices"
+                % (axis_shape, len(devs)))
+        shape[shape.index(-1)] = len(devs) // known
+    if int(np.prod(shape)) != len(devs):
+        raise ValueError("mesh shape %r != %d devices" % (shape, len(devs)))
+    try:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_device_mesh(tuple(shape), devices=devs)
+    except Exception:
+        arr = np.array(devs).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def mesh_axis_size(mesh, axis_name):
+    return mesh.shape[axis_name]
+
+
+def topology_summary(backend=None):
+    """Human-readable device/topology description (the `--check-build`
+    analogue of the reference's capability matrix, `run/run.py:262-298`)."""
+    devs = _devices(backend)
+    lines = ["%d device(s), platform=%s" % (len(devs), devs[0].platform)]
+    for d in devs:
+        coords = getattr(d, "coords", None)
+        lines.append("  id=%d process=%d kind=%s%s" % (
+            d.id, d.process_index, d.device_kind,
+            " coords=%s" % (coords,) if coords is not None else ""))
+    return "\n".join(lines)

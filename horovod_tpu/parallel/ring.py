@@ -1,0 +1,121 @@
+"""Long-context sequence parallelism: ring attention and Ulysses.
+
+Not present in the reference (SURVEY.md §5.7 — it never sees activations);
+first-class here because long context shapes the core design on TPU.
+
+* :func:`ring_attention` — blockwise (flash-style) attention where each
+  device holds a sequence shard and k/v blocks rotate around the ICI ring
+  via ``lax.ppermute``; compute on the current block overlaps the
+  neighbour exchange (XLA schedules the ppermute concurrently with the
+  matmuls since there is no data dependence until the next iteration).
+  Softmax is accumulated online (running max + normaliser), so the result
+  is exact full attention over the whole sequence at O(L/n) memory.
+* :func:`ulysses_attention` — all-to-all alternative: reshard from
+  sequence-sharded to head-sharded, run dense local attention, reshard
+  back. Better when heads >= devices and the per-device sequence is short.
+
+Both are meant to run inside ``shard_map`` over a mesh axis (see
+`horovod_tpu.parallel.mesh.hybrid_mesh`).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attention(q, k, v, o, m, l, q_offset, kv_offset, causal, scale):
+    """One flash-attention block update with online softmax.
+
+    q [B,Lq,H,D]; k,v [B,Lk,H,D]; o [B,Lq,H,D] f32 accumulator;
+    m,l [B,H,Lq] running max / normaliser. Offsets are *global* token
+    offsets of the local q block and the current k/v block, for causal
+    masking across devices.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        k_pos = kv_offset + lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # exp(-inf - -inf) guard: a fully-masked row keeps m == -inf; correct
+    # the scale factor to 0 there instead of NaN.
+    alpha = jnp.where(jnp.isneginf(m_new), 0.0, jnp.exp(m - m_new))
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(jnp.isneginf(m_new)[..., None], 0.0, p)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, axis_name, causal=True, scale=None):
+    """Exact multi-head attention over a sequence sharded on `axis_name`.
+
+    Args: q, k, v of shape [B, L_local, H, D] (per-device shards, equal
+    L_local on every device), inside shard_map over `axis_name`.
+    Returns [B, L_local, H, D] in q.dtype.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+
+    o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        src = (idx - i) % n  # which global block we currently hold
+        o, m, l = _block_attention(q, k_blk, v_blk, o, m, l,
+                                   q_offset=idx * Lq, kv_offset=src * Lk,
+                                   causal=causal, scale=scale)
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return o, m, l, k_nxt, v_nxt
+
+    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
+
+    Input [B, L_local, H, D] sequence-sharded; all_to_all turns it into
+    [B, L_full, H/n, D] head-sharded, local dense attention runs on full
+    sequence, and a second all_to_all restores sequence sharding. H must
+    be divisible by the axis size.
+    """
+    n = lax.psum(1, axis_name)
+    B, Ll, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+
+    def seq_to_heads(x):
+        # [B, Ll, H, D] -> concat seq, split heads -> [B, Ll*n, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        L = s.shape[2]
+        mask = lax.broadcasted_iota(jnp.int32, (L, L), 0) >= \
+            lax.broadcasted_iota(jnp.int32, (L, L), 1)
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(vg.dtype)
+    og = jnp.einsum("bhqk,bkhd->bqhd", p, vg,
+                    preferred_element_type=jnp.float32).astype(q.dtype)
+    return heads_to_seq(og)

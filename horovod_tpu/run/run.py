@@ -15,6 +15,7 @@ import argparse
 import os
 import shlex
 import signal
+import socket
 import subprocess
 import sys
 
@@ -27,6 +28,11 @@ def check_build(out=sys.stdout):
 
     def flag(v):
         return "X" if v else " "
+
+    def binding(framework, binding_mod):
+        # A framework counts only when BOTH it and our binding for it are
+        # importable (the matrix diagnoses what this build supports).
+        return flag(_importable(framework) and _importable(binding_mod))
 
     out.write("""\
 Horovod-TPU v%s:
@@ -45,10 +51,13 @@ Available data planes:
     [X] CPU (TCP ring)
     [%s] XLA/ICI (in-jit)
 """ % (hvd.__version__,
-       flag(_importable("jax")), flag(_importable("torch")),
-       flag(_importable("tensorflow")),
-       flag(_importable("tensorflow") or _importable("keras")),
-       flag(_importable("mxnet")), flag(_importable("jax"))))
+       binding("jax", "horovod_tpu.jax"),
+       binding("torch", "horovod_tpu.torch"),
+       binding("tensorflow", "horovod_tpu.tensorflow"),
+       flag((_importable("tensorflow") or _importable("keras"))
+            and _importable("horovod_tpu.keras")),
+       binding("mxnet", "horovod_tpu.mxnet"),
+       flag(_importable("jax"))))
 
 
 def _importable(mod):
@@ -151,8 +160,11 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
         ports = util.find_free_ports(np)
     else:
         ports = [29500 + i for i in range(np)]
+    # Local slots must be advertised with an address the *other hosts* can
+    # reach; 127.0.0.1 is only valid when every slot is local.
+    local_addr = "127.0.0.1" if all_local else socket.gethostname()
     addrs = ["%s:%d" % (slot.hostname if not util.is_local_host(slot.hostname)
-                        else "127.0.0.1", port)
+                        else local_addr, port)
              for slot, port in zip(slots, ports)]
 
     base_env = dict(env if env is not None else os.environ)
@@ -207,6 +219,10 @@ def main(argv=None):
             parser.error("--tpu-pod given but no TPU pod metadata found")
         if args.num_proc is None:
             args.num_proc = len(util.parse_hosts(hosts))
+    elif args.hostfile:
+        hosts = util.parse_hostfile(args.hostfile)
+        if args.num_proc is None:
+            args.num_proc = sum(h.slots for h in hosts)
     else:
         hosts = args.hosts or "localhost:%d" % (args.num_proc or 1)
     if args.num_proc is None:

@@ -1,0 +1,159 @@
+"""TensorFlow binding.
+
+Capability parity with the reference TF API
+(``horovod/tensorflow/__init__.py``): ``allreduce`` (dense +
+IndexedSlices sparse path + ``sparse_as_dense``), ``allgather``,
+``broadcast``, ``broadcast_variables``, ``DistributedGradientTape``,
+``DistributedOptimizer`` (Keras-3 optimizers), ``Compression``.
+
+Tensors ride the native host core (negotiation/fusion/cache) via numpy —
+the reference's CPU custom-op path (`horovod/tensorflow/mpi_ops.cc`)
+without a compiled TF kernel: eager tensors convert directly, graph mode
+goes through ``tf.py_function``. For TPU-resident XLA training use the
+jax binding; this binding is the TF-on-host-CPU compatibility surface.
+"""
+
+import tensorflow as tf
+
+import horovod_tpu as _hvd
+from horovod_tpu import (  # noqa: F401
+    init, shutdown, is_initialized, rank, local_rank, cross_rank, size,
+    local_size, cross_size, is_homogeneous,
+)
+from horovod_tpu.common import ops as _ops
+from horovod_tpu.common.ops import HorovodInternalError  # noqa: F401
+
+from .compression import Compression  # noqa: F401
+
+_name_counter = [0]
+
+
+def _auto_name(prefix):
+    _name_counter[0] += 1
+    return "%s.tf%d" % (prefix, _name_counter[0])
+
+
+def _py_collective(fn, tensor, name):
+    """Runs `fn(numpy) -> numpy` on a tf tensor, eagerly or via
+    tf.py_function inside tf.function graphs."""
+    if tf.inside_function():
+        out = tf.py_function(lambda t: fn(t.numpy()), [tensor],
+                             Tout=tensor.dtype, name=name)
+        out.set_shape(tensor.shape)
+        return out
+    import numpy as np
+    return tf.convert_to_tensor(fn(np.asarray(tensor)))
+
+
+def allreduce(tensor, average=True, name=None, compression=Compression.none,
+              sparse_as_dense=False, prescale_factor=1.0,
+              postscale_factor=1.0):
+    """Allreduce; IndexedSlices take the sparse allgather path (reference:
+    tensorflow/__init__.py:65-76)."""
+    if isinstance(tensor, tf.IndexedSlices):
+        if sparse_as_dense:
+            tensor = tf.convert_to_tensor(tensor)
+        else:
+            op_name = name or _auto_name("ar_sparse")
+            values = allgather(tensor.values, name=op_name + ".v")
+            indices = allgather(tf.cast(tensor.indices, tf.int64),
+                                name=op_name + ".i")
+            if average:
+                values = values / size()
+            return tf.IndexedSlices(values, indices,
+                                    dense_shape=tensor.dense_shape)
+    op_name = name or _auto_name("allreduce")
+    compressed, ctx = compression.compress(tensor)
+    post = postscale_factor / size() if average else postscale_factor
+
+    def _do(arr):
+        return _ops.allreduce(arr, op_name, prescale_factor=prescale_factor,
+                              postscale_factor=post)
+
+    out = _py_collective(_do, compressed, op_name.replace(".", "_"))
+    return compression.decompress(out, ctx)
+
+
+def allgather(tensor, name=None):
+    op_name = name or _auto_name("allgather")
+    if tf.inside_function():
+        out = tf.py_function(
+            lambda t: _ops.allgather(t.numpy(), op_name), [tensor],
+            Tout=tensor.dtype, name=op_name.replace(".", "_"))
+        out.set_shape([None] + list(tensor.shape[1:]))
+        return out
+    import numpy as np
+    return tf.convert_to_tensor(_ops.allgather(np.asarray(tensor), op_name))
+
+
+def broadcast(tensor, root_rank=0, name=None):
+    op_name = name or _auto_name("broadcast")
+    return _py_collective(
+        lambda arr: _ops.broadcast(arr, root_rank, op_name), tensor,
+        op_name.replace(".", "_"))
+
+
+def broadcast_variables(variables, root_rank=0):
+    """Assigns every variable its root-rank value (reference:
+    broadcast_global_variables / tensorflow/__init__.py:87-141)."""
+    for i, var in enumerate(variables):
+        name = "bc_var.%d.%s" % (i, getattr(var, "name", i))
+        var.assign(broadcast(var.value() if hasattr(var, "value") else var,
+                             root_rank, name=name))
+
+
+class DistributedGradientTape(tf.GradientTape):
+    """GradientTape whose `gradient()` allreduces the results (reference:
+    _DistributedGradientTape, tensorflow/__init__.py:322-377)."""
+
+    def __init__(self, *args, average=True, compression=Compression.none,
+                 sparse_as_dense=False, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._hvd_average = average
+        self._hvd_compression = compression
+        self._hvd_sparse_as_dense = sparse_as_dense
+        self._hvd_name_counter = [0]
+
+    def gradient(self, target, sources, output_gradients=None, **kwargs):
+        grads = super().gradient(target, sources, output_gradients,
+                                 **kwargs)
+        flat = tf.nest.flatten(grads)
+        reduced = []
+        for i, g in enumerate(flat):
+            if g is None:
+                reduced.append(None)
+                continue
+            reduced.append(allreduce(
+                g, average=self._hvd_average,
+                name="tape_grad.%d" % i,
+                compression=self._hvd_compression,
+                sparse_as_dense=self._hvd_sparse_as_dense))
+        return tf.nest.pack_sequence_as(grads, reduced)
+
+
+def DistributedOptimizer(optimizer, average=True,
+                         compression=Compression.none,
+                         sparse_as_dense=False):
+    """Wraps a Keras-3 optimizer so `apply_gradients` first averages
+    gradients across ranks (reference: tensorflow/__init__.py:231-319)."""
+
+    base = optimizer.__class__
+
+    class _Distributed(base):
+        _HVD_WRAPPED = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            grads_and_vars = list(grads_and_vars)
+            reduced = []
+            for i, (g, v) in enumerate(grads_and_vars):
+                if g is not None:
+                    g = allreduce(g, average=average,
+                                  name="opt_grad.%d" % i,
+                                  compression=compression,
+                                  sparse_as_dense=sparse_as_dense)
+                reduced.append((g, v))
+            return super().apply_gradients(reduced, *args, **kwargs)
+
+    cls = type("Distributed%s" % base.__name__, (_Distributed,), {})
+    new_opt = cls.from_config(optimizer.get_config())
+    return new_opt

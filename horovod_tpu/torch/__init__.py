@@ -1,0 +1,368 @@
+"""PyTorch binding.
+
+Capability parity with the reference torch API
+(``horovod/torch/__init__.py`` + ``horovod/torch/mpi_ops.py``):
+``allreduce[_async][_]``, ``allgather[_async]``, ``broadcast[_async][_]``,
+``poll``/``synchronize`` handle semantics, ``DistributedOptimizer`` with
+per-parameter grad hooks and ``backward_passes_per_step`` accumulation,
+``broadcast_parameters`` / ``broadcast_optimizer_state``, ``Compression``.
+
+Torch here is the CPU-tensor framework (the environment ships CPU torch);
+tensors ride the native host core — the same path as the reference's
+``DoAllreduceCudaOnCPU`` staging variant (`torch/mpi_ops_v2.cc:84-117`),
+minus the GPU staging copy. TPU training from torch graphs is out of
+scope; use the jax binding for the XLA/ICI plane.
+"""
+
+import torch
+
+import horovod_tpu as _hvd
+from horovod_tpu import (  # noqa: F401
+    init, shutdown, is_initialized, rank, local_rank, cross_rank, size,
+    local_size, cross_size, is_homogeneous,
+)
+from horovod_tpu.common import ops as _ops
+from horovod_tpu.common.ops import HorovodInternalError  # noqa: F401
+
+from .compression import Compression  # noqa: F401
+
+# handle -> (input torch tensor, output destination or None)
+_torch_handles = {}
+
+_name_counter = [0]
+
+
+def _auto_name(prefix):
+    _name_counter[0] += 1
+    return "%s.t%d" % (prefix, _name_counter[0])
+
+
+def _to_numpy(tensor):
+    if tensor.dtype == torch.bfloat16:
+        import ml_dtypes
+        return tensor.detach().float().cpu().numpy().astype(
+            ml_dtypes.bfloat16)
+    return tensor.detach().cpu().numpy()
+
+
+# -- async collectives ----------------------------------------------------
+
+def allreduce_async(tensor, average=True, name=None,
+                    prescale_factor=1.0, postscale_factor=1.0):
+    post = postscale_factor / size() if average else postscale_factor
+    handle = _ops.allreduce_async(_to_numpy(tensor),
+                                  name or _auto_name("allreduce"),
+                                  prescale_factor=prescale_factor,
+                                  postscale_factor=post)
+    _torch_handles[handle] = (tensor, None)
+    return handle
+
+
+def allreduce_async_(tensor, average=True, name=None,
+                     prescale_factor=1.0, postscale_factor=1.0):
+    """In-place variant: the result lands back in `tensor`."""
+    handle = allreduce_async(tensor, average, name, prescale_factor,
+                             postscale_factor)
+    _torch_handles[handle] = (tensor, tensor)
+    return handle
+
+
+def allgather_async(tensor, name=None):
+    handle = _ops.allgather_async(_to_numpy(tensor),
+                                  name or _auto_name("allgather"))
+    _torch_handles[handle] = (tensor, None)
+    return handle
+
+
+def broadcast_async(tensor, root_rank, name=None):
+    handle = _ops.broadcast_async(_to_numpy(tensor), root_rank,
+                                  name or _auto_name("broadcast"))
+    _torch_handles[handle] = (tensor, None)
+    return handle
+
+
+def broadcast_async_(tensor, root_rank, name=None):
+    handle = broadcast_async(tensor, root_rank, name)
+    _torch_handles[handle] = (tensor, tensor)
+    return handle
+
+
+def poll(handle):
+    return _ops.poll(handle)
+
+
+def synchronize(handle):
+    """Completes `handle`; returns the result as a torch tensor (writing
+    in place when the `_`-variant started it)."""
+    if handle not in _torch_handles:
+        raise ValueError("unknown handle %d" % handle)
+    tensor, dest = _torch_handles.pop(handle)
+    out = _ops.synchronize(handle)
+    try:
+        result = torch.from_numpy(out.copy())
+    except TypeError:  # bfloat16 numpy extension dtype
+        result = torch.from_numpy(out.astype("float32")).to(torch.bfloat16)
+    if result.dtype != tensor.dtype:
+        result = result.to(tensor.dtype)
+    if dest is not None:
+        dest.copy_(result.reshape(dest.shape))
+        return dest
+    return result
+
+
+# -- sync wrappers ---------------------------------------------------------
+
+def allreduce(tensor, average=True, name=None, compression=Compression.none,
+              prescale_factor=1.0, postscale_factor=1.0):
+    compressed, ctx = compression.compress(tensor)
+    handle = allreduce_async(compressed, average, name, prescale_factor,
+                             postscale_factor)
+    return compression.decompress(synchronize(handle), ctx)
+
+
+def allreduce_(tensor, average=True, name=None,
+               prescale_factor=1.0, postscale_factor=1.0):
+    return synchronize(allreduce_async_(tensor, average, name,
+                                        prescale_factor, postscale_factor))
+
+
+def allgather(tensor, name=None):
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast(tensor, root_rank, name=None):
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def broadcast_(tensor, root_rank, name=None):
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+# -- parameter / optimizer state broadcast --------------------------------
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcasts a model's `state_dict()` or `named_parameters()` from
+    root (reference: torch/__init__.py:255-284)."""
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    else:
+        items = sorted(params)
+    handles = []
+    for name, p in items:
+        if not torch.is_tensor(p):
+            continue
+        handles.append((p, broadcast_async(p, root_rank, "bc_param.%s" %
+                                           name)))
+    for p, h in handles:
+        with torch.no_grad():
+            p.copy_(synchronize(h).reshape(p.shape))
+
+
+def broadcast_optimizer_state(optimizer, root_rank=0):
+    """Broadcasts optimizer state from root, tensor-izing scalar state the
+    way the reference does (torch/__init__.py:287-403)."""
+    state_dict = optimizer.state_dict()
+    casts = []
+    handles = []
+
+    def _walk(prefix, obj):
+        if torch.is_tensor(obj):
+            handles.append((obj, broadcast_async(obj, root_rank,
+                                                 "bc_opt.%s" % prefix)))
+        elif isinstance(obj, (int, float)):
+            t = torch.tensor(float(obj), dtype=torch.float64)
+            handles.append((t, broadcast_async(t, root_rank,
+                                               "bc_opt.%s" % prefix)))
+            casts.append((prefix, type(obj), t))
+        elif isinstance(obj, dict):
+            for k in sorted(obj, key=str):
+                _walk("%s.%s" % (prefix, k), obj[k])
+        elif isinstance(obj, (list, tuple)):
+            for i, v in enumerate(obj):
+                _walk("%s.%d" % (prefix, i), v)
+
+    _walk("state", state_dict.get("state", {}))
+    for i, group in enumerate(state_dict.get("param_groups", [])):
+        for k in sorted(group, key=str):
+            if k != "params":
+                _walk("group.%d.%s" % (i, k), group[k])
+
+    for t, h in handles:
+        with torch.no_grad():
+            t.copy_(synchronize(h).reshape(t.shape))
+    # Write back tensor-ized scalars.
+    scalar_map = {prefix: typ(t.item()) for prefix, typ, t in casts}
+
+    def _apply(prefix, obj):
+        if isinstance(obj, dict):
+            for k in list(obj):
+                p = "%s.%s" % (prefix, k)
+                if p in scalar_map:
+                    obj[k] = scalar_map[p]
+                else:
+                    _apply(p, obj[k])
+        elif isinstance(obj, list):
+            for i in range(len(obj)):
+                p = "%s.%d" % (prefix, i)
+                if p in scalar_map:
+                    obj[i] = scalar_map[p]
+                else:
+                    _apply(p, obj[i])
+
+    _apply("state", state_dict.get("state", {}))
+    for i, group in enumerate(state_dict.get("param_groups", [])):
+        for k in list(group):
+            if k != "params":
+                p = "group.%d.%s" % (i, k)
+                if p in scalar_map:
+                    group[k] = scalar_map[p]
+    optimizer.load_state_dict(state_dict)
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Broadcasts an arbitrary picklable object from root."""
+    import io
+    import pickle
+
+    import numpy as np
+    if rank() == root_rank:
+        data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    else:
+        data = np.zeros(0, dtype=np.uint8)
+    length = torch.tensor([len(data)], dtype=torch.int64)
+    broadcast_(length, root_rank, (name or "bc_obj") + ".len")
+    payload = torch.zeros(int(length.item()), dtype=torch.uint8)
+    if rank() == root_rank:
+        payload.copy_(torch.from_numpy(data.copy()))
+    broadcast_(payload, root_rank, (name or "bc_obj") + ".data")
+    return pickle.loads(io.BytesIO(payload.numpy().tobytes()).getvalue())
+
+
+# -- DistributedOptimizer --------------------------------------------------
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Wraps a torch optimizer: registers per-parameter grad-accumulator
+    hooks that fire async allreduce as gradients become ready (reference:
+    torch/__init__.py:108-143); `step()` drains the handles first."""
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1):
+        # params is the wrapped optimizer's param_groups: each group dict
+        # already carries its hyperparameters, so the parent optimizer's
+        # defaults never overwrite them (same trick as the reference,
+        # torch/__init__.py:50).
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self._backward_passes_per_step = backward_passes_per_step
+        self._allreduce_delay = {}
+        self._handles = {}
+        self._grad_accs = []
+        self._requires_update = set()
+        self._synchronized = False
+        self._should_synchronize = True
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [("allreduce.noname.%s" % i, v)
+                     for param_group in self.param_groups
+                     for i, v in enumerate(param_group["params"])]
+        all_params = {id(v) for pg in self.param_groups
+                      for v in pg["params"]}
+        self._parameter_names = {id(v): k for k, v in named
+                                 if id(v) in all_params}
+        if _hvd.size() > 1:
+            self._register_hooks()
+
+    def _register_hooks(self):
+        for param_group in self.param_groups:
+            for p in param_group["params"]:
+                if p.requires_grad:
+                    self._requires_update.add(p)
+                    self._allreduce_delay[p] = self._backward_passes_per_step
+                    p_tmp = p.expand_as(p)
+                    grad_acc = p_tmp.grad_fn.next_functions[0][0]
+                    grad_acc.register_hook(self._make_hook(p))
+                    self._grad_accs.append(grad_acc)
+
+    def _allreduce_grad_async(self, p):
+        name = self._parameter_names.get(id(p), "grad.%d" % id(p))
+        compressed, ctx = self._compression.compress(p.grad)
+        handle = allreduce_async(compressed, average=True,
+                                 name="allreduce.%s" % name)
+        return handle, ctx
+
+    def _make_hook(self, p):
+        def hook(*ignore):
+            if p in self._handles and self._handles[p][0] is not None:
+                if self._allreduce_delay[p] <= 0:
+                    raise AssertionError(
+                        "Gradients were computed more than "
+                        "backward_passes_per_step times before call to "
+                        "step(). Increase backward_passes_per_step.")
+            self._allreduce_delay[p] -= 1
+            if self._allreduce_delay[p] == 0:
+                handle, ctx = self._allreduce_grad_async(p)
+                self._handles[p] = (handle, ctx)
+        return hook
+
+    def synchronize(self):
+        """Drains every outstanding gradient allreduce into p.grad."""
+        missing = [p for p in self._requires_update
+                   if p not in self._handles]
+        for p in missing:
+            self._handles[p] = self._allreduce_grad_async(p)
+        for p, (handle, ctx) in sorted(
+                self._handles.items(),
+                key=lambda kv: self._parameter_names.get(id(kv[0]), "")):
+            output = synchronize(handle)
+            self._allreduce_delay[p] = self._backward_passes_per_step
+            with torch.no_grad():
+                p.grad.copy_(self._compression.decompress(output, ctx)
+                             .reshape(p.grad.shape))
+        self._handles.clear()
+        self._synchronized = True
+
+    class _SkipSync:
+        def __init__(self, opt):
+            self._opt = opt
+
+        def __enter__(self):
+            self._opt._should_synchronize = False
+
+        def __exit__(self, *args):
+            self._opt._should_synchronize = True
+
+    def skip_synchronize(self):
+        """Context manager to call step() without draining handles
+        (reference: torch/__init__.py:164-182)."""
+        return self._SkipSync(self)
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                import warnings
+                warnings.warn(
+                    "optimizer.step() called without a preceding backward "
+                    "pass (synchronize() already ran)")
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "zero_grad called while allreduce handles are outstanding; "
+                "call step() or synchronize() first")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1):
+    """Wraps `optimizer` into a gradient-averaging distributed optimizer
+    (reference: torch/__init__.py DistributedOptimizer factory — dynamic
+    subclass so isinstance(opt, type(optimizer)) keeps working)."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step)

@@ -29,6 +29,36 @@ def cpu_devices():
 
 
 @pytest.fixture
+def run_launcher():
+    """Runs a worker script under the launcher (`-np N` on localhost) —
+    the shared harness for the multi-process tests (SURVEY.md §4)."""
+    import subprocess
+
+    def _run(np_, script, extra_env=None, timeout=300):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        # Workers run plain CPU numpy; don't inherit test JAX flags.
+        env.pop("JAX_PLATFORMS", None)
+        # Workers compile identical jit programs; share a persistent
+        # compilation cache so only the first worker pays the compile.
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/hvd_tpu_jax_cache")
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+        # JAX_PLATFORM_NAME (not JAX_PLATFORMS) overrides the axon TPU
+        # plugin's default-backend priority — N workers must not all grab
+        # the single tunnel chip.
+        env["JAX_PLATFORM_NAME"] = "cpu"
+        if extra_env:
+            env.update(extra_env)
+        script_path = os.path.join(REPO_ROOT, "tests", script)
+        return subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.run.run", "-np", str(np_),
+             "--", sys.executable, script_path],
+            env=env, timeout=timeout, capture_output=True, text=True)
+
+    return _run
+
+
+@pytest.fixture
 def cpu_mesh_1d():
     """8-device mesh over axis 'hvd' on the CPU backend."""
     import jax

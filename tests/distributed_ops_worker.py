@@ -143,6 +143,34 @@ def test_duplicate_name(r, n):
         hvd.synchronize(h1)
 
 
+def test_jit_host_callback_plane(r, n):
+    # hvd collectives inside plain `jax.jit` with no mapped axis must ride
+    # the host core via ordered io_callback (not emit an unbound psum).
+    import os
+    if os.environ.get("HVD_TPU_SKIP_JIT_TEST"):
+        return
+    os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import horovod_tpu.jax as hvd_jax
+
+    @jax.jit
+    def step(x):
+        s = hvd_jax.allreduce(x, average=False, name="jit_cb")
+        b = hvd_jax.broadcast(x, 0, name="jit_bc")
+        g = hvd_jax.allgather(x, name="jit_ag")
+        return s, b, g
+
+    x = jnp.full((4,), float(r + 1), jnp.float32)
+    for _ in range(2):  # 2nd call reuses the compiled program + cache path
+        s, b, g = step(x)
+        assert np.allclose(np.asarray(s), sum(rr + 1 for rr in range(n)))
+        assert np.allclose(np.asarray(b), 1.0)
+        assert g.shape == (4 * n,)
+        for rr in range(n):
+            assert np.allclose(np.asarray(g)[4 * rr:4 * rr + 4], rr + 1)
+
+
 def test_cache_steady_state(r, n):
     # Same names over many iterations: second-and-later cycles should ride
     # the response-cache fast path; correctness must be identical.

@@ -1,32 +1,12 @@
 """Multi-process collective tests: launch real 2- and 4-rank jobs on
 localhost via the launcher (no mocked collectives, mirroring the reference CI
-strategy in SURVEY.md §4)."""
-
-import os
-import subprocess
-import sys
+strategy in SURVEY.md §4). The `run_launcher` harness lives in conftest.py."""
 
 import pytest
 
-HERE = os.path.dirname(os.path.abspath(__file__))
-REPO = os.path.dirname(HERE)
-
-
-def run_launcher(np_, script, extra_env=None, timeout=180):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    # The workers should run plain CPU numpy; don't inherit test JAX flags.
-    env.pop("JAX_PLATFORMS", None)
-    if extra_env:
-        env.update(extra_env)
-    return subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.run.run", "-np", str(np_), "--",
-         sys.executable, os.path.join(HERE, script)],
-        env=env, timeout=timeout, capture_output=True, text=True)
-
 
 @pytest.mark.parametrize("np_", [2, 4])
-def test_distributed_ops(np_):
+def test_distributed_ops(run_launcher, np_):
     proc = run_launcher(np_, "distributed_ops_worker.py")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     for r in range(np_):
@@ -34,18 +14,20 @@ def test_distributed_ops(np_):
             proc.stdout, proc.stdout + proc.stderr
 
 
-def test_single_process_short_circuit():
+def test_single_process_short_circuit(run_launcher):
     proc = run_launcher(1, "single_proc_worker.py")
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
-def test_cycle_time_env():
+def test_cycle_time_env(run_launcher):
     proc = run_launcher(2, "distributed_ops_worker.py",
                         extra_env={"HVD_TPU_CYCLE_TIME": "1"})
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
-def test_cache_disabled():
+def test_cache_disabled(run_launcher):
+    # Deliberately includes the plain-jit io_callback plane: the host
+    # core must stay correct with the response cache off.
     proc = run_launcher(2, "distributed_ops_worker.py",
                         extra_env={"HVD_TPU_CACHE_CAPACITY": "0"})
     assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,57 @@
+"""Examples as smoke tests, mirroring the reference CI
+(`.buildkite/gen-pipeline.sh:123-177` runs the MNIST examples under both
+launchers). Tiny configs keep the suite fast; the keras example is gated
+behind HVD_TPU_RUN_ALL_EXAMPLES because the TF worker already covers that
+binding end-to-end."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_example(np_, script, extra_args=(), timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/hvd_tpu_jax_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    # N workers must not all grab the single tunnel TPU; JAX_PLATFORM_NAME
+    # (unlike JAX_PLATFORMS) overrides the axon plugin's default-backend
+    # priority.
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run.run", "-np", str(np_), "--",
+         sys.executable, os.path.join(REPO, "examples", script)]
+        + list(extra_args),
+        env=env, timeout=timeout, capture_output=True, text=True)
+
+
+def test_torch_mnist_example():
+    proc = run_example(2, "torch_mnist.py", ["--epochs", "1"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "done" in proc.stdout
+
+
+def test_jax_mnist_example():
+    proc = run_example(2, "jax_mnist.py", ["--epochs", "1"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "done" in proc.stdout
+
+
+def test_jax_word2vec_example():
+    proc = run_example(2, "jax_word2vec.py",
+                       ["--steps", "20", "--vocab-size", "500"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "done" in proc.stdout
+
+
+@pytest.mark.skipif(not os.environ.get("HVD_TPU_RUN_ALL_EXAMPLES"),
+                    reason="set HVD_TPU_RUN_ALL_EXAMPLES=1 to run")
+def test_keras_mnist_example():
+    proc = run_example(2, "keras_mnist.py", ["--epochs", "1"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "done" in proc.stdout

@@ -117,3 +117,24 @@ def test_broadcast_parameters():
 
 def test_metric_average():
     assert hvd.metric_average(3.5) == 3.5
+
+
+def test_plain_jit_single_process_identity():
+    """Collectives inside plain jit (no shard_map axis) in a single
+    process are identity — must NOT raise unbound-axis NameError."""
+    import jax
+    import jax.numpy as jnp
+    import horovod_tpu.jax as hvd_jax
+
+    @jax.jit
+    def step(x):
+        a = hvd_jax.allreduce(x, average=True)
+        b = hvd_jax.broadcast(x, 0)
+        g = hvd_jax.allgather(x)
+        return a, b, g
+
+    x = jnp.arange(6.0)
+    a, b, g = step(x)
+    assert jnp.allclose(a, x)
+    assert jnp.allclose(b, x)
+    assert jnp.allclose(g, x)

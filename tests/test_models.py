@@ -1,0 +1,93 @@
+"""Model-zoo shape/correctness tests (CPU, f32 to keep them cheap)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def test_resnet50_forward_shape():
+    from horovod_tpu.models import ResNet50
+    model = ResNet50(num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_resnet18_param_count():
+    from horovod_tpu.models import ResNet18
+    model = ResNet18(num_classes=1000, dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3)), train=False)
+    n = sum(p.size for p in jax.tree_util.tree_leaves(variables["params"]))
+    # torchvision resnet18 has 11.69M params; ours matches to within the
+    # fc/in-shape differences.
+    assert 11e6 < n < 12e6
+
+
+def test_mnist_cnn_forward():
+    from horovod_tpu.models import MnistCNN
+    model = MnistCNN(dtype=jnp.float32)
+    x = jnp.zeros((4, 28, 28, 1))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (4, 10)
+
+
+def test_word2vec_loss_and_shapes():
+    from horovod_tpu.models import SkipGram
+    model = SkipGram(vocab_size=100, embedding_dim=16)
+    center = jnp.array([1, 2, 3], jnp.int32)
+    context = jnp.array([4, 5, 6], jnp.int32)
+    neg = jnp.array([7, 8, 9, 10], jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), center)
+    emb = model.apply(variables, center)
+    assert emb.shape == (3, 16)
+    loss = model.apply(variables, center, context, neg,
+                       method=SkipGram.nce_loss)
+    assert np.isfinite(float(loss)) and float(loss) > 0
+
+
+def test_transformer_dense_forward():
+    from horovod_tpu.models import Transformer, TransformerConfig
+    cfg = TransformerConfig(vocab_size=128, num_layers=2, num_heads=4,
+                            embed_dim=64, mlp_dim=128, dtype=jnp.float32)
+    model = Transformer(cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    logits = model.apply(variables, tokens)
+    assert logits.shape == (2, 16, 128)
+
+
+def test_transformer_ring_matches_dense():
+    """Sequence-sharded ring transformer == single-device dense
+    transformer on the same weights — end-to-end SP correctness."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.models import Transformer, TransformerConfig
+
+    base = dict(vocab_size=64, num_layers=2, num_heads=4, embed_dim=32,
+                mlp_dim=64, dtype=jnp.float32)
+    dense_model = Transformer(TransformerConfig(**base))
+    ring_model = Transformer(TransformerConfig(attention="ring",
+                                               sp_axis="sp", **base))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+    variables = dense_model.init(jax.random.PRNGKey(0), tokens)
+    expected = dense_model.apply(variables, tokens)
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), ("sp",))
+    positions = jnp.broadcast_to(jnp.arange(32, dtype=jnp.int32)[None],
+                                 tokens.shape)
+
+    def shard_fn(tokens, positions):
+        return ring_model.apply(variables, tokens, positions)
+
+    f = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"), check_vma=False))
+    out = f(tokens, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)

@@ -1,28 +1,11 @@
 """Timeline + stall-inspector e2e tests (reference analogues:
-test/test_timeline.py, test/test_stall.py)."""
+test/test_timeline.py, test/test_stall.py). The `run_launcher` harness
+lives in conftest.py."""
 
 import json
-import os
-import subprocess
-import sys
-
-HERE = os.path.dirname(os.path.abspath(__file__))
-REPO = os.path.dirname(HERE)
 
 
-def run_launcher(np_, script, extra_env=None, timeout=120):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("JAX_PLATFORMS", None)
-    if extra_env:
-        env.update(extra_env)
-    return subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.run.run", "-np", str(np_), "--",
-         sys.executable, os.path.join(HERE, script)],
-        env=env, timeout=timeout, capture_output=True, text=True)
-
-
-def test_timeline(tmp_path):
+def test_timeline(run_launcher, tmp_path):
     timeline_file = str(tmp_path / "timeline.json")
     proc = run_launcher(2, "timeline_worker.py", extra_env={
         "HVD_TPU_TIMELINE": timeline_file,
@@ -44,7 +27,7 @@ def test_timeline(tmp_path):
         json.loads(line)
 
 
-def test_stall_detection_and_shutdown():
+def test_stall_detection_and_shutdown(run_launcher):
     proc = run_launcher(2, "stall_worker.py", extra_env={
         "HVD_TPU_STALL_CHECK_TIME_SECONDS": "2",
         "HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS": "5",

@@ -1,0 +1,135 @@
+"""Sequence-parallel attention and DP train-step tests on the 8-device
+virtual CPU mesh (the TPU-less analogue of the reference's 2-process
+localhost distributed tests, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+# Numerical-equivalence tests compare two computation orders; pin matmuls
+# to exact f32 so only the math (not backend matmul quantization) differs.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def _mesh(n, name):
+    return Mesh(np.array(jax.devices("cpu")[:n]), (name,))
+
+
+def _dense_reference(q, k, v, causal=True):
+    D = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * D ** -0.5
+    if causal:
+        L = s.shape[-1]
+        mask = np.tril(np.ones((L, L), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(causal):
+    from horovod_tpu.parallel import ring_attention
+    n = 4
+    B, L, H, D = 2, 32, 4, 16
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    expected = _dense_reference(q, k, v, causal)
+
+    mesh = _mesh(n, "sp")
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "sp", causal=causal),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
+        check_vma=False))
+    out = f(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_attention_matches_dense():
+    from horovod_tpu.parallel import ulysses_attention
+    n = 4
+    B, L, H, D = 2, 32, 8, 16  # H divisible by n
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    expected = _dense_reference(q, k, v, causal=True)
+
+    mesh = _mesh(n, "sp")
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, "sp", causal=True),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
+        check_vma=False))
+    out = f(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_make_train_step_decreases_loss():
+    import optax
+    from horovod_tpu.models import MnistCNN
+    from horovod_tpu.parallel import data_parallel_mesh, make_train_step
+    from horovod_tpu.parallel.train import cross_entropy_loss
+
+    model = MnistCNN(dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (16, 28, 28, 1))
+    y = jax.random.randint(rng, (16,), 0, 10)
+    variables = model.init(rng, x[:1], train=False)
+    params = variables["params"]
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"], train=True)
+        return cross_entropy_loss(logits, batch["y"])
+
+    mesh = data_parallel_mesh(devices=jax.devices("cpu"))
+    opt = optax.sgd(0.05)
+    step = make_train_step(loss_fn, opt, mesh, donate=False)
+    params_p, opt_state = step.place(params, opt.init(params))
+    batch = {"x": x, "y": y}
+
+    losses = []
+    for _ in range(5):
+        params_p, opt_state, loss = step(params_p, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_train_step_gradients_are_averaged():
+    """Each shard sees different data; the resulting params must be
+    identical to a single-device run on the full batch (the defining
+    property of synchronous data parallelism)."""
+    import optax
+    from horovod_tpu.parallel import data_parallel_mesh, make_train_step
+
+    w0 = jnp.ones((4,))
+    x = jnp.arange(32.0).reshape(8, 4) / 32.0
+    y = jnp.ones((8,))
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    opt = optax.sgd(0.1)
+    mesh = data_parallel_mesh(devices=jax.devices("cpu"))
+    step = make_train_step(loss_fn, opt, mesh, donate=False)
+    params_p, opt_state = step.place(w0, opt.init(w0))
+    params_p, _, _ = step(params_p, opt_state, {"x": x, "y": y})
+
+    g = jax.grad(loss_fn)(w0, {"x": x, "y": y})
+    expected = w0 - 0.1 * g
+    np.testing.assert_allclose(np.asarray(params_p), np.asarray(expected),
+                               rtol=1e-6)
+
+
+def test_hybrid_mesh_shapes():
+    from horovod_tpu.parallel import hybrid_mesh, mesh_axis_size
+    mesh = hybrid_mesh((-1, 4), ("dp", "sp"), devices=jax.devices("cpu"))
+    assert mesh_axis_size(mesh, "dp") == 2
+    assert mesh_axis_size(mesh, "sp") == 4

@@ -1,0 +1,42 @@
+"""TensorFlow/Keras binding tests (reference analogues:
+test/test_tensorflow.py, test/test_keras.py). Multi-process correctness
+runs via the launcher; sparse helpers in-process."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+
+def test_tensorflow_distributed(run_launcher):
+    proc = run_launcher(2, "tf_ops_worker.py", timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for r in range(2):
+        assert ("rank %d: all tensorflow tests passed" % r) in proc.stdout, \
+            proc.stdout + proc.stderr
+
+
+def test_tf_compression_roundtrip():
+    from horovod_tpu.tensorflow.compression import Compression
+    x = tf.constant(np.random.randn(16).astype(np.float32))
+    for codec in (Compression.none, Compression.fp16, Compression.bf16):
+        c, ctx = codec.compress(x)
+        out = codec.decompress(c, ctx)
+        assert out.dtype == x.dtype
+        assert np.allclose(out.numpy(), x.numpy(), atol=1e-2)
+
+
+def test_jax_sparse_helpers():
+    import jax.numpy as jnp
+    from horovod_tpu.jax.sparse import apply_sparse, densify
+
+    param = jnp.zeros((5, 2))
+    idx = jnp.array([1, 1, 3])
+    val = jnp.ones((3, 2))
+    out = apply_sparse(param, idx, val)
+    assert np.allclose(np.asarray(out[1]), 2.0)  # duplicates accumulate
+    assert np.allclose(np.asarray(out[3]), 1.0)
+
+    dense = densify(idx, val, 5)
+    assert dense.shape == (5, 2)
+    assert np.allclose(np.asarray(dense[1]), 2.0)
