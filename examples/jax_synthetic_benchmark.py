@@ -66,16 +66,19 @@ def main():
     print("Model: %s, batch size/device: %d, devices: %d (%s)" %
           (args.model, args.batch_size, n, devices[0].platform))
 
+    # float(loss) is a true end-of-chain barrier (each loss depends on
+    # every prior step's params); block_until_ready alone is not reliable
+    # over remote-device transports.
     for _ in range(args.num_warmup_batches):
         params_p, opt_state, loss = step(params_p, opt_state, batch)
-    jax.block_until_ready(loss)
+    float(loss)
 
     img_secs = []
     for i in range(args.num_iters):
         t0 = time.perf_counter()
         for _ in range(args.num_batches_per_iter):
             params_p, opt_state, loss = step(params_p, opt_state, batch)
-        jax.block_until_ready(loss)
+        float(loss)
         dt = time.perf_counter() - t0
         rate = global_batch * args.num_batches_per_iter / dt / n
         img_secs.append(rate)

@@ -31,7 +31,7 @@ class TransformerConfig:
     embed_dim: int = 768
     mlp_dim: int = 3072
     max_seq_len: int = 8192
-    attention: str = "dense"      # dense | ring | ulysses
+    attention: str = "dense"      # dense | flash | ring | ulysses
     sp_axis: Optional[str] = None  # mesh axis holding the sequence shards
     dtype: Any = jnp.bfloat16
 
@@ -67,6 +67,9 @@ class Attention(nn.Module):
             o = ring_attention(q, k, v, cfg.sp_axis, causal=True)
         elif cfg.attention == "ulysses":
             o = ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
+        elif cfg.attention == "flash":
+            from horovod_tpu.ops import flash_attention
+            o = flash_attention(q, k, v, causal=True)
         else:
             s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                            preferred_element_type=jnp.float32)

@@ -1,0 +1,168 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Forward: one grid program per (batch*head, q-block). The q block and the
+full k/v for that head live in VMEM; the kernel streams k/v in BLOCK_K
+slices with an online-softmax accumulator, so HBM traffic is O(L*D) and
+VMEM is O(BLOCK*D) — the standard flash recipe, tiled to the MXU
+(128-aligned blocks, bf16 inputs, f32 accumulation). Causal masking skips
+whole k-blocks above the diagonal (the fori_loop bound is the q-block
+index), not just elements.
+
+Backward: custom VJP that recomputes attention blockwise over q in plain
+JAX (O(BLOCK_Q * L) live memory) — XLA fuses it well, and it keeps the
+kernel surface small. The softmax statistics are not saved; stability
+comes from a fresh log-sum-exp per block.
+
+On non-TPU backends the same kernel runs in Pallas interpret mode (tests)
+or falls back to the blockwise JAX implementation.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import jax.experimental.pallas as pl
+
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k):
+    # q_ref: [BLOCK_Q, D]; k_ref/v_ref: [L, D]; o_ref: [BLOCK_Q, D]
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[0]
+    seq_len = k_ref.shape[0]
+    num_kb = seq_len // block_k
+
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    acc = jnp.zeros((block_q, q_ref.shape[1]), jnp.float32)
+    m = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+
+    # Causal: k-blocks strictly above the diagonal contribute nothing —
+    # bound the loop instead of masking them.
+    kb_bound = jnp.minimum(qi + 1, num_kb) if causal else num_kb
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [BQ, BK]
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc, m, l = lax.fori_loop(0, kb_bound, body, (acc, m, l))
+    l = jnp.where(l == 0.0, 1.0, l)  # rows with no visible keys
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+
+
+def _pallas_forward(q, k, v, scale, causal, interpret):
+    # q,k,v: [B, H, L, D]
+    B, H, L, D = q.shape
+    qf = q.reshape(B * H, L, D)
+    kf = k.reshape(B * H, L, D)
+    vf = v.reshape(B * H, L, D)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_k=BLOCK_K)
+    grid = (B * H, L // BLOCK_Q)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, BLOCK_Q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, L, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, L, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, BLOCK_Q, D),
+                               lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, L, D)
+
+
+def _blockwise_reference(q, k, v, scale, causal):
+    """Blockwise JAX attention, O(BLOCK_Q * L) live memory; used for the
+    backward recompute and as the non-TPU fallback."""
+    B, H, L, D = q.shape
+    block_q = min(BLOCK_Q, L)
+    num_qb = L // block_q
+
+    def per_qblock(i):
+        qs = lax.dynamic_slice_in_dim(q, i * block_q, block_q, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qs.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        if causal:
+            rows = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, L), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (block_q, L), 1)
+            s = jnp.where((rows >= cols)[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    blocks = [per_qblock(i) for i in range(num_qb)]
+    return jnp.concatenate(blocks, axis=2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, scale, causal, interpret):
+    if interpret is None:
+        return _blockwise_reference(q, k, v, scale, causal)
+    return _pallas_forward(q, k, v, scale, causal, interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, interpret):
+    return _flash(q, k, v, scale, causal, interpret), (q, k, v)
+
+
+def _flash_bwd(scale, causal, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _blockwise_reference(q, k, v, scale, causal),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=True, scale=None):
+    """Flash attention over [B, L, H, D] inputs (same layout as
+    `parallel.ring.ring_attention`); returns [B, L, H, D] in q.dtype.
+
+    L must be a multiple of 128 to hit the Pallas kernel; other shapes
+    (and non-TPU backends without interpret mode) use the blockwise JAX
+    fallback, which is numerically identical.
+    """
+    B, L, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    # Kernel layout: [B, H, L, D].
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if L % BLOCK_Q != 0 or not on_tpu:
+        out = _flash(qt, kt, vt, scale, causal, None)
+    else:
+        out = _flash(qt, kt, vt, scale, causal, False)
+    return out.transpose(0, 2, 1, 3)
