@@ -27,14 +27,85 @@ from .common import (  # noqa: F401
 __version__ = "0.1.0"
 
 _initialized_here = False
+_world_env = None  # launcher-injected env saved before a rank-subset remap
+
+_TOPOLOGY_KEYS = ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_LOCAL_RANK",
+                  "HVD_TPU_LOCAL_SIZE", "HVD_TPU_CROSS_RANK",
+                  "HVD_TPU_CROSS_SIZE", "HVD_TPU_ADDRS")
 
 
-def init():
+def _remap_subset_env(ranks):
+    """Rewrites the HVD_TPU_* env so the native core rendezvouses over the
+    `ranks` sub-communicator (members) or a size-1 self communicator
+    (non-members). Reference analogue: ``hvd.init(comm=[...])``
+    (`horovod/common/basics.py:29-60`, `common/mpi/mpi_context.cc:128-140`,
+    where MPI_Group_incl builds the subset communicator); here the subset is
+    realized by re-deriving rank/size/topology from the subset's addresses.
+    Non-members become independent size-1 communicators (the reference
+    falls back to MPI_COMM_WORLD with a warning, which leaves the two
+    groups' collectives incompatible anyway)."""
+    import os
+
+    from .run.util import topology_env
+
+    global _world_env
+    if _world_env is None:
+        _world_env = {k: os.environ.get(k) for k in _TOPOLOGY_KEYS}
+    else:  # re-init with a different subset: start from the world view
+        for k, v in _world_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    world_rank = int(os.environ.get("HVD_TPU_RANK", "0"))
+    world_size = int(os.environ.get("HVD_TPU_SIZE", "1"))
+    if len(set(ranks)) != len(ranks):
+        raise ValueError("duplicate entries in ranks: %r" % (ranks,))
+    for r in ranks:
+        if not 0 <= r < world_size:
+            raise ValueError("rank %d out of range for world size %d" %
+                             (r, world_size))
+    if world_rank not in ranks:
+        for k in _TOPOLOGY_KEYS:
+            os.environ.pop(k, None)
+        os.environ["HVD_TPU_RANK"] = "0"
+        os.environ["HVD_TPU_SIZE"] = "1"
+        return
+    addrs = (os.environ.get("HVD_TPU_ADDRS") or "").split(",")
+    if len(addrs) != world_size:
+        raise RuntimeError(
+            "HVD_TPU_ADDRS does not cover the world; cannot form a "
+            "rank-subset communicator")
+    sub_addrs = [addrs[r] for r in ranks]
+    os.environ.update(topology_env(list(ranks).index(world_rank), sub_addrs))
+
+
+def init(ranks=None):
     """Initializes the core runtime (rendezvous + background thread).
+
+    Args:
+      ranks: optional list of world ranks forming the communicator (the
+        reference's ``hvd.init(comm=[0, 1])`` rank-subset form,
+        ``horovod/common/basics.py:29-60``). Processes whose world rank is
+        not listed initialize as independent size-1 communicators and sit
+        out the subset's collectives.
 
     Reference analogue: ``hvd.init()`` -> ``horovod/common/basics.py:29-60``.
     """
-    global _initialized_here
+    global _initialized_here, _world_env
+    if ranks is not None and len(ranks) > 0:
+        _remap_subset_env(ranks)
+    elif _world_env is not None:
+        # A previous init(ranks=...) remapped the env; a plain init() must
+        # see the original world topology again, not the stale subset.
+        import os
+        for k, v in _world_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _world_env = None
     get_basics().init()
     if not _initialized_here:
         _atexit.register(shutdown)

@@ -127,54 +127,86 @@ void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor) {
   }
 }
 
+// Partitions `count` elements into n near-equal chunks.
+static void PartitionChunks(int64_t count, int n, std::vector<int64_t>* counts,
+                            std::vector<int64_t>* offsets) {
+  counts->assign(n, 0);
+  offsets->assign(n, 0);
+  int64_t base = count / n, rem = count % n;
+  int64_t off = 0;
+  for (int i = 0; i < n; ++i) {
+    (*counts)[i] = base + (i < rem ? 1 : 0);
+    (*offsets)[i] = off;
+    off += (*counts)[i];
+  }
+}
+
+// Reduce-scatter leg of a ring allreduce: after n-1 steps ring rank r owns
+// chunk (r+1) % n, reduced over the whole ring.
+static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
+                                  const std::vector<int64_t>& counts,
+                                  const std::vector<int64_t>& offsets,
+                                  DataType dtype) {
+  int n = ctx.RingSize(ring);
+  int rank = ctx.RingRank(ring);
+  std::size_t elem = DataTypeSize(dtype);
+  std::vector<char> tmp(static_cast<std::size_t>(counts[0]) * elem);
+  for (int step = 0; step < n - 1; ++step) {
+    int send_chunk = (rank - step + n) % n;
+    int recv_chunk = (rank - step - 1 + n) % n;
+    if (!ctx.RingExchangeOn(ring, buf + offsets[send_chunk] * elem,
+                            counts[send_chunk] * elem, tmp.data(),
+                            counts[recv_chunk] * elem)) {
+      return Status::UnknownError("ring reduce-scatter exchange failed");
+    }
+    ReduceSum(buf + offsets[recv_chunk] * elem, tmp.data(), counts[recv_chunk],
+              dtype);
+  }
+  return Status::OK();
+}
+
+// Allgather leg: circulates the fully-reduced chunks (owned per the
+// reduce-scatter leg above) until every ring member has all of them.
+static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
+                                   const std::vector<int64_t>& counts,
+                                   const std::vector<int64_t>& offsets,
+                                   DataType dtype) {
+  int n = ctx.RingSize(ring);
+  int rank = ctx.RingRank(ring);
+  std::size_t elem = DataTypeSize(dtype);
+  for (int step = 0; step < n - 1; ++step) {
+    int send_chunk = (rank + 1 - step + n) % n;
+    int recv_chunk = (rank - step + n) % n;
+    if (!ctx.RingExchangeOn(ring, buf + offsets[send_chunk] * elem,
+                            counts[send_chunk] * elem,
+                            buf + offsets[recv_chunk] * elem,
+                            counts[recv_chunk] * elem)) {
+      return Status::UnknownError("ring allgather exchange failed");
+    }
+  }
+  return Status::OK();
+}
+
+Status RingAllreduceOn(TcpContext& ctx, Ring ring, void* buffer, int64_t count,
+                       DataType dtype) {
+  int n = ctx.RingSize(ring);
+  if (n == 1 || count == 0) return Status::OK();
+  std::vector<int64_t> counts, offsets;
+  PartitionChunks(count, n, &counts, &offsets);
+  char* buf = static_cast<char*>(buffer);
+  Status s = RingReduceScatterOn(ctx, ring, buf, counts, offsets, dtype);
+  if (!s.ok()) return s;
+  return RingAllgatherPhaseOn(ctx, ring, buf, counts, offsets, dtype);
+}
+
 bool CpuRingAllreduce::Enabled(const std::vector<TensorTableEntry>& entries,
                                const Response& response) const {
   return entries[0].device == HOST_DEVICE_ID;
 }
 
-Status CpuRingAllreduce::RingAllreduce(void* buffer, int64_t count,
-                                       DataType dtype) {
-  int n = ctx_.size();
-  if (n == 1 || count == 0) return Status::OK();
-  int rank = ctx_.rank();
-  std::size_t elem = DataTypeSize(dtype);
-
-  // Partition elements into n near-equal chunks.
-  std::vector<int64_t> counts(n), offsets(n);
-  int64_t base = count / n, rem = count % n;
-  int64_t off = 0;
-  for (int i = 0; i < n; ++i) {
-    counts[i] = base + (i < rem ? 1 : 0);
-    offsets[i] = off;
-    off += counts[i];
-  }
-  char* buf = static_cast<char*>(buffer);
-  std::vector<char> tmp(static_cast<std::size_t>(counts[0]) * elem);
-
-  // Reduce-scatter phase: after n-1 steps rank r owns chunk (r+1) % n.
-  for (int step = 0; step < n - 1; ++step) {
-    int send_chunk = (rank - step + n) % n;
-    int recv_chunk = (rank - step - 1 + n) % n;
-    if (!ctx_.RingExchange(buf + offsets[send_chunk] * elem,
-                           counts[send_chunk] * elem, tmp.data(),
-                           counts[recv_chunk] * elem)) {
-      return Status::UnknownError("ring allreduce exchange failed");
-    }
-    ReduceSum(buf + offsets[recv_chunk] * elem, tmp.data(), counts[recv_chunk],
-              dtype);
-  }
-  // Allgather phase: circulate fully-reduced chunks.
-  for (int step = 0; step < n - 1; ++step) {
-    int send_chunk = (rank + 1 - step + n) % n;
-    int recv_chunk = (rank - step + n) % n;
-    if (!ctx_.RingExchange(buf + offsets[send_chunk] * elem,
-                           counts[send_chunk] * elem,
-                           buf + offsets[recv_chunk] * elem,
-                           counts[recv_chunk] * elem)) {
-      return Status::UnknownError("ring allgather exchange failed");
-    }
-  }
-  return Status::OK();
+Status CpuRingAllreduce::ReduceBuffer(void* buffer, int64_t count,
+                                      DataType dtype) {
+  return RingAllreduceOn(ctx_, Ring::GLOBAL, buffer, count, dtype);
 }
 
 Status CpuRingAllreduce::Execute(std::vector<TensorTableEntry>& entries,
@@ -211,8 +243,8 @@ Status CpuRingAllreduce::Execute(std::vector<TensorTableEntry>& entries,
     }
   }
 
-  timeline.ActivityStartAll(response.tensor_names(), "ALLREDUCE_RING");
-  Status s = RingAllreduce(buffer, total_elements, entries[0].dtype);
+  timeline.ActivityStartAll(response.tensor_names(), ActivityName());
+  Status s = ReduceBuffer(buffer, total_elements, entries[0].dtype);
   timeline.ActivityEndAll(response.tensor_names());
   if (!s.ok()) return s;
 
@@ -233,6 +265,43 @@ Status CpuRingAllreduce::Execute(std::vector<TensorTableEntry>& entries,
     timeline.ActivityEndAll(response.tensor_names());
   }
   return Status::OK();
+}
+
+bool CpuHierarchicalAllreduce::Enabled(
+    const std::vector<TensorTableEntry>& entries,
+    const Response& response) const {
+  return entries[0].device == HOST_DEVICE_ID &&
+         ctx_.hierarchical_possible() &&
+         global_state_->parameter_manager.HierarchicalAllreduce();
+}
+
+Status CpuHierarchicalAllreduce::ReduceBuffer(void* buffer, int64_t count,
+                                              DataType dtype) {
+  // Two-level composite (reference: nccl_operations.cc:150-346):
+  //   1. local-ring reduce-scatter — local rank lr ends up owning chunk
+  //      (lr+1) % ls, reduced over the local group;
+  //   2. cross-ring allreduce of the owned chunk (one participant per
+  //      local_rank, riding the inter-host links only);
+  //   3. local-ring allgather of the now globally-reduced chunks.
+  int ls = ctx_.local_size();
+  int lr = ctx_.local_rank();
+  if (count == 0) return Status::OK();
+  std::size_t elem = DataTypeSize(dtype);
+
+  std::vector<int64_t> counts, offsets;
+  PartitionChunks(count, ls, &counts, &offsets);
+  char* buf = static_cast<char*>(buffer);
+
+  Status s = RingReduceScatterOn(ctx_, Ring::LOCAL, buf, counts, offsets,
+                                 dtype);
+  if (!s.ok()) return s;
+
+  int owned = (lr + 1) % ls;
+  s = RingAllreduceOn(ctx_, Ring::CROSS, buf + offsets[owned] * elem,
+                      counts[owned], dtype);
+  if (!s.ok()) return s;
+
+  return RingAllgatherPhaseOn(ctx_, Ring::LOCAL, buf, counts, offsets, dtype);
 }
 
 bool CpuRingAllgather::Enabled(const std::vector<TensorTableEntry>& entries,
@@ -287,6 +356,109 @@ Status CpuRingAllgather::Execute(std::vector<TensorTableEntry>& entries,
   return Status::OK();
 }
 
+bool CpuHierarchicalAllgather::Enabled(
+    const std::vector<TensorTableEntry>& entries,
+    const Response& response) const {
+  return entries[0].device == HOST_DEVICE_ID &&
+         ctx_.hierarchical_possible() &&
+         global_state_->parameter_manager.HierarchicalAllgather();
+}
+
+Status CpuHierarchicalAllgather::Execute(
+    std::vector<TensorTableEntry>& entries, const Response& response) {
+  // Two-stage allgatherv (role parity with the reference's shared-memory
+  // hierarchical allgather, mpi_operations.cc:168-321): blocks circulate
+  // the intra-host local ring first, then whole host block-sets circulate
+  // the cross ring, so the inter-host links carry each byte once per host
+  // instead of once per rank.
+  int n = ctx_.size();
+  int ls = ctx_.local_size(), lr = ctx_.local_rank();
+  int cs = ctx_.cross_size(), cr = ctx_.cross_rank();
+  auto& timeline = global_state_->timeline;
+  timeline.ActivityStartAll(response.tensor_names(), "ALLGATHER_HIERARCHICAL");
+  for (auto& e : entries) {
+    const auto& first_dims = response.tensor_sizes();
+    if (static_cast<int>(first_dims.size()) != n) {
+      timeline.ActivityEndAll(response.tensor_names());
+      return Status::UnknownError("allgather sizes missing");
+    }
+    int64_t slice_elems = 1;
+    for (int d = 1; d < e.shape.ndims(); ++d) slice_elems *= e.shape.dim_size(d);
+    std::size_t elem = DataTypeSize(e.dtype);
+
+    std::vector<int64_t> block_bytes(n), block_offsets(n);
+    int64_t total_bytes = 0;
+    for (int r = 0; r < n; ++r) {
+      block_bytes[r] = first_dims[r] * slice_elems * static_cast<int64_t>(elem);
+      block_offsets[r] = total_bytes;
+      total_bytes += block_bytes[r];
+    }
+    e.gathered = std::make_shared<std::vector<char>>(
+        static_cast<std::size_t>(total_bytes));
+    e.gathered_sizes = std::make_shared<std::vector<int64_t>>(first_dims);
+    char* out = e.gathered->data();
+    std::memcpy(out + block_offsets[ctx_.rank()], e.data,
+                static_cast<std::size_t>(block_bytes[ctx_.rank()]));
+
+    // Stage 1: circulate single-rank blocks around the CROSS ring (my
+    // local_rank's column), writing each at its final (global-rank)
+    // offset. Each cross ring carries only its own column, so every byte
+    // crosses the inter-host links exactly once in total.
+    for (int step = 0; step < cs - 1; ++step) {
+      int gs = ctx_.RankAt(lr, (cr - step + cs) % cs);
+      int gr = ctx_.RankAt(lr, (cr - step - 1 + cs) % cs);
+      if (!ctx_.RingExchangeOn(
+              Ring::CROSS, out + block_offsets[gs],
+              static_cast<std::size_t>(block_bytes[gs]),
+              out + block_offsets[gr],
+              static_cast<std::size_t>(block_bytes[gr]))) {
+        timeline.ActivityEndAll(response.tensor_names());
+        return Status::UnknownError("hierarchical allgather cross leg failed");
+      }
+    }
+
+    // Stage 2: circulate whole column-sets (one local_rank's blocks from
+    // every host) around the intra-host local ring. Columns are not
+    // contiguous in the global layout, so stage through pack/unpack
+    // buffers — cheap, since this leg never leaves the host.
+    std::vector<int64_t> col_bytes(ls, 0);
+    int64_t max_col = 0;
+    for (int j = 0; j < ls; ++j) {
+      for (int c = 0; c < cs; ++c) col_bytes[j] += block_bytes[ctx_.RankAt(j, c)];
+      max_col = std::max(max_col, col_bytes[j]);
+    }
+    std::vector<char> tmp_send(static_cast<std::size_t>(max_col));
+    std::vector<char> tmp_recv(static_cast<std::size_t>(max_col));
+    for (int step = 0; step < ls - 1; ++step) {
+      int send_col = (lr - step + ls) % ls;
+      int recv_col = (lr - step - 1 + ls) % ls;
+      char* p = tmp_send.data();
+      for (int c = 0; c < cs; ++c) {
+        int g = ctx_.RankAt(send_col, c);
+        std::memcpy(p, out + block_offsets[g],
+                    static_cast<std::size_t>(block_bytes[g]));
+        p += block_bytes[g];
+      }
+      if (!ctx_.RingExchangeOn(
+              Ring::LOCAL, tmp_send.data(),
+              static_cast<std::size_t>(col_bytes[send_col]), tmp_recv.data(),
+              static_cast<std::size_t>(col_bytes[recv_col]))) {
+        timeline.ActivityEndAll(response.tensor_names());
+        return Status::UnknownError("hierarchical allgather local leg failed");
+      }
+      const char* q = tmp_recv.data();
+      for (int c = 0; c < cs; ++c) {
+        int g = ctx_.RankAt(recv_col, c);
+        std::memcpy(out + block_offsets[g], q,
+                    static_cast<std::size_t>(block_bytes[g]));
+        q += block_bytes[g];
+      }
+    }
+  }
+  timeline.ActivityEndAll(response.tensor_names());
+  return Status::OK();
+}
+
 bool CpuBroadcast::Enabled(const std::vector<TensorTableEntry>& entries,
                            const Response& response) const {
   return entries[0].device == HOST_DEVICE_ID;
@@ -295,45 +467,20 @@ bool CpuBroadcast::Enabled(const std::vector<TensorTableEntry>& entries,
 Status CpuBroadcast::Execute(std::vector<TensorTableEntry>& entries,
                              const Response& response) {
   auto& timeline = global_state_->timeline;
-  timeline.ActivityStartAll(response.tensor_names(), "BROADCAST_STAR");
+  timeline.ActivityStartAll(response.tensor_names(), "BROADCAST_RING");
   int rank = ctx_.rank();
   for (auto& e : entries) {
     std::size_t len = e.SizeBytes();
-    // Relay to rank 0 if the root is elsewhere, then star fan-out from 0.
-    // Ops run in lockstep on the coordination thread, so borrowing the
-    // control star for bulk data is race-free.
-    if (e.root_rank != 0) {
-      if (rank == e.root_rank) {
-        if (!ctx_.StarSend(0, e.data, len)) {
-          timeline.ActivityEndAll(response.tensor_names());
-          return Status::UnknownError("broadcast relay to rank 0 failed");
-        }
-      } else if (rank == 0) {
-        if (!ctx_.StarRecv(e.root_rank, e.output, len)) {
-          timeline.ActivityEndAll(response.tensor_names());
-          return Status::UnknownError("broadcast recv at rank 0 failed");
-        }
-      }
-    }
-    if (rank == 0) {
-      const void* src = (e.root_rank == 0) ? e.data : e.output;
-      for (int r = 1; r < ctx_.size(); ++r) {
-        if (r == e.root_rank) continue;
-        if (!ctx_.StarSend(r, src, len)) {
-          timeline.ActivityEndAll(response.tensor_names());
-          return Status::UnknownError("broadcast fan-out failed");
-        }
-      }
-      if (e.root_rank == 0 && e.output != e.data) {
-        std::memcpy(e.output, e.data, len);
-      }
-    } else if (rank != e.root_rank) {
-      if (!ctx_.StarRecv(0, e.output, len)) {
-        timeline.ActivityEndAll(response.tensor_names());
-        return Status::UnknownError("broadcast recv failed");
-      }
-    } else if (e.output != e.data) {
+    // Cut-through pipelined broadcast over the global ring: every byte
+    // crosses each link once and intermediate ranks forward as they
+    // receive, replacing the former star fan-out that serialized N-1 full
+    // copies through rank 0.
+    if (rank == e.root_rank && e.output != e.data) {
       std::memcpy(e.output, e.data, len);
+    }
+    if (!ctx_.RingBroadcast(e.output, len, e.root_rank)) {
+      timeline.ActivityEndAll(response.tensor_names());
+      return Status::UnknownError("ring broadcast failed");
     }
   }
   timeline.ActivityEndAll(response.tensor_names());

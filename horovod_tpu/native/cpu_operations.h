@@ -1,10 +1,19 @@
-// Host-CPU collective op implementations over the TCP data ring:
+// Host-CPU collective op implementations over the TCP data rings:
 //   - CpuRingAllreduce: bandwidth-optimal ring (reduce-scatter + allgather)
 //     over the fused buffer, dtype-aware reduction (16-bit floats accumulate
 //     in fp32).
+//   - CpuHierarchicalAllreduce: two-level composite — local-ring
+//     reduce-scatter, cross-ring allreduce of the owned chunk, local-ring
+//     allgather. The TCP analogue of the reference's NCCL ReduceScatter ->
+//     cross-node MPI allreduce -> AllGather composite
+//     (/root/reference horovod/common/ops/nccl_operations.cc:150-346).
 //   - CpuRingAllgather: ring allgatherv with per-rank first-dim sizes.
-//   - CpuBroadcast: root -> rank 0 relay -> star fan-out on the control
-//     channel (safe: ops run lockstep on the single coordination thread).
+//   - CpuHierarchicalAllgather: cross-ring circulation of each local_rank's
+//     block column (inter-host links carry every byte exactly once), then
+//     local-ring circulation of whole column-sets (role parity with the
+//     reference's shared-memory hierarchical allgather,
+//     ops/mpi_operations.cc:168-321).
+//   - CpuBroadcast: chunk-streamed pipelined broadcast over the global ring.
 //
 // Role parity with /root/reference horovod/common/ops/mpi_operations.cc and
 // gloo_operations.cc (the host data plane); the TPU in-jit data plane rides
@@ -28,10 +37,26 @@ class CpuRingAllreduce : public AllreduceOp {
   Status Execute(std::vector<TensorTableEntry>& entries,
                  const Response& response) override;
 
- private:
-  // In-place ring allreduce on `buffer` (count elements of dtype).
-  Status RingAllreduce(void* buffer, int64_t count, DataType dtype);
+ protected:
+  // In-place reduction of the fused buffer; overridden by the hierarchical
+  // variant. Named activity is used for the timeline.
+  virtual Status ReduceBuffer(void* buffer, int64_t count, DataType dtype);
+  virtual const char* ActivityName() const { return "ALLREDUCE_RING"; }
+
   TcpContext& ctx_;
+};
+
+class CpuHierarchicalAllreduce : public CpuRingAllreduce {
+ public:
+  using CpuRingAllreduce::CpuRingAllreduce;
+  bool Enabled(const std::vector<TensorTableEntry>& entries,
+               const Response& response) const override;
+
+ protected:
+  Status ReduceBuffer(void* buffer, int64_t count, DataType dtype) override;
+  const char* ActivityName() const override {
+    return "ALLREDUCE_HIERARCHICAL";
+  }
 };
 
 class CpuRingAllgather : public AllgatherOp {
@@ -43,8 +68,17 @@ class CpuRingAllgather : public AllgatherOp {
   Status Execute(std::vector<TensorTableEntry>& entries,
                  const Response& response) override;
 
- private:
+ protected:
   TcpContext& ctx_;
+};
+
+class CpuHierarchicalAllgather : public CpuRingAllgather {
+ public:
+  using CpuRingAllgather::CpuRingAllgather;
+  bool Enabled(const std::vector<TensorTableEntry>& entries,
+               const Response& response) const override;
+  Status Execute(std::vector<TensorTableEntry>& entries,
+                 const Response& response) override;
 };
 
 class CpuBroadcast : public BroadcastOp {
@@ -64,6 +98,9 @@ class CpuBroadcast : public BroadcastOp {
 void ReduceSum(void* dst, const void* src, int64_t count, DataType dtype);
 // Elementwise scale in place (used for prescale/postscale/average).
 void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor);
+// In-place ring allreduce of `count` elements on the chosen ring.
+Status RingAllreduceOn(TcpContext& ctx, Ring ring, void* buffer, int64_t count,
+                       DataType dtype);
 
 }  // namespace hvdtpu
 

@@ -17,8 +17,10 @@
 namespace hvdtpu {
 
 enum class Channel : uint8_t {
-  CONTROL = 0,  // worker -> coordinator star
-  RING = 1,     // prev -> next data ring
+  CONTROL = 0,     // worker -> coordinator star
+  RING = 1,        // prev -> next data ring (global)
+  LOCAL_RING = 2,  // ring within one host's local group
+  CROSS_RING = 3,  // ring across hosts at one local_rank
 };
 
 // Framed duplex connection. Frame = [u32 tag][u64 len][payload].

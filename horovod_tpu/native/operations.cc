@@ -29,7 +29,14 @@
 
 namespace hvdtpu {
 
-HorovodGlobalState::~HorovodGlobalState() = default;
+HorovodGlobalState::~HorovodGlobalState() {
+  // A joinable std::thread member would std::terminate the process at
+  // static destruction (e.g. interpreter exit without hvd.shutdown()).
+  shut_down.store(true);
+  if (background_thread.joinable()) {
+    background_thread.join();
+  }
+}
 
 namespace {
 
@@ -216,6 +223,7 @@ bool RunLoopOnce(HorovodGlobalState& state,
 
 void BackgroundThreadLoop(HorovodGlobalState& state) {
   if (!state.tcp_context.Initialize()) {
+    state.tcp_context.Finalize();  // release sockets for a re-init retry
     state.initialization_failed.store(true);
     state.initialization_done.store(true);
     return;
@@ -237,10 +245,17 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
   int64_t cache_capacity = EnvInt64(HVD_TPU_CACHE_CAPACITY, 1024, &fixed);
   state.response_cache.set_capacity(static_cast<uint32_t>(cache_capacity));
   state.parameter_manager.SetCacheEnabled(cache_capacity > 0, fixed);
-  bool hier_ar = EnvBool(HVD_TPU_HIERARCHICAL_ALLREDUCE, false, &fixed);
-  state.parameter_manager.SetHierarchicalAllreduce(hier_ar, fixed);
-  bool hier_ag = EnvBool(HVD_TPU_HIERARCHICAL_ALLGATHER, false, &fixed);
-  state.parameter_manager.SetHierarchicalAllgather(hier_ag, fixed);
+  if (state.tcp_context.hierarchical_possible()) {
+    bool hier_ar = EnvBool(HVD_TPU_HIERARCHICAL_ALLREDUCE, false, &fixed);
+    state.parameter_manager.SetHierarchicalAllreduce(hier_ar, fixed);
+    bool hier_ag = EnvBool(HVD_TPU_HIERARCHICAL_ALLGATHER, false, &fixed);
+    state.parameter_manager.SetHierarchicalAllgather(hier_ag, fixed);
+  } else {
+    // Flat topology: pin the knobs off and fixed so the autotuner doesn't
+    // waste its categorical budget scoring identical configurations.
+    state.parameter_manager.SetHierarchicalAllreduce(false, true);
+    state.parameter_manager.SetHierarchicalAllgather(false, true);
+  }
 
   state.controller->stall_inspector().SetStallWarningTimeSeconds(
       static_cast<int>(EnvInt64(HVD_TPU_STALL_CHECK_TIME, 60)));
@@ -270,8 +285,10 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
   // tensors lives inside jit (horovod_tpu/jax) and is deliberately not a
   // registry entry here — it never crosses the host boundary.
   std::vector<std::shared_ptr<AllreduceOp>> allreduce_ops = {
+      std::make_shared<CpuHierarchicalAllreduce>(state.tcp_context, &state),
       std::make_shared<CpuRingAllreduce>(state.tcp_context, &state)};
   std::vector<std::shared_ptr<AllgatherOp>> allgather_ops = {
+      std::make_shared<CpuHierarchicalAllgather>(state.tcp_context, &state),
       std::make_shared<CpuRingAllgather>(state.tcp_context, &state)};
   std::vector<std::shared_ptr<BroadcastOp>> broadcast_ops = {
       std::make_shared<CpuBroadcast>(state.tcp_context, &state)};
@@ -303,13 +320,24 @@ bool InitializeHorovodOnce() {
   if (!g_state.initialize_flag.load()) {
     g_state.initialize_flag.store(true);
     g_state.shut_down.store(false);
+    g_state.initialization_done.store(false);
+    g_state.initialization_failed.store(false);
     g_state.background_thread =
         std::thread(BackgroundThreadLoop, std::ref(g_state));
   }
   while (!g_state.initialization_done.load()) {
     std::this_thread::sleep_for(std::chrono::milliseconds(1));
   }
-  return !g_state.initialization_failed.load();
+  if (g_state.initialization_failed.load()) {
+    // Leave the state re-initializable: reap the dead thread and clear
+    // the flag so a later init() (e.g. with corrected env) can retry.
+    if (g_state.background_thread.joinable()) {
+      g_state.background_thread.join();
+    }
+    g_state.initialize_flag.store(false);
+    return false;
+  }
+  return true;
 }
 
 Status EnqueueTensor(Request::RequestType type, const char* name,

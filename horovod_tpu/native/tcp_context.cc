@@ -3,8 +3,10 @@
 #include <poll.h>
 #include <sys/socket.h>
 
+#include <cerrno>
 #include <cstdlib>
 #include <cstring>
+#include <sstream>
 
 #include "logging.h"
 
@@ -18,7 +20,6 @@ static int EnvInt(const char* name, int dflt) {
 static constexpr uint32_t kTagGather = 0x11;
 static constexpr uint32_t kTagBcast = 0x12;
 static constexpr uint32_t kTagBits = 0x13;
-static constexpr uint32_t kTagBarrier = 0x14;
 static constexpr uint32_t kTagRing = 0x20;
 
 bool TcpContext::Initialize() {
@@ -31,6 +32,8 @@ bool TcpContext::Initialize() {
   SetLogRank(rank_);
 
   if (size_ == 1) {
+    is_homogeneous_ = true;
+    rank_grid_.assign(1, 0);
     initialized_ = true;
     return true;
   }
@@ -56,12 +59,11 @@ bool TcpContext::Initialize() {
 
   int timeout_ms = EnvInt("HVD_TPU_START_TIMEOUT", 60) * 1000;
 
-  // Expected inbound connections: the ring predecessor, plus (rank 0 only)
+  // Phase 1 inbound: the global-ring predecessor, plus (rank 0 only)
   // every worker's control connection.
   int expected = 1 + (rank_ == 0 ? size_ - 1 : 0);
   control_conns_.resize(rank_ == 0 ? size_ : 1);
 
-  std::atomic<int> accepted{0};
   std::atomic<bool> accept_ok{true};
   std::thread acceptor([&] {
     for (int i = 0; i < expected; ++i) {
@@ -74,18 +76,18 @@ bool TcpContext::Initialize() {
       }
       if (channel == Channel::RING) {
         ring_prev_ = Conn(fd);
-      } else if (rank_ == 0 && peer_rank >= 1 && peer_rank < size_) {
+      } else if (rank_ == 0 && channel == Channel::CONTROL && peer_rank >= 1 &&
+                 peer_rank < size_) {
         control_conns_[peer_rank] = Conn(fd);
       } else {
-        LOG(ERROR) << "unexpected control connection from rank " << peer_rank;
+        LOG(ERROR) << "unexpected connection from rank " << peer_rank;
         accept_ok.store(false);
         return;
       }
-      ++accepted;
     }
   });
 
-  // Outbound: ring successor, and (workers) control to rank 0.
+  // Outbound: global-ring successor, and (workers) control to rank 0.
   bool ok = true;
   {
     int next = (rank_ + 1) % size_;
@@ -108,9 +110,138 @@ bool TcpContext::Initialize() {
     LOG(ERROR) << "rendezvous failed (rank " << rank_ << ")";
     return false;
   }
+
+  // Phase 2: learn every rank's (local_rank, cross_rank) over the star and
+  // build the local/cross rings the two-level collectives ride (the role
+  // MPI_Comm_split_type/split fill in the reference, mpi_context.cc:149-158).
+  if (!ExchangeTopology()) return false;
+  if (hierarchical_possible()) {
+    if (!ConnectSubRings(timeout_ms)) {
+      LOG(ERROR) << "sub-ring rendezvous failed (rank " << rank_ << ")";
+      return false;
+    }
+  }
+
   initialized_ = true;
-  LOG(DEBUG) << "TcpContext initialized: rank " << rank_ << "/" << size_;
+  LOG(DEBUG) << "TcpContext initialized: rank " << rank_ << "/" << size_
+             << (hierarchical_possible() ? " (hierarchical)" : "");
   return true;
+}
+
+bool TcpContext::ExchangeTopology() {
+  std::ostringstream mine;
+  mine << local_rank_ << " " << local_size_ << " " << cross_rank_ << " "
+       << cross_size_;
+  std::vector<std::string> all;
+  if (!GatherBlobs(mine.str(), rank_ == 0 ? &all : nullptr)) return false;
+
+  std::string grid_blob;
+  if (rank_ == 0) {
+    // Validate homogeneity: every rank reports the same local/cross sizes
+    // and the (local_rank, cross_rank) grid is a complete bijection.
+    bool homogeneous = local_size_ * cross_size_ == size_;
+    std::vector<int> grid(static_cast<std::size_t>(size_), -1);
+    for (int r = 0; r < size_ && homogeneous; ++r) {
+      std::istringstream in(all[r]);
+      int lr, ls, cr, cs;
+      if (!(in >> lr >> ls >> cr >> cs)) {
+        homogeneous = false;
+        break;
+      }
+      if (ls != local_size_ || cs != cross_size_ || lr < 0 ||
+          lr >= local_size_ || cr < 0 || cr >= cross_size_) {
+        homogeneous = false;
+        break;
+      }
+      int cell = cr * local_size_ + lr;
+      if (grid[cell] != -1) {
+        homogeneous = false;
+        break;
+      }
+      grid[cell] = r;
+    }
+    std::ostringstream out;
+    out << (homogeneous ? 1 : 0);
+    if (homogeneous) {
+      for (int g : grid) out << " " << g;
+    }
+    grid_blob = out.str();
+  }
+  if (!BroadcastBlob(&grid_blob)) return false;
+
+  std::istringstream in(grid_blob);
+  int homogeneous = 0;
+  in >> homogeneous;
+  is_homogeneous_ = homogeneous != 0;
+  rank_grid_.clear();
+  if (is_homogeneous_) {
+    rank_grid_.resize(static_cast<std::size_t>(size_));
+    for (int i = 0; i < size_; ++i) in >> rank_grid_[i];
+  }
+  return true;
+}
+
+int TcpContext::RankAt(int local_rank, int cross_rank) const {
+  if (!is_homogeneous_ || local_rank < 0 || local_rank >= local_size_ ||
+      cross_rank < 0 || cross_rank >= cross_size_) {
+    return -1;
+  }
+  return rank_grid_[static_cast<std::size_t>(cross_rank) * local_size_ +
+                    local_rank];
+}
+
+bool TcpContext::ConnectSubRings(int timeout_ms) {
+  const char* addrs_env = std::getenv("HVD_TPU_ADDRS");
+  std::vector<std::string> addrs = SplitString(addrs_env ? addrs_env : "", ',');
+
+  int expected = (local_size_ > 1 ? 1 : 0) + (cross_size_ > 1 ? 1 : 0);
+  std::atomic<bool> accept_ok{true};
+  std::thread acceptor([&] {
+    for (int i = 0; i < expected; ++i) {
+      int peer_rank;
+      Channel channel;
+      int fd = listener_.AcceptPeer(&peer_rank, &channel, timeout_ms);
+      if (fd < 0) {
+        accept_ok.store(false);
+        return;
+      }
+      if (channel == Channel::LOCAL_RING && !local_prev_.valid()) {
+        local_prev_ = Conn(fd);
+      } else if (channel == Channel::CROSS_RING && !cross_prev_.valid()) {
+        cross_prev_ = Conn(fd);
+      } else {
+        LOG(ERROR) << "unexpected sub-ring connection from rank " << peer_rank;
+        accept_ok.store(false);
+        return;
+      }
+    }
+  });
+
+  bool ok = true;
+  if (local_size_ > 1) {
+    int next = RankAt((local_rank_ + 1) % local_size_, cross_rank_);
+    std::string host;
+    int port;
+    ok = ok && next >= 0 && ParseHostPort(addrs[next], &host, &port);
+    if (ok) {
+      local_next_ =
+          ConnectPeer(host, port, rank_, Channel::LOCAL_RING, timeout_ms);
+      ok = local_next_.valid();
+    }
+  }
+  if (ok && cross_size_ > 1) {
+    int next = RankAt(local_rank_, (cross_rank_ + 1) % cross_size_);
+    std::string host;
+    int port;
+    ok = ok && next >= 0 && ParseHostPort(addrs[next], &host, &port);
+    if (ok) {
+      cross_next_ =
+          ConnectPeer(host, port, rank_, Channel::CROSS_RING, timeout_ms);
+      ok = cross_next_.valid();
+    }
+  }
+  acceptor.join();
+  return ok && accept_ok.load();
 }
 
 void TcpContext::Finalize() {
@@ -118,8 +249,183 @@ void TcpContext::Finalize() {
   control_conns_.clear();
   ring_next_.Close();
   ring_prev_.Close();
+  local_next_.Close();
+  local_prev_.Close();
+  cross_next_.Close();
+  cross_prev_.Close();
   listener_.Close();
+  rank_grid_.clear();
+  is_homogeneous_ = false;
   initialized_ = false;
+}
+
+// ---------------- poll-multiplexed control star (rank 0) ----------------
+//
+// The reference's coordinator leans on MPI_Gatherv/MPI_Bcast, which the MPI
+// library parallelizes internally; a naive per-socket loop here would
+// serialize the whole negotiation through rank 0 (the SURVEY §7.3
+// "negotiation latency at 256 chips" wall). These helpers service every
+// worker socket concurrently with one poll loop.
+
+namespace {
+
+struct FrameRecvState {
+  char header[12];
+  std::size_t hoff = 0;
+  std::string payload;
+  std::size_t poff = 0;
+  uint32_t tag = 0;
+  bool have_header = false;
+  bool done = false;
+};
+
+struct FrameSendState {
+  char header[12];
+  std::size_t hoff = 0;
+  const char* payload = nullptr;
+  std::size_t len = 0;
+  std::size_t poff = 0;
+  bool done = false;
+};
+
+}  // namespace
+
+bool TcpContext::MultiRecvFrames(uint32_t expect_tag,
+                                 std::vector<std::string>* blobs) {
+  int n = size_ - 1;  // workers 1..size_-1
+  std::vector<FrameRecvState> st(static_cast<std::size_t>(n));
+  int remaining = n;
+  std::vector<struct pollfd> pfds;
+  std::vector<int> idx;
+  while (remaining > 0) {
+    pfds.clear();
+    idx.clear();
+    for (int i = 0; i < n; ++i) {
+      if (!st[i].done) {
+        pfds.push_back({control_conns_[i + 1].fd(), POLLIN, 0});
+        idx.push_back(i);
+      }
+    }
+    if (::poll(pfds.data(), pfds.size(), 60000) <= 0) {
+      LOG(ERROR) << "control gather poll timeout/error";
+      return false;
+    }
+    for (std::size_t k = 0; k < pfds.size(); ++k) {
+      if (!(pfds[k].revents & (POLLIN | POLLERR | POLLHUP))) continue;
+      int i = idx[k];
+      auto& s = st[i];
+      int fd = control_conns_[i + 1].fd();
+      if (!s.have_header) {
+        ssize_t r = ::recv(fd, s.header + s.hoff, sizeof(s.header) - s.hoff,
+                           MSG_DONTWAIT);
+        if (r == 0) return false;
+        if (r < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+            continue;
+          return false;
+        }
+        s.hoff += static_cast<std::size_t>(r);
+        if (s.hoff == sizeof(s.header)) {
+          uint64_t len;
+          std::memcpy(&s.tag, s.header, 4);
+          std::memcpy(&len, s.header + 4, 8);
+          if (s.tag != expect_tag) {
+            LOG(ERROR) << "control gather: unexpected tag " << s.tag;
+            return false;
+          }
+          s.payload.resize(static_cast<std::size_t>(len));
+          s.have_header = true;
+          if (len == 0) {
+            s.done = true;
+            --remaining;
+          }
+        }
+      }
+      if (s.have_header && !s.done) {
+        ssize_t r = ::recv(fd, &s.payload[s.poff], s.payload.size() - s.poff,
+                           MSG_DONTWAIT);
+        if (r == 0) return false;
+        if (r < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+            continue;
+          return false;
+        }
+        s.poff += static_cast<std::size_t>(r);
+        if (s.poff == s.payload.size()) {
+          s.done = true;
+          --remaining;
+        }
+      }
+    }
+  }
+  if (blobs != nullptr) {
+    for (int i = 0; i < n; ++i) (*blobs)[i + 1] = std::move(st[i].payload);
+  }
+  return true;
+}
+
+bool TcpContext::MultiSendFrames(
+    uint32_t tag,
+    const std::vector<std::pair<const void*, std::size_t>>& payloads) {
+  int n = size_ - 1;
+  std::vector<FrameSendState> st(static_cast<std::size_t>(n));
+  for (int i = 0; i < n; ++i) {
+    auto& s = st[i];
+    uint64_t len = payloads[i].second;
+    std::memcpy(s.header, &tag, 4);
+    std::memcpy(s.header + 4, &len, 8);
+    s.payload = static_cast<const char*>(payloads[i].first);
+    s.len = payloads[i].second;
+  }
+  int remaining = n;
+  std::vector<struct pollfd> pfds;
+  std::vector<int> idx;
+  while (remaining > 0) {
+    pfds.clear();
+    idx.clear();
+    for (int i = 0; i < n; ++i) {
+      if (!st[i].done) {
+        pfds.push_back({control_conns_[i + 1].fd(), POLLOUT, 0});
+        idx.push_back(i);
+      }
+    }
+    if (::poll(pfds.data(), pfds.size(), 60000) <= 0) {
+      LOG(ERROR) << "control bcast poll timeout/error";
+      return false;
+    }
+    for (std::size_t k = 0; k < pfds.size(); ++k) {
+      if (!(pfds[k].revents & (POLLOUT | POLLERR))) continue;
+      int i = idx[k];
+      auto& s = st[i];
+      int fd = control_conns_[i + 1].fd();
+      if (s.hoff < sizeof(s.header)) {
+        ssize_t w = ::send(fd, s.header + s.hoff, sizeof(s.header) - s.hoff,
+                           MSG_NOSIGNAL | MSG_DONTWAIT);
+        if (w < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+            continue;
+          return false;
+        }
+        s.hoff += static_cast<std::size_t>(w);
+        if (s.hoff < sizeof(s.header)) continue;
+      }
+      if (s.poff < s.len) {
+        ssize_t w = ::send(fd, s.payload + s.poff, s.len - s.poff,
+                           MSG_NOSIGNAL | MSG_DONTWAIT);
+        if (w < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+            continue;
+          return false;
+        }
+        s.poff += static_cast<std::size_t>(w);
+      }
+      if (s.poff == s.len) {
+        s.done = true;
+        --remaining;
+      }
+    }
+  }
+  return true;
 }
 
 bool TcpContext::GatherBlobs(const std::string& mine,
@@ -133,14 +439,7 @@ bool TcpContext::GatherBlobs(const std::string& mine,
   if (rank_ == 0) {
     all->assign(size_, std::string());
     (*all)[0] = mine;
-    for (int r = 1; r < size_; ++r) {
-      uint32_t tag;
-      if (!control_conns_[r].RecvFrame(&tag, &(*all)[r]) ||
-          tag != kTagGather) {
-        return false;
-      }
-    }
-    return true;
+    return MultiRecvFrames(kTagGather, all);
   }
   return control_conns_[0].SendFrame(kTagGather, mine);
 }
@@ -148,10 +447,10 @@ bool TcpContext::GatherBlobs(const std::string& mine,
 bool TcpContext::BroadcastBlob(std::string* blob) {
   if (size_ == 1) return true;
   if (rank_ == 0) {
-    for (int r = 1; r < size_; ++r) {
-      if (!control_conns_[r].SendFrame(kTagBcast, *blob)) return false;
-    }
-    return true;
+    std::vector<std::pair<const void*, std::size_t>> payloads(
+        static_cast<std::size_t>(size_ - 1),
+        {blob->data(), blob->size()});
+    return MultiSendFrames(kTagBcast, payloads);
   }
   uint32_t tag;
   return control_conns_[0].RecvFrame(&tag, blob) && tag == kTagBcast;
@@ -161,23 +460,22 @@ bool TcpContext::BitwiseSync(std::vector<uint64_t>& bits, bool is_or) {
   if (size_ == 1) return true;
   std::size_t nbytes = bits.size() * sizeof(uint64_t);
   if (rank_ == 0) {
-    std::vector<uint64_t> peer(bits.size());
+    std::vector<std::string> blobs(static_cast<std::size_t>(size_));
+    if (!MultiRecvFrames(kTagBits, &blobs)) return false;
     for (int r = 1; r < size_; ++r) {
-      uint32_t tag;
-      if (!control_conns_[r].RecvFrameInto(&tag, peer.data(), nbytes) ||
-          tag != kTagBits) {
+      if (blobs[r].size() != nbytes) {
+        LOG(ERROR) << "bit sync size mismatch from rank " << r;
         return false;
       }
+      const uint64_t* peer =
+          reinterpret_cast<const uint64_t*>(blobs[r].data());
       for (std::size_t i = 0; i < bits.size(); ++i) {
         bits[i] = is_or ? (bits[i] | peer[i]) : (bits[i] & peer[i]);
       }
     }
-    for (int r = 1; r < size_; ++r) {
-      if (!control_conns_[r].SendFrame(kTagBits, bits.data(), nbytes)) {
-        return false;
-      }
-    }
-    return true;
+    std::vector<std::pair<const void*, std::size_t>> payloads(
+        static_cast<std::size_t>(size_ - 1), {bits.data(), nbytes});
+    return MultiSendFrames(kTagBits, payloads);
   }
   uint32_t tag;
   return control_conns_[0].SendFrame(kTagBits, bits.data(), nbytes) &&
@@ -185,40 +483,58 @@ bool TcpContext::BitwiseSync(std::vector<uint64_t>& bits, bool is_or) {
          tag == kTagBits;
 }
 
-static constexpr uint32_t kTagData = 0x21;
-
-bool TcpContext::StarSend(int peer, const void* data, std::size_t len) {
-  if (rank_ == 0) {
-    if (peer <= 0 || peer >= size_) return false;
-    return control_conns_[peer].SendFrame(kTagData, data, len);
-  }
-  if (peer != 0) return false;
-  return control_conns_[0].SendFrame(kTagData, data, len);
-}
-
-bool TcpContext::StarRecv(int peer, void* buf, std::size_t len) {
-  uint32_t tag;
-  if (rank_ == 0) {
-    if (peer <= 0 || peer >= size_) return false;
-    return control_conns_[peer].RecvFrameInto(&tag, buf, len) &&
-           tag == kTagData;
-  }
-  if (peer != 0) return false;
-  return control_conns_[0].RecvFrameInto(&tag, buf, len) && tag == kTagData;
-}
-
 bool TcpContext::Barrier() {
   std::vector<uint64_t> bits(1, ~0ull);
   return BitwiseSync(bits, false);
 }
 
-bool TcpContext::RingExchange(const void* send_buf, std::size_t send_len,
-                              void* recv_buf, std::size_t recv_len) {
-  if (size_ == 1) {
+// ---------------- data rings ----------------
+
+int TcpContext::RingRank(Ring ring) const {
+  switch (ring) {
+    case Ring::GLOBAL:
+      return rank_;
+    case Ring::LOCAL:
+      return local_rank_;
+    case Ring::CROSS:
+      return cross_rank_;
+  }
+  return rank_;
+}
+
+int TcpContext::RingSize(Ring ring) const {
+  switch (ring) {
+    case Ring::GLOBAL:
+      return size_;
+    case Ring::LOCAL:
+      return local_size_;
+    case Ring::CROSS:
+      return cross_size_;
+  }
+  return size_;
+}
+
+bool TcpContext::RingExchangeOn(Ring ring, const void* send_buf,
+                                std::size_t send_len, void* recv_buf,
+                                std::size_t recv_len) {
+  Conn* next = &ring_next_;
+  Conn* prev = &ring_prev_;
+  if (ring == Ring::LOCAL) {
+    next = &local_next_;
+    prev = &local_prev_;
+  } else if (ring == Ring::CROSS) {
+    next = &cross_next_;
+    prev = &cross_prev_;
+  }
+  if (RingSize(ring) == 1) {
     if (recv_len > 0 && recv_buf != send_buf) {
       std::memcpy(recv_buf, send_buf, std::min(send_len, recv_len));
     }
     return true;
+  }
+  if (!next->valid() || !prev->valid()) {
+    LOG(ERROR) << "ring exchange on unconnected ring";
+    return false;
   }
   // Frame headers first (blocking, tiny), then pump payloads full-duplex so
   // a ring of simultaneous large sends can't deadlock on socket buffers.
@@ -226,9 +542,9 @@ bool TcpContext::RingExchange(const void* send_buf, std::size_t send_len,
   uint64_t slen = send_len;
   std::memcpy(shdr, &kTagRing, 4);
   std::memcpy(shdr + 4, &slen, 8);
-  if (!ring_next_.SendAll(shdr, 12)) return false;
+  if (!next->SendAll(shdr, 12)) return false;
   char rhdr[12];
-  if (!ring_prev_.RecvAll(rhdr, 12)) return false;
+  if (!prev->RecvAll(rhdr, 12)) return false;
   uint32_t rtag;
   uint64_t rlen;
   std::memcpy(&rtag, rhdr, 4);
@@ -247,11 +563,11 @@ bool TcpContext::RingExchange(const void* send_buf, std::size_t send_len,
     int n = 0;
     int send_idx = -1, recv_idx = -1;
     if (sent < send_len) {
-      pfds[n] = {ring_next_.fd(), POLLOUT, 0};
+      pfds[n] = {next->fd(), POLLOUT, 0};
       send_idx = n++;
     }
     if (received < recv_len) {
-      pfds[n] = {ring_prev_.fd(), POLLIN, 0};
+      pfds[n] = {prev->fd(), POLLIN, 0};
       recv_idx = n++;
     }
     if (::poll(pfds, n, 60000) <= 0) {
@@ -259,7 +575,7 @@ bool TcpContext::RingExchange(const void* send_buf, std::size_t send_len,
       return false;
     }
     if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR))) {
-      ssize_t w = ::send(ring_next_.fd(), sp + sent, send_len - sent,
+      ssize_t w = ::send(next->fd(), sp + sent, send_len - sent,
                          MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
         return false;
@@ -267,13 +583,63 @@ bool TcpContext::RingExchange(const void* send_buf, std::size_t send_len,
       if (w > 0) sent += static_cast<std::size_t>(w);
     }
     if (recv_idx >= 0 && (pfds[recv_idx].revents & (POLLIN | POLLERR))) {
-      ssize_t r = ::recv(ring_prev_.fd(), rp + received, recv_len - received,
+      ssize_t r = ::recv(prev->fd(), rp + received, recv_len - received,
                          MSG_DONTWAIT);
       if (r == 0) return false;
       if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
         return false;
       }
       if (r > 0) received += static_cast<std::size_t>(r);
+    }
+  }
+  return true;
+}
+
+bool TcpContext::RingBroadcast(void* buf, std::size_t len, int root) {
+  if (size_ == 1 || len == 0) return true;
+  int next = (rank_ + 1) % size_;
+  char* p = static_cast<char*>(buf);
+  if (rank_ == root) {
+    // Root only streams downstream (size_ > 1 so next != root).
+    return ring_next_.SendAll(p, len);
+  }
+  // Non-root: stream from the predecessor, forwarding bytes as they arrive
+  // (cut-through, not store-and-forward — total time ~ len/BW + hop latency).
+  bool forward = next != root;
+  std::size_t received = 0, sent = 0;
+  while (received < len || (forward && sent < len)) {
+    struct pollfd pfds[2];
+    int n = 0;
+    int recv_idx = -1, send_idx = -1;
+    if (received < len) {
+      pfds[n] = {ring_prev_.fd(), POLLIN, 0};
+      recv_idx = n++;
+    }
+    if (forward && sent < received) {
+      pfds[n] = {ring_next_.fd(), POLLOUT, 0};
+      send_idx = n++;
+    }
+    if (n == 0) break;
+    if (::poll(pfds, n, 60000) <= 0) {
+      LOG(ERROR) << "ring broadcast poll timeout/error";
+      return false;
+    }
+    if (recv_idx >= 0 && (pfds[recv_idx].revents & (POLLIN | POLLERR))) {
+      ssize_t r = ::recv(ring_prev_.fd(), p + received, len - received,
+                         MSG_DONTWAIT);
+      if (r == 0) return false;
+      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        return false;
+      }
+      if (r > 0) received += static_cast<std::size_t>(r);
+    }
+    if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR))) {
+      ssize_t w = ::send(ring_next_.fd(), p + sent, received - sent,
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        return false;
+      }
+      if (w > 0) sent += static_cast<std::size_t>(w);
     }
   }
   return true;

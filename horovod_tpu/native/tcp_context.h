@@ -1,8 +1,11 @@
 // Owns the process's host-network communicator state: the listener, the
-// control star (worker <-> rank 0) and the data ring (rank i <-> i+1 mod N),
+// control star (worker <-> rank 0), the global data ring (rank i <-> i+1
+// mod N), and — when the topology is homogeneous — a local ring (within
+// one host's ranks) and a cross ring (across hosts at one local_rank),
 // plus rank/local/cross topology read from launcher-injected env.
 //
-// Role parity with /root/reference horovod/common/mpi/mpi_context.{h,cc} and
+// Role parity with /root/reference horovod/common/mpi/mpi_context.{h,cc}
+// (global/local/cross communicator splits, mpi_context.cc:133-165) and
 // gloo/gloo_context.{h,cc} (communicator ownership + rendezvous); transport
 // here is plain TCP with launcher-assigned ports:
 //   HVD_TPU_RANK / HVD_TPU_SIZE / HVD_TPU_LOCAL_RANK / HVD_TPU_LOCAL_SIZE /
@@ -12,6 +15,7 @@
 #define HVD_TPU_TCP_CONTEXT_H
 
 #include <atomic>
+#include <cstdint>
 #include <string>
 #include <thread>
 #include <vector>
@@ -19,6 +23,9 @@
 #include "net.h"
 
 namespace hvdtpu {
+
+// Which ring a neighbor exchange rides.
+enum class Ring { GLOBAL, LOCAL, CROSS };
 
 class TcpContext {
  public:
@@ -35,41 +42,79 @@ class TcpContext {
   int cross_rank() const { return cross_rank_; }
   int cross_size() const { return cross_size_; }
 
+  // True when every rank reported the same local/cross sizes and the
+  // (local_rank, cross_rank) grid is complete — the precondition for the
+  // two-level collectives (reference gates hierarchical ops on
+  // is_homogeneous the same way, mpi_controller.cc:25-81).
+  bool is_homogeneous() const { return is_homogeneous_; }
+  // Local + cross rings exist and two-level ops can run.
+  bool hierarchical_possible() const {
+    return is_homogeneous_ && local_size_ > 1 && cross_size_ > 1;
+  }
+  // Global rank of the peer at (local_rank, cross_rank); -1 if unknown.
+  int RankAt(int local_rank, int cross_rank) const;
+
   // --- control star (coordinator protocol) ---
   // Worker sends its blob to rank 0; rank 0 fills all[r] for r=1..N-1.
+  // Rank 0 services every worker socket concurrently (poll-multiplexed).
   bool GatherBlobs(const std::string& mine, std::vector<std::string>* all);
   bool BroadcastBlob(std::string* blob);
   // Elementwise bitwise AND / OR across ranks (fixed-size u64 vectors).
   bool BitwiseSync(std::vector<uint64_t>& bits, bool is_or);
   bool Barrier();
 
-  // Bulk point-to-point on the control star (workers may only address rank
-  // 0; rank 0 may address anyone). Used by broadcast; safe because ops run
-  // lockstep on the single coordination thread.
-  bool StarSend(int peer, const void* data, std::size_t len);
-  bool StarRecv(int peer, void* buf, std::size_t len);
-
-  // --- data ring (collective ops) ---
-  // Full-duplex neighbor exchange: sends send_len bytes to rank+1 while
-  // receiving recv_len bytes from rank-1, pumping both directions so large
-  // transfers can't deadlock on full socket buffers.
+  // --- data rings (collective ops) ---
+  // Full-duplex neighbor exchange on the chosen ring: sends send_len bytes
+  // to the ring successor while receiving recv_len bytes from the ring
+  // predecessor, pumping both directions so large transfers can't deadlock
+  // on full socket buffers.
   bool RingExchange(const void* send_buf, std::size_t send_len, void* recv_buf,
-                    std::size_t recv_len);
+                    std::size_t recv_len) {
+    return RingExchangeOn(Ring::GLOBAL, send_buf, send_len, recv_buf,
+                          recv_len);
+  }
+  bool RingExchangeOn(Ring ring, const void* send_buf, std::size_t send_len,
+                      void* recv_buf, std::size_t recv_len);
+  // This rank's index / participant count on the given ring.
+  int RingRank(Ring ring) const;
+  int RingSize(Ring ring) const;
+
+  // Chunked pipelined broadcast over the global ring: the root streams
+  // `len` bytes; every other rank receives into `buf` and forwards.
+  // Root passes its source in `buf` too.
+  bool RingBroadcast(void* buf, std::size_t len, int root);
 
  private:
+  bool ExchangeTopology();
+  bool ConnectSubRings(int timeout_ms);
+  // Rank 0: receive one frame from every worker concurrently.
+  bool MultiRecvFrames(uint32_t expect_tag, std::vector<std::string>* blobs);
+  // Rank 0: send per-worker payloads concurrently (all pairs may alias).
+  bool MultiSendFrames(uint32_t tag,
+                       const std::vector<std::pair<const void*, std::size_t>>&
+                           payloads);
+
   int rank_ = 0;
   int size_ = 1;
   int local_rank_ = 0;
   int local_size_ = 1;
   int cross_rank_ = 0;
   int cross_size_ = 1;
+  bool is_homogeneous_ = false;
   bool initialized_ = false;
+
+  // rank_grid_[cross_rank * local_size + local_rank] = global rank.
+  std::vector<int> rank_grid_;
 
   Listener listener_;
   // Rank 0: control_conns_[r] for r=1..N-1; workers: control_conns_[0].
   std::vector<Conn> control_conns_;
-  Conn ring_next_;  // connected to (rank+1) % size
-  Conn ring_prev_;  // accepted from (rank-1+size) % size
+  Conn ring_next_;        // connected to (rank+1) % size
+  Conn ring_prev_;        // accepted from (rank-1+size) % size
+  Conn local_next_;       // successor within my host's local ring
+  Conn local_prev_;
+  Conn cross_next_;       // successor within my local_rank's cross ring
+  Conn cross_prev_;
 };
 
 }  // namespace hvdtpu

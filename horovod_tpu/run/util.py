@@ -90,6 +90,34 @@ def allocate_slots(hosts, np):
     return slots
 
 
+def topology_env(rank, host_ports):
+    """Computes the HVD_TPU_* env for `rank` given every rank's "host:port"
+    (index == rank). Topology semantics shared by the launcher, the Spark
+    barrier tasks and rank-subset init: local = same host, cross = same
+    local_rank across hosts."""
+    size = len(host_ports)
+    hosts = [hp.rsplit(":", 1)[0] for hp in host_ports]
+    by_host = collections.defaultdict(list)
+    for r, h in enumerate(hosts):
+        by_host[h].append(r)
+    my_host = hosts[rank]
+    local_ranks = by_host[my_host]
+    local_rank = local_ranks.index(rank)
+    # cross: hosts that have a rank at this local_rank, ordered by first
+    # appearance.
+    host_order = list(dict.fromkeys(hosts))
+    cross_hosts = [h for h in host_order if len(by_host[h]) > local_rank]
+    return {
+        "HVD_TPU_RANK": str(rank),
+        "HVD_TPU_SIZE": str(size),
+        "HVD_TPU_LOCAL_RANK": str(local_rank),
+        "HVD_TPU_LOCAL_SIZE": str(len(local_ranks)),
+        "HVD_TPU_CROSS_RANK": str(cross_hosts.index(my_host)),
+        "HVD_TPU_CROSS_SIZE": str(len(cross_hosts)),
+        "HVD_TPU_ADDRS": ",".join(host_ports),
+    }
+
+
 def find_free_ports(count, host="127.0.0.1"):
     """Reserves `count` distinct free TCP ports (bind-then-release)."""
     socks = []

@@ -15,7 +15,6 @@ so it is unit-testable without a Spark cluster (the reference mocks its
 shell layer the same way, test/test_spark.py:51-91).
 """
 
-import collections
 import os
 import socket
 
@@ -26,32 +25,9 @@ def _importable(mod):
 
 
 def _task_topology_env(rank, host_ports):
-    """Computes the HVD_TPU_* env for `rank` given every task's
-    "host:port" (index = rank). Same topology semantics as the launcher:
-    local = same host, cross = same local_rank across hosts."""
-    size = len(host_ports)
-    hosts = [hp.rsplit(":", 1)[0] for hp in host_ports]
-    # local_rank: position among ranks on the same host.
-    by_host = collections.defaultdict(list)
-    for r, h in enumerate(hosts):
-        by_host[h].append(r)
-    my_host = hosts[rank]
-    local_ranks = by_host[my_host]
-    local_rank = local_ranks.index(rank)
-    # cross: hosts that have a rank at this local_rank, ordered by first
-    # appearance.
-    host_order = list(dict.fromkeys(hosts))
-    cross_hosts = [h for h in host_order
-                   if len(by_host[h]) > local_rank]
-    return {
-        "HVD_TPU_RANK": str(rank),
-        "HVD_TPU_SIZE": str(size),
-        "HVD_TPU_LOCAL_RANK": str(local_rank),
-        "HVD_TPU_LOCAL_SIZE": str(len(local_ranks)),
-        "HVD_TPU_CROSS_RANK": str(cross_hosts.index(my_host)),
-        "HVD_TPU_CROSS_SIZE": str(len(cross_hosts)),
-        "HVD_TPU_ADDRS": ",".join(host_ports),
-    }
+    """Shared topology computation; see `horovod_tpu.run.util.topology_env`."""
+    from horovod_tpu.run.util import topology_env
+    return topology_env(rank, host_ports)
 
 
 def _free_port():
@@ -74,14 +50,25 @@ def _barrier_task(fn, args, kwargs, extra_env, context=None):
     env = _task_topology_env(rank, host_ports)
     if extra_env:
         env.update(extra_env)
+    # The task does not own this process (Spark reuses python workers,
+    # and tests run the barrier body in-process): restore every mutated
+    # key afterwards so stale topology can't leak into a later init().
+    saved = {k: os.environ.get(k) for k in env}
     os.environ.update(env)
 
     import horovod_tpu as hvd
-    hvd.init()
     try:
-        result = fn(*args, **kwargs)
+        hvd.init()
+        try:
+            result = fn(*args, **kwargs)
+        finally:
+            hvd.shutdown()
     finally:
-        hvd.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     return rank, result
 
 

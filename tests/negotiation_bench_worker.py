@@ -1,0 +1,37 @@
+"""Control-plane negotiation microbenchmark worker: times synchronous tiny
+allreduces, whose cost is dominated by the per-cycle coordinator negotiation
+(gather/bcast or the cached bit-sync), not data movement. Run with
+HVD_TPU_CYCLE_TIME=0 so the cycle pacing sleep doesn't mask the control
+plane. Prints `NEGOTIATION_US_PER_OP <us>` on rank 0."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+    # Zero-element tensor: the negotiation/cycle machinery runs in full but
+    # the ring data phase is skipped, isolating control-plane latency (a
+    # payload allreduce would add the ring's inherent Theta(n) hop latency).
+    x = np.zeros(0, dtype=np.float32)
+    iters = int(os.environ.get("HVD_TPU_BENCH_ITERS", "200"))
+    for i in range(20):  # warmup; also populates the response cache
+        hvd.allreduce(x, "nb")
+    t0 = time.perf_counter()
+    for i in range(iters):
+        hvd.allreduce(x, "nb")
+    dt = time.perf_counter() - t0
+    if r == 0:
+        print("NEGOTIATION_US_PER_OP %.1f" % (dt / iters * 1e6))
+    print("rank %d done" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,41 @@
+"""Rank-subset communicator worker: launched with a 4-rank world env, every
+process calls ``hvd.init(ranks=[1, 3])``. Members form a 2-rank communicator
+and allreduce among themselves; non-members become size-1 self communicators
+and sit out (reference capability: ``hvd.init(comm=[0,1])``,
+`horovod/common/basics.py:29-60`)."""
+
+import os
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+SUBSET = [1, 3]
+
+
+def main():
+    world_rank = int(os.environ["HVD_TPU_RANK"])
+    hvd.init(ranks=SUBSET)
+    if world_rank in SUBSET:
+        assert hvd.size() == len(SUBSET), hvd.size()
+        assert hvd.rank() == SUBSET.index(world_rank), hvd.rank()
+        x = np.full(8, float(world_rank), dtype=np.float32)
+        out = hvd.allreduce(x, "subset_sum")
+        assert np.allclose(out, float(sum(SUBSET))), out
+        b = hvd.broadcast(np.full(4, world_rank, np.int32), 0, "subset_bc")
+        assert np.all(b == SUBSET[0]), b
+        g = hvd.allgather(np.full((2,), world_rank, np.int64), "subset_ag")
+        assert list(g) == [SUBSET[0]] * 2 + [SUBSET[1]] * 2, g
+    else:
+        assert hvd.size() == 1, hvd.size()
+        assert hvd.rank() == 0, hvd.rank()
+        x = np.full(8, 7.0, dtype=np.float32)
+        out = hvd.allreduce(x, "solo")  # size-1 short-circuit: identity
+        assert np.allclose(out, 7.0), out
+    print("worldrank %d: subset test passed" % world_rank)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,96 @@
+"""Hierarchical (two-level) collective tests: 4 ranks on localhost with a
+forced 2-host x 2-slot topology (HVD_TPU_LOCAL_SIZE=2, CROSS_SIZE=2), so the
+local/cross rings and the composite ops run without real multi-host hardware.
+Mirrors the reference's NCCL hierarchical composite
+(`horovod/common/ops/nccl_operations.cc:150-346`) and shared-memory
+hierarchical allgather (`ops/mpi_operations.cc:168-321`) test obligations."""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def run_hierarchical_workers(script, extra_env=None, timeout=300):
+    """Launches 4 copies of `script` with a crafted 2x2 topology: rank r is
+    slot r%2 on "host" r//2."""
+    ports = _free_ports(4)
+    addrs = ",".join("127.0.0.1:%d" % p for p in ports)
+    procs = []
+    for r in range(4):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("JAX_PLATFORMS", None)
+        env["JAX_PLATFORM_NAME"] = "cpu"
+        env.update({
+            "HVD_TPU_RANK": str(r),
+            "HVD_TPU_SIZE": "4",
+            "HVD_TPU_LOCAL_RANK": str(r % 2),
+            "HVD_TPU_LOCAL_SIZE": "2",
+            "HVD_TPU_CROSS_RANK": str(r // 2),
+            "HVD_TPU_CROSS_SIZE": "2",
+            "HVD_TPU_ADDRS": addrs,
+            "HVD_TPU_HIERARCHICAL_ALLREDUCE": "1",
+            "HVD_TPU_HIERARCHICAL_ALLGATHER": "1",
+            "HVD_TPU_SKIP_JIT_TEST": "1",
+        })
+        if extra_env:
+            env.update(extra_env)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", script)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    return procs, outs
+
+
+def test_hierarchical_ops_correct(tmp_path):
+    timeline = str(tmp_path / "hier_timeline.json")
+    procs, outs = run_hierarchical_workers(
+        "distributed_ops_worker.py", {"HVD_TPU_TIMELINE": timeline})
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, "rank %d:\n%s" % (r, out)
+        assert "all distributed op tests passed" in out, out
+    # Prove the hierarchical path actually executed (rank 0's timeline
+    # records per-op activities).
+    with open(timeline) as f:
+        text = f.read()
+    assert "ALLREDUCE_HIERARCHICAL" in text, text[:2000]
+    assert "ALLGATHER_HIERARCHICAL" in text, text[:2000]
+
+
+def test_hierarchical_disabled_uses_flat_ring(tmp_path):
+    timeline = str(tmp_path / "flat_timeline.json")
+    procs, outs = run_hierarchical_workers(
+        "distributed_ops_worker.py",
+        {"HVD_TPU_TIMELINE": timeline,
+         "HVD_TPU_HIERARCHICAL_ALLREDUCE": "0",
+         "HVD_TPU_HIERARCHICAL_ALLGATHER": "0"})
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, "rank %d:\n%s" % (r, out)
+    with open(timeline) as f:
+        text = f.read()
+    assert "ALLREDUCE_HIERARCHICAL" not in text
+    assert "ALLREDUCE_RING" in text

@@ -1,0 +1,55 @@
+"""Rank-subset communicator test: a 4-rank world where only ranks [1, 3]
+form the training communicator (VERDICT round-1 missing item #2; reference
+`horovod/common/basics.py:29-60`)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_rank_subset_allreduce():
+    n = 4
+    ports = _free_ports(n)
+    addrs = ",".join("127.0.0.1:%d" % p for p in ports)
+    procs = []
+    for r in range(n):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.update({
+            "HVD_TPU_RANK": str(r),
+            "HVD_TPU_SIZE": str(n),
+            "HVD_TPU_LOCAL_RANK": str(r),
+            "HVD_TPU_LOCAL_SIZE": str(n),
+            "HVD_TPU_CROSS_RANK": "0",
+            "HVD_TPU_CROSS_SIZE": "1",
+            "HVD_TPU_ADDRS": addrs,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests",
+                                          "rank_subset_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, "world rank %d:\n%s" % (r, out)
+        assert "subset test passed" in out, out
