@@ -3,11 +3,17 @@
 synthetic ImageNet-shaped data, warmup then timed rounds, images/sec).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
+   "step_time_ms": N, "tflops_per_chip": N, "mfu": N, "baseline": "..."}
 
 Baseline: the reference's only published absolute throughput is ResNet-101
 at 1656.82 images/sec over 16 Pascal P100s (`docs/benchmarks.rst:43`) =
-103.55 images/sec/GPU; `vs_baseline` is images/sec/chip over that number.
+103.55 images/sec/GPU; `vs_baseline` is images/sec/chip over that number
+(cross-model when --model != resnet101 — the `baseline` field says so).
+
+MFU honesty: FLOPs per step come from XLA's own cost analysis of the
+compiled train step (not a hand-count), divided by measured step time and
+the chip's peak bf16 FLOP/s.
 """
 
 import argparse
@@ -16,6 +22,35 @@ import sys
 import time
 
 import numpy as np
+
+# Peak bf16 dense FLOP/s per chip, by jax device_kind substring (public
+# TPU spec sheet numbers). Used only for the MFU denominator.
+_PEAK_BF16 = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5", 459e12), ("v4 lite", 138e12), ("v4", 275e12), ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def peak_flops(device):
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_BF16:
+        if key in kind:
+            return val
+    return None
+
+
+def compiled_flops(step, *args):
+    """Per-device FLOPs of the compiled step, from XLA's own cost
+    analysis (no hand-counting)."""
+    try:
+        cost = step.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost["flops"])
+    except Exception as e:  # cost analysis is best-effort diagnostics
+        print("bench: cost_analysis unavailable (%s)" % e, file=sys.stderr)
+        return None
 
 
 def main():
@@ -91,13 +126,34 @@ def main():
 
     total = float(np.mean(rates))
     per_chip = total / n
+    step_time_ms = global_batch / total * 1000.0
+
+    # MFU: XLA-reported per-device FLOPs / measured step time / peak.
+    flops = compiled_flops(step, params_p, opt_state, batch)
+    peak = peak_flops(devices[0])
+    tflops_per_chip = mfu = None
+    if flops:
+        tflops_per_chip = flops / (step_time_ms / 1000.0) / 1e12
+        if peak:
+            mfu = tflops_per_chip * 1e12 / peak
+
     baseline_per_gpu = 1656.82 / 16.0
-    print(json.dumps({
+    out = {
         "metric": "%s_synthetic_images_per_sec_per_chip" % args.model,
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / baseline_per_gpu, 3),
-    }))
+        "baseline": "reference ResNet-101 @ 16xP100, 103.55 img/s/GPU "
+                    "(docs/benchmarks.rst:43)%s" % (
+                        "" if args.model == "resnet101"
+                        else "; cross-model vs %s" % args.model),
+        "step_time_ms": round(step_time_ms, 2),
+    }
+    if tflops_per_chip is not None:
+        out["tflops_per_chip"] = round(tflops_per_chip, 1)
+    if mfu is not None:
+        out["mfu"] = round(mfu, 3)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

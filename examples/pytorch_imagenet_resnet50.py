@@ -1,0 +1,181 @@
+"""Data-parallel ResNet-50 ImageNet training in PyTorch — the reference
+config `examples/pytorch_imagenet_resnet50.py` (BASELINE.json config #3)
+rebuilt for horovod_tpu: DistributedOptimizer with gradient predivide,
+root-rank parameter/optimizer broadcast, epoch-scaled LR warmup, allreduce
+metric averaging, rank-0 checkpointing.
+
+torchvision isn't available in this environment, so the model is a
+self-contained ResNet-50 and training runs on ImageNet-shaped synthetic
+data (swap `synthetic_loader` for a torchvision ImageFolder DataLoader
+with a DistributedSampler to train on real ImageNet).
+
+Run: python -m horovod_tpu.run.run -np 8 -- \
+         python examples/pytorch_imagenet_resnet50.py --epochs 90
+"""
+
+import argparse
+import os
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, in_ch, width, stride=1):
+        super().__init__()
+        out_ch = width * self.expansion
+        self.conv1 = nn.Conv2d(in_ch, width, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.conv2 = nn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(width)
+        self.conv3 = nn.Conv2d(width, out_ch, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(out_ch)
+        self.down = None
+        if stride != 1 or in_ch != out_ch:
+            self.down = nn.Sequential(
+                nn.Conv2d(in_ch, out_ch, 1, stride, bias=False),
+                nn.BatchNorm2d(out_ch))
+
+    def forward(self, x):
+        identity = x if self.down is None else self.down(x)
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = F.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        return F.relu(out + identity)
+
+
+class ResNet50(nn.Module):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2d(3, 64, 7, 2, 3, bias=False), nn.BatchNorm2d(64),
+            nn.ReLU(inplace=True), nn.MaxPool2d(3, 2, 1))
+        chans, layers = [64, 128, 256, 512], [3, 4, 6, 3]
+        stages, in_ch = [], 64
+        for i, (width, n) in enumerate(zip(chans, layers)):
+            for j in range(n):
+                stages.append(Bottleneck(in_ch, width,
+                                         stride=2 if i > 0 and j == 0 else 1))
+                in_ch = width * Bottleneck.expansion
+        self.stages = nn.Sequential(*stages)
+        self.fc = nn.Linear(in_ch, num_classes)
+
+    def forward(self, x):
+        x = self.stages(self.stem(x))
+        x = torch.flatten(F.adaptive_avg_pool2d(x, 1), 1)
+        return self.fc(x)
+
+
+def synthetic_loader(batch_size, num_batches, num_classes, image_size, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(num_batches):
+        x = torch.from_numpy(
+            rng.randn(batch_size, 3, image_size, image_size)
+            .astype(np.float32))
+        y = torch.from_numpy(
+            rng.randint(0, num_classes, size=batch_size).astype(np.int64))
+        yield x, y
+
+
+def adjust_lr(optimizer, base_lr, epoch, batch_idx, batches_per_epoch,
+              warmup_epochs):
+    """Reference LR schedule: linear warmup to base_lr * hvd.size() over
+    `warmup_epochs`, then /10 at epochs 30/60/80
+    (reference pytorch_imagenet_resnet50.py adjust_learning_rate)."""
+    if epoch < warmup_epochs:
+        progress = (batch_idx + epoch * batches_per_epoch) / (
+            warmup_epochs * batches_per_epoch)
+        lr_adj = progress * (hvd.size() - 1) / hvd.size() + 1.0 / hvd.size()
+    elif epoch < 30:
+        lr_adj = 1.0
+    elif epoch < 60:
+        lr_adj = 1e-1
+    elif epoch < 80:
+        lr_adj = 1e-2
+    else:
+        lr_adj = 1e-3
+    for group in optimizer.param_groups:
+        group["lr"] = base_lr * hvd.size() * lr_adj
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--batches-per-epoch", type=int, default=4,
+                    help="synthetic batches per epoch per rank")
+    ap.add_argument("--num-classes", type=int, default=100)
+    ap.add_argument("--image-size", type=int, default=64,
+                    help="224 for the full ImageNet shape")
+    ap.add_argument("--base-lr", type=float, default=0.0125)
+    ap.add_argument("--warmup-epochs", type=int, default=1)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--wd", type=float, default=5e-5)
+    ap.add_argument("--checkpoint-dir", default=None)
+    args = ap.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42 + hvd.rank())
+    torch.set_num_threads(max(1, (os.cpu_count() or 4) // hvd.local_size()))
+
+    model = ResNet50(num_classes=args.num_classes)
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.base_lr * hvd.size(),
+                                momentum=args.momentum,
+                                weight_decay=args.wd)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    # Consistent start: root's params/opt state everywhere (the
+    # reference's broadcast_parameters/broadcast_optimizer_state pattern).
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    for epoch in range(args.epochs):
+        model.train()
+        t0 = time.time()
+        seen = 0
+        loader = synthetic_loader(args.batch_size, args.batches_per_epoch,
+                                  args.num_classes, args.image_size,
+                                  seed=1000 * epoch + hvd.rank())
+        for batch_idx, (x, y) in enumerate(loader):
+            adjust_lr(optimizer, args.base_lr, epoch, batch_idx,
+                      args.batches_per_epoch, args.warmup_epochs)
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            optimizer.step()
+            seen += x.shape[0]
+        # Cross-rank metric averaging (reference: Metric/metric_average).
+        avg_loss = hvd.allreduce(loss.detach(), average=True,
+                                 name="epoch_loss").item()
+        rate = seen / (time.time() - t0)
+        if hvd.rank() == 0:
+            print("epoch %d: loss %.4f, %.1f img/s/rank (x%d ranks)"
+                  % (epoch, avg_loss, rate, hvd.size()), flush=True)
+            if args.checkpoint_dir:
+                os.makedirs(args.checkpoint_dir, exist_ok=True)
+                torch.save(
+                    {"model": model.state_dict(),
+                     "optimizer": optimizer.state_dict(), "epoch": epoch},
+                    os.path.join(args.checkpoint_dir,
+                                 "checkpoint-%d.pt" % epoch))
+
+    # Final consistency check: trained params must agree across ranks
+    # (BN running stats stay rank-local, like the reference).
+    for name, p in sorted(dict(model.named_parameters()).items()):
+        avg = hvd.allreduce(p.detach(), average=True, name="final.%s" % name)
+        assert torch.allclose(avg, p, atol=1e-5), name
+    if hvd.rank() == 0:
+        print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

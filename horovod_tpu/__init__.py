@@ -81,6 +81,33 @@ def _remap_subset_env(ranks):
     os.environ.update(topology_env(list(ranks).index(world_rank), sub_addrs))
 
 
+def _maybe_rendezvous():
+    """Dynamic rendezvous: when the launcher supplied only
+    ``HVD_TPU_RENDEZVOUS_ADDR`` (no pre-assigned ``HVD_TPU_ADDRS``), bind
+    a port on this host, publish it, fetch the peer table and derive the
+    topology env. Reference analogue: the Gloo HTTP rendezvous
+    (`horovod/run/rendezvous/http_server.py:33-205`)."""
+    import os
+
+    if os.environ.get("HVD_TPU_ADDRS"):
+        return
+    rdv_addr = os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+    if not rdv_addr:
+        return
+    size = int(os.environ.get("HVD_TPU_SIZE", "1"))
+    if size <= 1:
+        return
+    if "HVD_TPU_RANK" not in os.environ:
+        raise RuntimeError(
+            "HVD_TPU_RENDEZVOUS_ADDR and HVD_TPU_SIZE are set but "
+            "HVD_TPU_RANK is missing; the launcher must inject all three "
+            "(check ssh env forwarding)")
+    rank = int(os.environ["HVD_TPU_RANK"])
+    timeout = float(os.environ.get("HVD_TPU_START_TIMEOUT", "60"))
+    from .run import rendezvous as _rdv
+    os.environ.update(_rdv.resolve_topology(rank, size, rdv_addr, timeout))
+
+
 def init(ranks=None):
     """Initializes the core runtime (rendezvous + background thread).
 
@@ -94,6 +121,8 @@ def init(ranks=None):
     Reference analogue: ``hvd.init()`` -> ``horovod/common/basics.py:29-60``.
     """
     global _initialized_here, _world_env
+    if not is_initialized():
+        _maybe_rendezvous()
     if ranks is not None and len(ranks) > 0:
         _remap_subset_env(ranks)
     elif _world_env is not None:

@@ -58,6 +58,17 @@ def _multi_process():
     return _hvd.is_initialized() and _hvd.size() > 1
 
 
+def _require_init_traced():
+    """A collective traced in plain jit (no mapped axis) before ``init()``
+    must fail loudly — silently degrading to identity would let a
+    multi-process program train unsynchronized. (The in-jit mapped-axis
+    plane needs no init: it is pure XLA.)"""
+    if not _hvd.is_initialized():
+        raise RuntimeError(
+            "horovod_tpu collective used inside jit before hvd.init(); "
+            "call init() first (single-process size-1 init is fine)")
+
+
 def _host_callback(fn, tensor):
     """Routes a traced tensor through the host core from inside jit.
 
@@ -138,6 +149,7 @@ def allreduce(tensor, average=True, name=None, axis_name=AXIS_NAME,
             compressed, ctx = compression.compress(tensor)
             return compression.decompress(
                 _host_callback(_cb, compressed), ctx)
+        _require_init_traced()
         # Single process: allreduce is identity up to scaling.
         scale = prescale_factor * postscale_factor
         return tensor * scale if scale != 1.0 else tensor
@@ -172,6 +184,7 @@ def allgather(tensor, name=None, axis_name=AXIS_NAME):
             shape = (tensor.shape[0] * _hvd.size(),) + tuple(tensor.shape[1:])
             out_shape = jax.ShapeDtypeStruct(shape, tensor.dtype)
             return io_callback(_cb, out_shape, tensor, ordered=True)
+        _require_init_traced()
         return tensor
     arr = np.asarray(tensor)
     out = _ops.allgather(arr, name or _auto_name("allgather"))
@@ -182,9 +195,14 @@ def broadcast(tensor, root_rank=0, name=None, axis_name=AXIS_NAME):
     """Broadcasts the root rank's tensor to every rank."""
     if _is_traced(tensor):
         if _axis_in_scope(axis_name):
-            # In-jit: select the root's shard and distribute it.
-            src = jax.lax.all_gather(tensor, axis_name)
-            return jax.tree_util.tree_map(lambda x: x[root_rank], src)
+            # In-jit: mask every rank but the root to zero and psum — XLA
+            # lowers this to a select+AllReduce with O(tensor) memory per
+            # rank, vs. the N x tensor an all_gather would materialize.
+            idx = jax.lax.axis_index(axis_name)
+            masked = jnp.where(idx == root_rank, tensor,
+                               jnp.zeros_like(tensor))
+            # psum promotes bool to int32; cast back (no-op otherwise).
+            return jax.lax.psum(masked, axis_name).astype(tensor.dtype)
         if _multi_process():
             op_name = name or _auto_name("broadcast")
 
@@ -193,6 +211,7 @@ def broadcast(tensor, root_rank=0, name=None, axis_name=AXIS_NAME):
                     np.asarray(arr), root_rank, op_name)).astype(arr.dtype)
 
             return _host_callback(_cb, tensor)
+        _require_init_traced()
         return tensor
     arr = np.asarray(tensor)
     out = _ops.broadcast(arr, root_rank, name or _auto_name("broadcast"))

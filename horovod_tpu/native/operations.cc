@@ -376,9 +376,12 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
   entry.postscale_factor = postscale;
   entry.callback = [handle](const Status& status,
                             const TensorTableEntry& done_entry) {
+    LOG(TRACE) << "done " << done_entry.tensor_name << " handle " << handle
+               << " status " << static_cast<int>(status.type());
     g_handles.MarkDone(handle, status, done_entry.gathered,
                        done_entry.gathered_sizes);
   };
+  LOG(TRACE) << "enqueue " << name << " handle " << handle;
   return g_state.tensor_queue.AddToTensorQueue(std::move(entry),
                                                std::move(message));
 }

@@ -1,0 +1,204 @@
+"""Dynamic rendezvous — launcher-hosted HTTP KV store + worker client.
+
+The launcher starts :class:`RendezvousServer` and hands every worker just
+``HVD_TPU_RENDEZVOUS_ADDR`` (+ rank/size). Each worker binds a free port
+on its own host, publishes ``rank -> ip:port``, then polls until the full
+peer table is present and derives its local/cross topology from it. This
+replaces pre-assigned port tables (the fixed ``29500+i`` scheme) with
+worker-chosen ports, the way the reference's Gloo path does it
+(capability parity with /root/reference horovod/run/rendezvous/
+http_server.py:33-205 and horovod/common/gloo/http_store.cc:1-134;
+fresh implementation over the Python stdlib http server).
+
+Protocol (scoped KV, values are opaque bytes):
+  PUT  /set/<scope>/<key>   body = value         -> 200
+  GET  /get/<scope>/<key>                        -> 200 value | 404
+  GET  /list/<scope>                             -> 200 JSON {key: utf8 value}
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+MAX_VALUE_BYTES = 1 << 20
+
+SCOPE_ADDRS = "addrs"
+
+
+class RendezvousServer:
+    """Threaded HTTP KV server; one per launcher process."""
+
+    def __init__(self, host="0.0.0.0", port=0):
+        self._store = {}  # (scope, key) -> bytes
+        self._lock = threading.Lock()
+        store, lock = self._store, self._lock
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _reply(self, code, body=b"",
+                       ctype="application/octet-stream"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_PUT(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) != 3 or parts[0] != "set":
+                    return self._reply(400, b"bad path")
+                length = int(self.headers.get("Content-Length", 0))
+                if length > MAX_VALUE_BYTES:
+                    return self._reply(413, b"value too large")
+                value = self.rfile.read(length)
+                with lock:
+                    store[(parts[1], parts[2])] = value
+                self._reply(200)
+
+            do_POST = do_PUT
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 3 and parts[0] == "get":
+                    with lock:
+                        value = store.get((parts[1], parts[2]))
+                    if value is None:
+                        return self._reply(404, b"not found")
+                    return self._reply(200, value)
+                if len(parts) == 2 and parts[0] == "list":
+                    with lock:
+                        scoped = {k: v.decode("utf-8", "replace")
+                                  for (s, k), v in store.items()
+                                  if s == parts[1]}
+                    return self._reply(200, json.dumps(scoped).encode(),
+                                       "application/json")
+                self._reply(400, b"bad path")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="hvd-tpu-rendezvous")
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Client side (workers)
+
+def put(addr, scope, key, value):
+    if isinstance(value, str):
+        value = value.encode()
+    req = urllib.request.Request("http://%s/set/%s/%s" % (addr, scope, key),
+                                 data=value, method="PUT")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        if resp.status != 200:
+            raise RuntimeError("rendezvous PUT failed: HTTP %d" % resp.status)
+
+
+def get(addr, scope, key):
+    try:
+        with urllib.request.urlopen(
+                "http://%s/get/%s/%s" % (addr, scope, key),
+                timeout=10) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def list_scope(addr, scope):
+    with urllib.request.urlopen("http://%s/list/%s" % (addr, scope),
+                                timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def wait_all(addr, scope, keys, timeout, poll_interval=0.1):
+    """Polls until every key in `keys` is present; returns {key: str}."""
+    deadline = time.monotonic() + timeout
+    keys = [str(k) for k in keys]
+    while True:
+        try:
+            table = list_scope(addr, scope)
+        except (urllib.error.URLError, ConnectionError, socket.timeout) as e:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "rendezvous server at %s unreachable: %s" % (addr, e))
+            table = {}
+        missing = [k for k in keys if k not in table]
+        if not missing:
+            return table
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                "rendezvous timed out after %.0fs waiting for %d/%d "
+                "workers (missing ranks: %s...). A worker likely failed "
+                "to start — check its log." %
+                (timeout, len(missing), len(keys),
+                 ",".join(missing[:8])))
+        time.sleep(poll_interval)
+
+
+def routable_ip(peer_host, peer_port=80):
+    """The local IP the kernel routes toward `peer_host` (UDP connect
+    trick — no packet is sent). Falls back through getfqdn to hostname."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect((peer_host, peer_port or 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        for name in (socket.getfqdn(), socket.gethostname()):
+            try:
+                return socket.gethostbyname(name)
+            except OSError:
+                continue
+        return "127.0.0.1"
+
+
+def reserve_port():
+    """Binds an ephemeral port and releases it (the native listener
+    re-binds it within milliseconds of init)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def resolve_topology(rank, size, rendezvous_addr, timeout=60):
+    """Worker-side rendezvous: publish my address, fetch the peer table,
+    derive the HVD_TPU_* topology env (index == rank)."""
+    from .util import topology_env
+
+    host = rendezvous_addr.rsplit(":", 1)[0]
+    port = int(rendezvous_addr.rsplit(":", 1)[1])
+    my_ip = routable_ip(host, port)
+    my_port = reserve_port()
+    put(rendezvous_addr, SCOPE_ADDRS, str(rank),
+        "%s:%d" % (my_ip, my_port))
+    table = wait_all(rendezvous_addr, SCOPE_ADDRS, range(size), timeout)
+    addrs = [table[str(r)] for r in range(size)]
+    return topology_env(rank, addrs)

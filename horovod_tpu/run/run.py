@@ -1,25 +1,27 @@
 """``horovodrun_tpu`` — the launcher.
 
-Starts N copies of a training script with rank/local/cross topology and
-rendezvous env injected, the way the reference ``horovodrun`` does for its
-Gloo path (/root/reference horovod/run/run.py:379-508 + gloo_run.py:156-233):
-local slots via subprocess, remote slots via ssh, TPU pod slices via
-metadata auto-discovery. SIGINT/SIGTERM fan out to every launched process.
+Starts N copies of a training script, the way the reference ``horovodrun``
+does for its Gloo path (/root/reference horovod/run/run.py:379-508 +
+gloo_run.py:156-233): local slots via subprocess, remote slots via ssh
+(after a reachability preflight, ref run/run.py:53-106), TPU pod slices
+via metadata auto-discovery. SIGINT/SIGTERM fan out to every launched
+process.
 
-Env injected per rank:
-  HVD_TPU_RANK / HVD_TPU_SIZE / HVD_TPU_LOCAL_RANK / HVD_TPU_LOCAL_SIZE /
-  HVD_TPU_CROSS_RANK / HVD_TPU_CROSS_SIZE / HVD_TPU_ADDRS
+Rendezvous is dynamic by default: the launcher hosts a KV server and
+injects only HVD_TPU_RANK / HVD_TPU_SIZE / HVD_TPU_RENDEZVOUS_ADDR;
+every worker binds its own free port, publishes it, and derives the
+local/cross topology from the published peer table (see rendezvous.py).
+``--start-port`` switches to a static pre-assigned port table.
 """
 
 import argparse
 import os
 import shlex
 import signal
-import socket
 import subprocess
 import sys
 
-from . import util
+from . import rendezvous, util
 
 
 def check_build(out=sys.stdout):
@@ -116,11 +118,46 @@ def build_env(slot, addrs, base_env=None):
     return env
 
 
-def launch(slots, addrs, command, ssh_port=None, verbose=False, env=None):
+def ssh_preflight(hostnames, ssh_port=None, timeout=5):
+    """Verifies every remote host is reachable over non-interactive ssh
+    before launching anything (reference: run/run.py:53-106). Raises with
+    an actionable message listing the unreachable hosts."""
+    import concurrent.futures
+
+    def probe(host):
+        cmd = ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
+               "-o", "ConnectTimeout=%d" % timeout]
+        if ssh_port:
+            cmd += ["-p", str(ssh_port)]
+        cmd += [host, "true"]
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=timeout + 10)
+            return host, r.returncode, r.stderr.strip()
+        except (subprocess.TimeoutExpired, OSError) as e:
+            return host, 255, str(e)
+
+    failures = []
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(32, len(hostnames))) as pool:
+        for host, rc, err in pool.map(probe, hostnames):
+            if rc != 0:
+                failures.append((host, err))
+    if failures:
+        detail = "\n".join("  %s: %s" % (h, e or "ssh exited nonzero")
+                           for h, e in failures)
+        raise RuntimeError(
+            "ssh preflight failed for %d host(s):\n%s\n"
+            "Ensure passwordless (key-based) ssh to every host in -H/"
+            "--hostfile works from this machine, e.g. "
+            "`ssh -o BatchMode=yes %s true`." %
+            (len(failures), detail, failures[0][0]))
+
+
+def launch(slots, rank_envs, command, ssh_port=None, verbose=False):
     """Launches one process per slot; returns the list of Popens."""
     procs = []
-    for slot in slots:
-        rank_env = build_env(slot, addrs, env)
+    for slot, rank_env in zip(slots, rank_envs):
         if util.is_local_host(slot.hostname):
             if verbose:
                 sys.stderr.write("[launcher] rank %d local: %s\n" %
@@ -154,23 +191,53 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
     slots = util.allocate_slots(host_list, np)
 
     all_local = all(util.is_local_host(s.hostname) for s in slots)
-    if start_port:
-        ports = [start_port + i for i in range(np)]
-    elif all_local:
-        ports = util.find_free_ports(np)
-    else:
-        ports = [29500 + i for i in range(np)]
-    # Local slots must be advertised with an address the *other hosts* can
-    # reach; 127.0.0.1 is only valid when every slot is local.
-    local_addr = "127.0.0.1" if all_local else socket.gethostname()
-    addrs = ["%s:%d" % (slot.hostname if not util.is_local_host(slot.hostname)
-                        else local_addr, port)
-             for slot, port in zip(slots, ports)]
+    remote_hosts = sorted({s.hostname for s in slots
+                           if not util.is_local_host(s.hostname)})
+    if remote_hosts:
+        ssh_preflight(remote_hosts, ssh_port=ssh_port)
 
     base_env = dict(env if env is not None else os.environ)
     base_env.setdefault("HVD_TPU_START_TIMEOUT", str(start_timeout))
-    procs = launch(slots, addrs, command, ssh_port=ssh_port, verbose=verbose,
-                   env=base_env)
+
+    # Local slots must be advertised with an address the *other hosts*
+    # can reach; 127.0.0.1 is only valid when every slot is local.
+    local_addr = ("127.0.0.1" if all_local
+                  else rendezvous.routable_ip(remote_hosts[0]))
+
+    server = None
+    if start_port:
+        # Static pre-assigned port table (compat path).
+        ports = [start_port + i for i in range(np)]
+        addrs = ["%s:%d" % (slot.hostname
+                            if not util.is_local_host(slot.hostname)
+                            else local_addr, port)
+                 for slot, port in zip(slots, ports)]
+        rank_envs = [build_env(slot, addrs, base_env) for slot in slots]
+    elif np == 1:
+        rank_envs = [build_env(slots[0], ["127.0.0.1:0"], base_env)]
+    else:
+        # Dynamic rendezvous: workers pick their own ports and publish
+        # them to the launcher-hosted KV server.
+        server = rendezvous.RendezvousServer()
+        rdv_addr = "%s:%d" % (local_addr, server.start())
+        rank_envs = []
+        for slot in slots:
+            rank_env = dict(base_env)
+            # A stale address table in the caller's env must not bypass
+            # the rendezvous the workers are about to perform.
+            for key in ("HVD_TPU_ADDRS", "HVD_TPU_LOCAL_RANK",
+                        "HVD_TPU_LOCAL_SIZE", "HVD_TPU_CROSS_RANK",
+                        "HVD_TPU_CROSS_SIZE"):
+                rank_env.pop(key, None)
+            rank_env.update({
+                "HVD_TPU_RANK": str(slot.rank),
+                "HVD_TPU_SIZE": str(slot.size),
+                "HVD_TPU_RENDEZVOUS_ADDR": rdv_addr,
+            })
+            rank_envs.append(rank_env)
+
+    procs = launch(slots, rank_envs, command, ssh_port=ssh_port,
+                   verbose=verbose)
 
     def kill_all(signum, frame):
         for p in procs:
@@ -200,6 +267,8 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
     finally:
         signal.signal(signal.SIGINT, old_int)
         signal.signal(signal.SIGTERM, old_term)
+        if server is not None:
+            server.stop()
 
 
 def main(argv=None):

@@ -118,22 +118,5 @@ def topology_env(rank, host_ports):
     }
 
 
-def find_free_ports(count, host="127.0.0.1"):
-    """Reserves `count` distinct free TCP ports (bind-then-release)."""
-    socks = []
-    ports = []
-    try:
-        for _ in range(count):
-            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            s.bind((host, 0))
-            socks.append(s)
-            ports.append(s.getsockname()[1])
-    finally:
-        for s in socks:
-            s.close()
-    return ports
-
-
 def is_local_host(hostname):
     return hostname in ("localhost", "127.0.0.1", socket.gethostname())

@@ -31,12 +31,8 @@ def _task_topology_env(rank, host_ports):
 
 
 def _free_port():
-    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    try:
-        s.bind(("0.0.0.0", 0))
-        return s.getsockname()[1]
-    finally:
-        s.close()
+    from horovod_tpu.run.rendezvous import reserve_port
+    return reserve_port()
 
 
 def _barrier_task(fn, args, kwargs, extra_env, context=None):

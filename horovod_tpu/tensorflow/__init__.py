@@ -6,11 +6,15 @@ IndexedSlices sparse path + ``sparse_as_dense``), ``allgather``,
 ``broadcast``, ``broadcast_variables``, ``DistributedGradientTape``,
 ``DistributedOptimizer`` (Keras-3 optimizers), ``Compression``.
 
-Tensors ride the native host core (negotiation/fusion/cache) via numpy —
-the reference's CPU custom-op path (`horovod/tensorflow/mpi_ops.cc`)
-without a compiled TF kernel: eager tensors convert directly, graph mode
-goes through ``tf.py_function``. For TPU-resident XLA training use the
-jax binding; this binding is the TF-on-host-CPU compatibility surface.
+Tensors ride the native host core (negotiation/fusion/cache). The default
+path is a compiled TF custom-op kernel (``native/tf_ops.cc``, built on
+first use — the reference's `horovod/tensorflow/mpi_ops.cc` shape):
+collectives are real graph nodes with registered gradients
+(``mpi_ops.py``), so they compose with ``tf.function``, ``tf.gradients``
+and SavedModel export. If the kernel library can't build/load, collectives
+fall back to ``tf.py_function`` (eager-compatible, not differentiable
+through the collective). For TPU-resident XLA training use the jax
+binding; this binding is the TF-on-host-CPU compatibility surface.
 """
 
 import tensorflow as tf
@@ -23,6 +27,7 @@ from horovod_tpu import (  # noqa: F401
 from horovod_tpu.common import ops as _ops
 from horovod_tpu.common.ops import HorovodInternalError  # noqa: F401
 
+from . import mpi_ops as _mpi_ops
 from .compression import Compression  # noqa: F401
 
 _name_counter = [0]
@@ -33,9 +38,14 @@ def _auto_name(prefix):
     return "%s.tf%d" % (prefix, _name_counter[0])
 
 
+def native_ops_available():
+    """True when collectives run as compiled TF graph kernels."""
+    return _mpi_ops.native_ops_available()
+
+
 def _py_collective(fn, tensor, name):
-    """Runs `fn(numpy) -> numpy` on a tf tensor, eagerly or via
-    tf.py_function inside tf.function graphs."""
+    """py_function fallback: runs `fn(numpy) -> numpy` on a tf tensor,
+    eagerly or via tf.py_function inside tf.function graphs."""
     if tf.inside_function():
         out = tf.py_function(lambda t: fn(t.numpy()), [tensor],
                              Tout=tensor.dtype, name=name)
@@ -64,6 +74,11 @@ def allreduce(tensor, average=True, name=None, compression=Compression.none,
                                     dense_shape=tensor.dense_shape)
     op_name = name or _auto_name("allreduce")
     compressed, ctx = compression.compress(tensor)
+    if _mpi_ops.native_ops_available():
+        out = _mpi_ops.allreduce(
+            tf.convert_to_tensor(compressed), op_name, average=average,
+            prescale=prescale_factor, postscale=postscale_factor)
+        return compression.decompress(out, ctx)
     post = postscale_factor / size() if average else postscale_factor
 
     def _do(arr):
@@ -76,6 +91,8 @@ def allreduce(tensor, average=True, name=None, compression=Compression.none,
 
 def allgather(tensor, name=None):
     op_name = name or _auto_name("allgather")
+    if _mpi_ops.native_ops_available():
+        return _mpi_ops.allgather(tf.convert_to_tensor(tensor), op_name)
     if tf.inside_function():
         out = tf.py_function(
             lambda t: _ops.allgather(t.numpy(), op_name), [tensor],
@@ -88,6 +105,9 @@ def allgather(tensor, name=None):
 
 def broadcast(tensor, root_rank=0, name=None):
     op_name = name or _auto_name("broadcast")
+    if _mpi_ops.native_ops_available():
+        return _mpi_ops.broadcast(tf.convert_to_tensor(tensor), root_rank,
+                                  op_name)
     return _py_collective(
         lambda arr: _ops.broadcast(arr, root_rank, op_name), tensor,
         op_name.replace(".", "_"))

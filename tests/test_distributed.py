@@ -4,6 +4,8 @@ strategy in SURVEY.md §4). The `run_launcher` harness lives in conftest.py."""
 
 import pytest
 
+pytestmark = pytest.mark.e2e
+
 
 @pytest.mark.parametrize("np_", [2, 4])
 def test_distributed_ops(run_launcher, np_):

@@ -10,6 +10,10 @@ import sys
 
 import pytest
 
+# Slow tier: each test launches a 2-process training job (see pytest.ini;
+# run with `pytest tests/ -m examples`).
+pytestmark = pytest.mark.examples
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -45,6 +49,22 @@ def test_jax_mnist_example():
 def test_jax_word2vec_example():
     proc = run_example(2, "jax_word2vec.py",
                        ["--steps", "20", "--vocab-size", "500"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "done" in proc.stdout
+
+
+def test_pytorch_imagenet_resnet50_example():
+    proc = run_example(2, "pytorch_imagenet_resnet50.py",
+                       ["--epochs", "1", "--batches-per-epoch", "2",
+                        "--batch-size", "8", "--image-size", "64"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "done" in proc.stdout
+
+
+def test_keras_spark_rossmann_example():
+    proc = run_example(2, "keras_spark_rossmann.py",
+                       ["--local", "--epochs", "1",
+                        "--rows-per-rank", "256"])
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "done" in proc.stdout
 

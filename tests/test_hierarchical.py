@@ -5,10 +5,14 @@ Mirrors the reference's NCCL hierarchical composite
 (`horovod/common/ops/nccl_operations.cc:150-346`) and shared-memory
 hierarchical allgather (`ops/mpi_operations.cc:168-321`) test obligations."""
 
+import pytest
+
 import os
 import socket
 import subprocess
 import sys
+
+pytestmark = pytest.mark.e2e
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

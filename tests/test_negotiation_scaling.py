@@ -4,11 +4,15 @@ per-cycle latency roughly flat as rank count grows (SURVEY §7.3's
 scaled linearly). Workers are numpy+ctypes only, so launching 16 locally is
 cheap."""
 
+import pytest
+
 import os
 import re
 import socket
 import subprocess
 import sys
+
+pytestmark = pytest.mark.e2e
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -2,7 +2,11 @@
 test/test_timeline.py, test/test_stall.py). The `run_launcher` harness
 lives in conftest.py."""
 
+import pytest
+
 import json
+
+pytestmark = pytest.mark.e2e
 
 
 def test_timeline(run_launcher, tmp_path):

@@ -68,6 +68,44 @@ def test_flash_fallback_and_grads():
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_flash_kernel_unequal_blocks_interpret():
+    """The production default tiling (bq=256, bk=512 at L>=512) uses
+    unequal q/k blocks whose causal straddle-mask arithmetic differs from
+    the square case — pin it numerically (interpret mode, L=1024)."""
+    from horovod_tpu.ops.flash_attention import _pallas_forward
+    B, L, H, D = 1, 1024, 1, 32
+    q, k, v = _rand_qkv(B, L, H, D, seed=7)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = _pallas_forward(qt, kt, vt, D ** -0.5, True, interpret=True,
+                          block_q=256, block_k=512).transpose(0, 2, 1, 3)
+    expected = _dense(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_fallback_tail_block():
+    """L not a multiple of BLOCK_Q (192 = 128 + 64 tail): the blockwise
+    fallback must cover the remainder, full shape, values AND grads."""
+    from horovod_tpu.ops import flash_attention
+    B, L, H, D = 1, 192, 2, 16
+    q, k, v = _rand_qkv(B, L, H, D, seed=5)
+
+    out = flash_attention(q, k, v, causal=True)
+    assert out.shape == (B, L, H, D)
+    expected = _dense(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+    g_flash = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(
+        q, k, v)
+    g_dense = jax.grad(lambda q, k, v: jnp.sum(
+        _dense(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_transformer_flash_matches_dense():
     from horovod_tpu.models import Transformer, TransformerConfig
     base = dict(vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,

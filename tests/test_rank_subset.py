@@ -2,10 +2,14 @@
 form the training communicator (VERDICT round-1 missing item #2; reference
 `horovod/common/basics.py:29-60`)."""
 
+import pytest
+
 import os
 import socket
 import subprocess
 import sys
+
+pytestmark = pytest.mark.e2e
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -1,0 +1,80 @@
+"""Dynamic rendezvous tests: the KV server protocol, worker-side topology
+resolution with worker-chosen ports, and the launcher e2e path (reference
+analogue: horovod/run/rendezvous/http_server.py + gloo http_store)."""
+
+import threading
+
+import pytest
+
+from horovod_tpu.run import rendezvous
+
+
+@pytest.fixture
+def server():
+    s = rendezvous.RendezvousServer(host="127.0.0.1")
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_kv_put_get_list(server):
+    addr = "127.0.0.1:%d" % server.port
+    assert rendezvous.get(addr, "s", "k") is None
+    rendezvous.put(addr, "s", "k", b"value-1")
+    assert rendezvous.get(addr, "s", "k") == b"value-1"
+    rendezvous.put(addr, "s", "k2", "value-2")
+    rendezvous.put(addr, "other", "k", "hidden")
+    assert rendezvous.list_scope(addr, "s") == {"k": "value-1",
+                                                "k2": "value-2"}
+
+
+def test_wait_all_timeout(server):
+    addr = "127.0.0.1:%d" % server.port
+    rendezvous.put(addr, rendezvous.SCOPE_ADDRS, "0", "127.0.0.1:1")
+    with pytest.raises(TimeoutError) as e:
+        rendezvous.wait_all(addr, rendezvous.SCOPE_ADDRS, range(3),
+                            timeout=0.5, poll_interval=0.05)
+    assert "missing ranks" in str(e.value)
+
+
+def test_resolve_topology_worker_chosen_ports(server):
+    """Three 'workers' rendezvous concurrently with no pre-assigned ports;
+    everyone must converge on one table with 3 distinct self-chosen ports
+    and consistent local topology (same IP -> one host)."""
+    addr = "127.0.0.1:%d" % server.port
+    envs = [None] * 3
+    errors = []
+
+    def worker(rank):
+        try:
+            envs[rank] = rendezvous.resolve_topology(rank, 3, addr,
+                                                     timeout=20)
+        except Exception as e:  # pragma: no cover
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors
+    tables = {e["HVD_TPU_ADDRS"] for e in envs}
+    assert len(tables) == 1  # everyone sees the same table
+    addrs = tables.pop().split(",")
+    ports = [int(a.rsplit(":", 1)[1]) for a in addrs]
+    assert len(set(ports)) == 3 and all(p > 0 for p in ports)
+    # All on one IP -> single host: local == world, cross size 1.
+    for rank, env in enumerate(envs):
+        assert env["HVD_TPU_RANK"] == str(rank)
+        assert env["HVD_TPU_SIZE"] == "3"
+        assert env["HVD_TPU_LOCAL_RANK"] == str(rank)
+        assert env["HVD_TPU_LOCAL_SIZE"] == "3"
+        assert env["HVD_TPU_CROSS_SIZE"] == "1"
+
+
+@pytest.mark.e2e
+def test_launcher_dynamic_rendezvous(run_launcher):
+    """Launcher end-to-end with NO pre-assigned ports: workers bind their
+    own, publish, and run real collectives."""
+    result = run_launcher(2, "distributed_ops_worker.py")
+    assert result.returncode == 0, result.stderr

@@ -5,6 +5,8 @@ runs via the launcher; sparse helpers in-process."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.e2e
+
 tf = pytest.importorskip("tensorflow")
 
 

@@ -3,6 +3,8 @@ the launcher (reference analogue: test/test_torch.py)."""
 
 import pytest
 
+pytestmark = pytest.mark.e2e
+
 torch = pytest.importorskip("torch")
 
 

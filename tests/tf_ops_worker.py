@@ -43,6 +43,66 @@ def test_allreduce_in_tf_function(r, n):
         assert np.allclose(out.numpy(), exp), out
 
 
+def test_gradients_through_collectives(r, n):
+    """Collectives are graph-real: differentiable under tf.function via
+    the registered gradients (reference: tensorflow/mpi_ops.py:89-180)."""
+    if not hvd.native_ops_available():
+        if r == 0:
+            print("SKIP test_gradients_through_collectives (no native ops)")
+        return
+
+    # allreduce: y = mean_r(x_r); dL/dx_r with L = sum(y * (r+1)) is
+    # mean_r(r+1) on every rank (the grad itself is allreduced).
+    @tf.function
+    def grad_allreduce(x):
+        with tf.GradientTape() as tape:
+            tape.watch(x)
+            y = hvd.allreduce(x, average=True, name="tf_gar")
+            loss = tf.reduce_sum(y) * (r + 1)
+        return tape.gradient(loss, x)
+
+    g = grad_allreduce(tf.ones((3,)))
+    exp = sum(rr + 1 for rr in range(n)) / n
+    assert np.allclose(g.numpy(), exp), g
+
+    # allgather with unequal first dims: rank r contributes r+1 rows of
+    # value r; every rank computes L_r = sum over gathered rows of
+    # per-row weight w_i. The registered gradient sums the upstream
+    # grads (the objective is implicitly sum_r L_r, the reference's
+    # convention) then slices this rank's segment: with identical L_r
+    # here, that is n * w over my rows.
+    @tf.function
+    def grad_allgather(x):
+        with tf.GradientTape() as tape:
+            tape.watch(x)
+            y = hvd.allgather(x, name="tf_gag")
+            w = tf.cast(tf.range(tf.shape(y)[0]) + 1, tf.float32)
+            loss = tf.reduce_sum(y[:, 0] * w)
+        return tape.gradient(loss, x)
+
+    x = tf.fill((r + 1, 2), float(r))
+    g = grad_allgather(x)
+    assert g.shape == x.shape
+    offset = sum(rr + 1 for rr in range(r))
+    exp_rows = (np.arange(offset, offset + r + 1) + 1) * n
+    assert np.allclose(g.numpy()[:, 0], exp_rows), (g.numpy(), exp_rows)
+    assert np.allclose(g.numpy()[:, 1], 0.0)
+
+    # broadcast: every rank's output grad (ones) sums onto the root's
+    # input; non-roots get zeros.
+    @tf.function
+    def grad_broadcast(x):
+        with tf.GradientTape() as tape:
+            tape.watch(x)
+            y = hvd.broadcast(x, root_rank=0, name="tf_gbc")
+            loss = tf.reduce_sum(y)
+        return tape.gradient(loss, x)
+
+    g = grad_broadcast(tf.ones((4,)) * (r + 1))
+    exp = float(n) if r == 0 else 0.0
+    assert np.allclose(g.numpy(), exp), g
+
+
 def test_allreduce_indexed_slices(r, n):
     values = tf.ones((2, 4)) * (r + 1)
     indices = tf.constant([r, r + 1], dtype=tf.int64)
