@@ -1,12 +1,175 @@
-"""MXNet binding slot (reference: ``horovod/mxnet/__init__.py``).
+"""MXNet binding.
 
-MXNet reached end-of-life and is not shipped in this environment; the
-module exists to keep the binding registry complete (`--check-build`
-reports it absent). Importing raises with a clear message, mirroring how
-the reference gates unbuilt extensions
-(`horovod/common/util.py check_extension`)."""
+Capability parity with the reference MXNet API
+(`horovod/mxnet/__init__.py:40-131`, `horovod/mxnet/mpi_ops.py:52-224`):
+``allreduce``/``allreduce_``/``allgather``/``broadcast``/``broadcast_``,
+``broadcast_parameters``, ``DistributedOptimizer`` (wraps an
+``mx.optimizer.Optimizer`` so every update sees averaged gradients) and
+``DistributedTrainer`` (gluon ``Trainer`` whose ``_allreduce_grads``
+rides this framework). Fresh implementation: NDArrays bridge to the
+native host core through numpy (``.asnumpy()`` / in-place ``[:]``
+copy-back), the same host-tensor path every other binding uses — there
+is no MXNet C++ kernel because the core's C API is framework-agnostic.
 
-raise ImportError(
-    "horovod_tpu.mxnet requires MXNet, which is not installed in this "
-    "environment (MXNet is EOL upstream). Use horovod_tpu.jax (TPU-native), "
-    "horovod_tpu.torch, horovod_tpu.tensorflow, or horovod_tpu.keras.")
+MXNet is EOL upstream and not installed in this environment; the import
+is lazy and raises an actionable error at first use, mirroring how the
+reference gates unbuilt extensions (`horovod/common/util.py
+check_extension`).
+"""
+
+import horovod_tpu as _hvd
+from horovod_tpu import (  # noqa: F401
+    init, shutdown, is_initialized, rank, local_rank, cross_rank, size,
+    local_size, cross_size, is_homogeneous,
+)
+from horovod_tpu.common import ops as _ops
+from horovod_tpu.common.ops import HorovodInternalError  # noqa: F401
+
+_name_counter = [0]
+
+
+def _auto_name(prefix):
+    _name_counter[0] += 1
+    return "%s.mx%d" % (prefix, _name_counter[0])
+
+
+def _mx():
+    try:
+        import mxnet
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.mxnet requires MXNet, which is not installed "
+            "(MXNet is EOL upstream). Use horovod_tpu.jax (TPU-native), "
+            "horovod_tpu.torch, horovod_tpu.tensorflow, or "
+            "horovod_tpu.keras.") from e
+    return mxnet
+
+
+def allreduce(tensor, average=True, name=None, priority=0):
+    """Averaged (or summed) allreduce of an NDArray; returns a new
+    NDArray on the same context (reference: mpi_ops.py:52-93).
+    `priority` is accepted for API parity; the core's cycle scheduler
+    orders work itself."""
+    mx = _mx()
+    out = _ops.allreduce(tensor.asnumpy(), name or _auto_name("allreduce"),
+                         average=average)
+    return mx.nd.array(out, ctx=tensor.context, dtype=out.dtype)
+
+
+def allreduce_(tensor, average=True, name=None, priority=0):
+    """In-place allreduce (reference: mpi_ops.py:94-128)."""
+    out = _ops.allreduce(tensor.asnumpy(), name or _auto_name("allreduce"),
+                         average=average)
+    tensor[:] = out
+    return tensor
+
+
+def allgather(tensor, name=None, priority=0):
+    """Concatenates every rank's NDArray along dim 0 (unequal first dims
+    allowed; reference: mpi_ops.py:129-167)."""
+    mx = _mx()
+    out = _ops.allgather(tensor.asnumpy(), name or _auto_name("allgather"))
+    return mx.nd.array(out, ctx=tensor.context, dtype=out.dtype)
+
+
+def broadcast(tensor, root_rank, name=None, priority=0):
+    """Broadcast from root_rank; returns a new NDArray (reference:
+    mpi_ops.py:168-207)."""
+    mx = _mx()
+    out = _ops.broadcast(tensor.asnumpy(), root_rank,
+                         name or _auto_name("broadcast"))
+    return mx.nd.array(out, ctx=tensor.context, dtype=out.dtype)
+
+
+def broadcast_(tensor, root_rank, name=None, priority=0):
+    """In-place broadcast (reference: mpi_ops.py:208-224)."""
+    out = _ops.broadcast(tensor.asnumpy(), root_rank,
+                         name or _auto_name("broadcast"))
+    tensor[:] = out
+    return tensor
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcasts a gluon ``ParameterDict`` (or a plain dict of
+    NDArrays) from root so all ranks start identical (reference:
+    mxnet/__init__.py:109-131)."""
+    if not hasattr(params, "items"):
+        raise ValueError("invalid params of type %r" % type(params))
+    tensors = []
+    for key in sorted(params.keys()):
+        p = params[key]
+        # gluon Parameter -> its data NDArray(s); plain NDArray passes
+        # through.
+        if hasattr(p, "list_data"):
+            tensors.extend(("%s.%d" % (key, i), d)
+                           for i, d in enumerate(p.list_data()))
+        elif hasattr(p, "data") and callable(p.data):
+            tensors.append((key, p.data()))
+        else:
+            tensors.append((key, p))
+    for key, tensor in tensors:
+        broadcast_(tensor, root_rank, name="param.%s" % key)
+
+
+class DistributedOptimizer(object):
+    """Wraps an ``mx.optimizer.Optimizer`` so each ``update`` first
+    allreduce-averages the gradient (reference: mxnet/__init__.py:40-84,
+    which proxies the wrapped optimizer the same way)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def _do_allreduce(self, index, grad):
+        if _hvd.size() == 1:
+            return
+        if isinstance(index, (tuple, list)):
+            for i in range(len(index)):
+                allreduce_(grad[i], average=True,
+                           name="grad.%s" % index[i])
+        else:
+            allreduce_(grad, average=True, name="grad.%s" % index)
+
+    def update(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None):
+    """gluon ``Trainer`` whose gradient reduction rides this framework
+    (reference: mxnet/__init__.py:85-108). The base Trainer's KVStore is
+    disabled; ``_allreduce_grads`` averages through the host core."""
+    mx = _mx()
+
+    class _DistributedTrainer(mx.gluon.Trainer):
+        def __init__(self, params, optimizer, optimizer_params=None):
+            if isinstance(optimizer, DistributedOptimizer):
+                optimizer = optimizer._optimizer
+            super(_DistributedTrainer, self).__init__(
+                params, optimizer, optimizer_params, kvstore=None)
+
+        def _allreduce_grads(self):
+            if _hvd.size() == 1:
+                return
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    for grad in param.list_grad():
+                        allreduce_(grad, average=True,
+                                   name="grad.%d.%s" % (i, param.name))
+
+    return _DistributedTrainer(params, optimizer, optimizer_params)

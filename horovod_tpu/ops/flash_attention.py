@@ -30,8 +30,8 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, num_kb):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                l_ref, *, scale, causal, num_kb):
     # q_ref: [BQ, D]; k_ref/v_ref: [BK, D]; o_ref: [BQ, D];
     # scratch: acc [BQ, D] f32, m/l [BQ, 128] f32 (state across k steps).
     qi = pl.program_id(1)
@@ -86,6 +86,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l = l_ref[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)  # rows with no visible keys
         o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
+        # Log-sum-exp per row, saved for the backward recompute.
+        lse_ref[...] = jnp.broadcast_to(m_ref[:, :1] + jnp.log(l),
+                                        lse_ref.shape)
 
 
 def _pick_block(L, preferred):
@@ -95,8 +98,13 @@ def _pick_block(L, preferred):
     return None
 
 
-def _pallas_forward(q, k, v, scale, causal, interpret,
-                    block_q=None, block_k=None):
+def _pallas_forward_lse(q, k, v, scale, causal, interpret,
+                        block_q=None, block_k=None):
+    """Returns (out [B,H,L,D], lse [B*H, L, 8] f32) — lse is the
+    per-row log-sum-exp the backward kernels need (replicated over a
+    8-wide trailing dim: keeps the block Mosaic-tileable and the DMA a
+    contiguous stripe; 1-wide measured slower, 128-wide wastes 16x the
+    memory)."""
     # q,k,v: [B, H, L, D]
     B, H, L, D = q.shape
     qf = q.reshape(B * H, L, D)
@@ -113,7 +121,7 @@ def _pallas_forward(q, k, v, scale, causal, interpret,
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_kb=num_kb)
     grid = (B * H, L // bq, num_kb)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -121,9 +129,14 @@ def _pallas_forward(q, k, v, scale, causal, interpret,
             pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((None, bq, D),
-                               lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, 8), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, L, 8), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -133,7 +146,177 @@ def _pallas_forward(q, k, v, scale, causal, interpret,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, L, D)
+    return out.reshape(B, H, L, D), lse
+
+
+def _pallas_forward(q, k, v, scale, causal, interpret,
+                    block_q=None, block_k=None):
+    return _pallas_forward_lse(q, k, v, scale, causal, interpret,
+                               block_q, block_k)[0]
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, num_kb):
+    """dQ: grid (bh, q-block, k-block), k innermost sequential.
+    Recomputes p = exp(s - lse) per block; dS = p * (dO.V^T - delta);
+    dQ = sum_k dS.K * scale accumulated in VMEM scratch. lse and
+    delta = rowsum(dO*O) are precomputed per row and streamed in."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    block_q, block_k = q_ref.shape[0], k_ref.shape[0]
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    visible = (kj * block_k < (qi + 1) * block_q) if causal else kj >= 0
+
+    @pl.when(visible)
+    def _compute():
+        s = jax.lax.dot_general(
+            q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse_ref[:, :1])
+        if causal:
+            def _mask(p):
+                rows = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                cols = kj * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                return jnp.where(rows >= cols, p, 0.0)
+
+            straddles = kj * block_k + (block_k - 1) > qi * block_q
+            p = jax.lax.cond(straddles, _mask, lambda p: p, p)
+        dp = jax.lax.dot_general(
+            do_ref[...], v_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[:, :1]) * scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == num_kb - 1)
+    def _finalize():
+        dq_ref[...] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    num_qb):
+    """dK/dV: grid (bh, k-block, q-block), q innermost sequential.
+    dV = sum_q P^T.dO; dK = sum_q dS^T.Q * scale."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    block_q, block_k = q_ref.shape[0], k_ref.shape[0]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # Causal: q blocks entirely above this k block see none of it.
+    visible = (qi * block_q + (block_q - 1) >= kj * block_k) if causal \
+        else qi >= 0
+
+    @pl.when(visible)
+    def _compute():
+        s = jax.lax.dot_general(
+            q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse_ref[:, :1])
+        if causal:
+            def _mask(p):
+                rows = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                cols = kj * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                return jnp.where(rows >= cols, p, 0.0)
+
+            straddles = kj * block_k + (block_k - 1) > qi * block_q
+            p = jax.lax.cond(straddles, _mask, lambda p: p, p)
+        p_lo = p.astype(do_ref.dtype)
+        dv_acc[...] += jax.lax.dot_general(
+            p_lo, do_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_ref[...], v_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[:, :1]) * scale).astype(q_ref.dtype)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_qb - 1)
+    def _finalize():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _pallas_backward(q, k, v, out, lse, g, scale, causal, interpret,
+                     block_q=None, block_k=None):
+    """Pallas backward: returns (dq, dk, dv) in the inputs' dtypes."""
+    B, H, L, D = q.shape
+    qf, kf, vf, gf = (x.reshape(B * H, L, D) for x in (q, k, v, g))
+    # delta = rowsum(dO * O): one fused XLA pass, streamed into both
+    # kernels per q block (recomputing it per grid step would redo the
+    # reduction num_kb/num_qb times).
+    delta = jnp.broadcast_to(
+        jnp.sum(gf.astype(jnp.float32) *
+                out.reshape(B * H, L, D).astype(jnp.float32), axis=-1,
+                keepdims=True), (B * H, L, 8))
+    bq = block_q or _pick_block(L, 256)
+    bk = block_k or _pick_block(L, 512)
+    num_kb, num_qb = L // bk, L // bq
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          num_kb=num_kb),
+        grid=(B * H, L // bq, num_kb),
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, 8), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, 8), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          num_qb=num_qb),
+        grid=(B * H, num_kb, num_qb),
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, 8), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, 8), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, L, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, L, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    shape = (B, H, L, D)
+    return (dq.reshape(shape), dk.reshape(shape), dv.reshape(shape))
 
 
 def _blockwise_reference(q, k, v, scale, causal):
@@ -169,15 +352,22 @@ def _flash(q, k, v, scale, causal, interpret):
 
 
 def _flash_fwd(q, k, v, scale, causal, interpret):
-    return _flash(q, k, v, scale, causal, interpret), (q, k, v)
+    if interpret is None:
+        return _blockwise_reference(q, k, v, scale, causal), \
+            (q, k, v, None, None)
+    out, lse = _pallas_forward_lse(q, k, v, scale, causal, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _blockwise_reference(q, k, v, scale, causal),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if interpret is None:
+        # Non-kernel path: recompute-blockwise VJP in plain JAX.
+        _, vjp = jax.vjp(
+            lambda q, k, v: _blockwise_reference(q, k, v, scale, causal),
+            q, k, v)
+        return vjp(g)
+    return _pallas_backward(q, k, v, out, lse, g, scale, causal, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -14,9 +14,20 @@ Protocol (scoped KV, values are opaque bytes):
   PUT  /set/<scope>/<key>   body = value         -> 200
   GET  /get/<scope>/<key>                        -> 200 value | 404
   GET  /list/<scope>                             -> 200 JSON {key: utf8 value}
+
+Requests are HMAC-authenticated: the launcher generates a per-job secret
+(injected as ``HVD_TPU_RENDEZVOUS_KEY``) and every request carries
+``X-Hvd-Auth: hmac_sha256(secret, method + path + body)`` — an
+unauthenticated peer on the network cannot poison the peer table
+(reference analogue: the HMAC-signed launcher service messages,
+``horovod/run/common/util/secret.py:26-36`` + ``network.py``).
 """
 
+import hashlib
+import hmac
 import json
+import os
+import secrets as _secrets
 import socket
 import threading
 import time
@@ -28,14 +39,33 @@ MAX_VALUE_BYTES = 1 << 20
 
 SCOPE_ADDRS = "addrs"
 
+AUTH_HEADER = "X-Hvd-Auth"
+KEY_ENV = "HVD_TPU_RENDEZVOUS_KEY"
+
+
+def make_secret():
+    return _secrets.token_hex(16)
+
+
+def _sign(key, method, path, body):
+    mac = hmac.new(key.encode(), digestmod=hashlib.sha256)
+    mac.update(method.encode())
+    mac.update(path.encode())
+    mac.update(body or b"")
+    return mac.hexdigest()
+
 
 class RendezvousServer:
-    """Threaded HTTP KV server; one per launcher process."""
+    """Threaded HTTP KV server; one per launcher process.
 
-    def __init__(self, host="0.0.0.0", port=0):
+    `key=None` disables authentication (unit tests); the launcher always
+    passes a per-job secret."""
+
+    def __init__(self, host="0.0.0.0", port=0, key=None):
         self._store = {}  # (scope, key) -> bytes
         self._lock = threading.Lock()
         store, lock = self._store, self._lock
+        auth_key = key
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet
@@ -49,6 +79,13 @@ class RendezvousServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _authorized(self, body=b""):
+                if auth_key is None:
+                    return True
+                got = self.headers.get(AUTH_HEADER, "")
+                want = _sign(auth_key, self.command, self.path, body)
+                return hmac.compare_digest(got, want)
+
             def do_PUT(self):
                 parts = self.path.strip("/").split("/")
                 if len(parts) != 3 or parts[0] != "set":
@@ -57,6 +94,8 @@ class RendezvousServer:
                 if length > MAX_VALUE_BYTES:
                     return self._reply(413, b"value too large")
                 value = self.rfile.read(length)
+                if not self._authorized(value):
+                    return self._reply(403, b"bad signature")
                 with lock:
                     store[(parts[1], parts[2])] = value
                 self._reply(200)
@@ -64,6 +103,8 @@ class RendezvousServer:
             do_POST = do_PUT
 
             def do_GET(self):
+                if not self._authorized():
+                    return self._reply(403, b"bad signature")
                 parts = self.path.strip("/").split("/")
                 if len(parts) == 3 and parts[0] == "get":
                     with lock:
@@ -105,21 +146,30 @@ class RendezvousServer:
 # ---------------------------------------------------------------------------
 # Client side (workers)
 
+def _auth_key():
+    return os.environ.get(KEY_ENV)
+
+
+def _request(method, addr, path, body=None):
+    req = urllib.request.Request("http://%s%s" % (addr, path), data=body,
+                                 method=method)
+    key = _auth_key()
+    if key is not None:
+        req.add_header(AUTH_HEADER, _sign(key, method, path, body))
+    return urllib.request.urlopen(req, timeout=10)
+
+
 def put(addr, scope, key, value):
     if isinstance(value, str):
         value = value.encode()
-    req = urllib.request.Request("http://%s/set/%s/%s" % (addr, scope, key),
-                                 data=value, method="PUT")
-    with urllib.request.urlopen(req, timeout=10) as resp:
+    with _request("PUT", addr, "/set/%s/%s" % (scope, key), value) as resp:
         if resp.status != 200:
             raise RuntimeError("rendezvous PUT failed: HTTP %d" % resp.status)
 
 
 def get(addr, scope, key):
     try:
-        with urllib.request.urlopen(
-                "http://%s/get/%s/%s" % (addr, scope, key),
-                timeout=10) as resp:
+        with _request("GET", addr, "/get/%s/%s" % (scope, key)) as resp:
             return resp.read()
     except urllib.error.HTTPError as e:
         if e.code == 404:
@@ -128,8 +178,7 @@ def get(addr, scope, key):
 
 
 def list_scope(addr, scope):
-    with urllib.request.urlopen("http://%s/list/%s" % (addr, scope),
-                                timeout=10) as resp:
+    with _request("GET", addr, "/list/%s" % scope) as resp:
         return json.loads(resp.read().decode())
 
 
@@ -140,6 +189,12 @@ def wait_all(addr, scope, keys, timeout, poll_interval=0.1):
     while True:
         try:
             table = list_scope(addr, scope)
+        except urllib.error.HTTPError as e:
+            if e.code == 403:
+                raise RuntimeError(
+                    "rendezvous auth failed (HTTP 403): %s mismatch "
+                    "between launcher and worker" % KEY_ENV) from e
+            raise
         except (urllib.error.URLError, ConnectionError, socket.timeout) as e:
             if time.monotonic() > deadline:
                 raise TimeoutError(

@@ -165,22 +165,36 @@ def launch(slots, rank_envs, command, ssh_port=None, verbose=False):
             procs.append(subprocess.Popen(command, env=rank_env,
                                           start_new_session=True))
         else:
-            # Remote launch over ssh with explicit env exports.
+            # Remote launch over ssh with explicit env exports. The
+            # rendezvous secret must NOT ride the command line (argv is
+            # world-readable via ps on both hosts); it is piped over the
+            # ssh channel's stdin instead.
+            secret = rank_env.get(rendezvous.KEY_ENV)
             exports = " ".join(
                 "%s=%s" % (k, shlex.quote(v))
                 for k, v in rank_env.items()
-                if k.startswith("HVD_TPU_") or k in ("PYTHONPATH", "PATH"))
+                if (k.startswith("HVD_TPU_") or k in ("PYTHONPATH", "PATH"))
+                and k != rendezvous.KEY_ENV)
             ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
             if ssh_port:
                 ssh_cmd += ["-p", str(ssh_port)]
             remote = "cd %s && env %s %s" % (
                 shlex.quote(os.getcwd()), exports,
                 " ".join(shlex.quote(c) for c in command))
+            if secret is not None:
+                remote = ("IFS= read -r %s && export %s && " %
+                          (rendezvous.KEY_ENV, rendezvous.KEY_ENV)) + remote
             if verbose:
                 sys.stderr.write("[launcher] rank %d ssh %s\n" %
                                  (slot.rank, slot.hostname))
-            procs.append(subprocess.Popen(ssh_cmd + [slot.hostname, remote],
-                                          start_new_session=True))
+            proc = subprocess.Popen(
+                ssh_cmd + [slot.hostname, remote],
+                start_new_session=True,
+                stdin=subprocess.PIPE if secret is not None else None)
+            if secret is not None:
+                proc.stdin.write((secret + "\n").encode())
+                proc.stdin.close()
+            procs.append(proc)
     return procs
 
 
@@ -217,8 +231,11 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
         rank_envs = [build_env(slots[0], ["127.0.0.1:0"], base_env)]
     else:
         # Dynamic rendezvous: workers pick their own ports and publish
-        # them to the launcher-hosted KV server.
-        server = rendezvous.RendezvousServer()
+        # them to the launcher-hosted KV server. Requests are signed
+        # with a per-job secret so a network peer can't poison the
+        # peer table.
+        rdv_key = rendezvous.make_secret()
+        server = rendezvous.RendezvousServer(key=rdv_key)
         rdv_addr = "%s:%d" % (local_addr, server.start())
         rank_envs = []
         for slot in slots:
@@ -233,6 +250,7 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
                 "HVD_TPU_RANK": str(slot.rank),
                 "HVD_TPU_SIZE": str(slot.size),
                 "HVD_TPU_RENDEZVOUS_ADDR": rdv_addr,
+                rendezvous.KEY_ENV: rdv_key,
             })
             rank_envs.append(rank_env)
 

@@ -9,11 +9,16 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 
 def test_resnet50_forward_shape():
+    # Shape-only via eval_shape: un-jitted eager execution of the 53-conv
+    # graph costs minutes of per-op CPU compiles and proves nothing more
+    # (numeric execution is covered by the train-step and bench paths).
     from horovod_tpu.models import ResNet50
     model = ResNet50(num_classes=10, dtype=jnp.float32)
-    x = jnp.zeros((2, 64, 64, 3))
-    variables = model.init(jax.random.PRNGKey(0), x, train=False)
-    logits = model.apply(variables, x, train=False)
+    x = jax.ShapeDtypeStruct((2, 64, 64, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda x: model.init(jax.random.PRNGKey(0), x, train=False), x)
+    logits = jax.eval_shape(
+        lambda v, x: model.apply(v, x, train=False), variables, x)
     assert logits.shape == (2, 10)
     assert logits.dtype == jnp.float32
 
@@ -21,8 +26,9 @@ def test_resnet50_forward_shape():
 def test_resnet18_param_count():
     from horovod_tpu.models import ResNet18
     model = ResNet18(num_classes=1000, dtype=jnp.float32)
-    variables = model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((1, 32, 32, 3)), train=False)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3)), train=False))
     n = sum(p.size for p in jax.tree_util.tree_leaves(variables["params"]))
     # torchvision resnet18 has 11.69M params; ours matches to within the
     # fc/in-shape differences.
@@ -34,8 +40,10 @@ def test_mnist_cnn_forward():
     model = MnistCNN(dtype=jnp.float32)
     x = jnp.zeros((4, 28, 28, 1))
     variables = model.init(jax.random.PRNGKey(0), x, train=False)
-    logits = model.apply(variables, x, train=False)
+    logits = jax.jit(lambda v, x: model.apply(v, x, train=False))(
+        variables, x)
     assert logits.shape == (4, 10)
+    assert np.all(np.isfinite(np.asarray(logits)))
 
 
 def test_word2vec_loss_and_shapes():
@@ -59,8 +67,9 @@ def test_transformer_dense_forward():
     model = Transformer(cfg)
     tokens = jnp.zeros((2, 16), jnp.int32)
     variables = model.init(jax.random.PRNGKey(0), tokens)
-    logits = model.apply(variables, tokens)
+    logits = jax.jit(model.apply)(variables, tokens)
     assert logits.shape == (2, 16, 128)
+    assert np.all(np.isfinite(np.asarray(logits)))
 
 
 def test_transformer_ring_matches_dense():

@@ -1,0 +1,88 @@
+"""MXNet binding tests. MXNet itself is EOL and absent from this
+environment, so the numpy bridge is exercised with an NDArray test
+double (asnumpy / in-place [:] assignment — the only NDArray surface
+the in-place ops touch) over a real size-1 core init; the lazy-import
+gate and the optimizer proxy are covered directly."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+import horovod_tpu.mxnet as hvd_mx
+
+
+class FakeNDArray:
+    """The slice of the mx.nd.NDArray API the in-place ops use."""
+
+    def __init__(self, arr):
+        self.arr = np.asarray(arr, dtype=np.float32)
+        self.context = "cpu(0)"
+
+    def asnumpy(self):
+        return self.arr.copy()
+
+    def __setitem__(self, key, value):
+        self.arr[key] = value
+
+
+@pytest.fixture
+def single_proc_init():
+    for key in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_ADDRS",
+                "HVD_TPU_RENDEZVOUS_ADDR"):
+        import os
+        os.environ.pop(key, None)
+    hvd.init()
+    yield
+    hvd.shutdown()
+
+
+def test_lazy_import_gate():
+    with pytest.raises(ImportError) as e:
+        hvd_mx._mx()
+    assert "MXNet" in str(e.value)
+    assert "horovod_tpu.jax" in str(e.value)  # actionable alternative
+
+
+def test_inplace_allreduce_broadcast(single_proc_init):
+    x = FakeNDArray([1.0, 2.0, 3.0])
+    out = hvd_mx.allreduce_(x, average=True, name="mx_ar")
+    assert out is x
+    np.testing.assert_allclose(x.arr, [1.0, 2.0, 3.0])  # size-1 identity
+
+    y = FakeNDArray([[5.0, 6.0]])
+    out = hvd_mx.broadcast_(y, root_rank=0, name="mx_bc")
+    assert out is y
+    np.testing.assert_allclose(y.arr, [[5.0, 6.0]])
+
+
+def test_distributed_optimizer_proxy(single_proc_init):
+    calls = []
+
+    class FakeOpt:
+        learning_rate = 0.5
+
+        def update(self, index, weight, grad, state):
+            calls.append(("update", index))
+
+        def update_multi_precision(self, index, weight, grad, state):
+            calls.append(("ump", index))
+
+        def set_learning_rate(self, lr):
+            calls.append(("lr", lr))
+
+    opt = hvd_mx.DistributedOptimizer(FakeOpt())
+    assert opt.learning_rate == 0.5  # attribute proxying
+    g = FakeNDArray([1.0])
+    opt.update(0, None, g, None)          # size-1: allreduce shortcut
+    opt.update_multi_precision([1, 2], None, [g, g], None)
+    opt.set_learning_rate(0.1)
+    assert calls == [("update", 0), ("ump", [1, 2]), ("lr", 0.1)]
+
+
+def test_broadcast_parameters_plain_dict(single_proc_init):
+    params = {"w": FakeNDArray([1.0, 2.0]), "b": FakeNDArray([0.5])}
+    hvd_mx.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(params["w"].arr, [1.0, 2.0])
+
+    with pytest.raises(ValueError):
+        hvd_mx.broadcast_parameters([1, 2, 3])

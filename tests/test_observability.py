@@ -35,7 +35,7 @@ def test_stall_detection_and_shutdown(run_launcher):
     proc = run_launcher(2, "stall_worker.py", extra_env={
         "HVD_TPU_STALL_CHECK_TIME_SECONDS": "2",
         "HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS": "5",
-    }, timeout=60)
+    }, timeout=120)
     out = proc.stdout + proc.stderr
     assert "rank 0 exited cleanly" in out, out
     assert "rank 1 exited cleanly" in out, out

@@ -47,7 +47,7 @@ def test_flash_fallback_and_grads():
     """Public API on CPU uses the blockwise fallback; values and grads
     must match dense attention."""
     from horovod_tpu.ops import flash_attention
-    B, L, H, D = 1, 64, 2, 16
+    B, L, H, D = 1, 64, 1, 8
     q, k, v = _rand_qkv(B, L, H, D, seed=3)
 
     out = flash_attention(q, k, v, causal=True)
@@ -68,26 +68,67 @@ def test_flash_fallback_and_grads():
                                    rtol=2e-4, atol=2e-4)
 
 
-def test_flash_kernel_unequal_blocks_interpret():
-    """The production default tiling (bq=256, bk=512 at L>=512) uses
-    unequal q/k blocks whose causal straddle-mask arithmetic differs from
-    the square case — pin it numerically (interpret mode, L=1024)."""
+@pytest.mark.parametrize("bq,bk", [(256, 512), (128, 128)])
+def test_flash_kernel_block_shapes_interpret(bq, bk):
+    """(256, 512): the production default's unequal q/k tiling, where
+    every visible causal block straddles the diagonal. (128, 128): equal
+    tiling at L=512 has fully-below-diagonal blocks, exercising the
+    mask-skip (straddles=False) branch the default tiling never hits."""
     from horovod_tpu.ops.flash_attention import _pallas_forward
-    B, L, H, D = 1, 1024, 1, 32
+    B, L, H, D = 1, 512, 1, 32
     q, k, v = _rand_qkv(B, L, H, D, seed=7)
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     out = _pallas_forward(qt, kt, vt, D ** -0.5, True, interpret=True,
-                          block_q=256, block_k=512).transpose(0, 2, 1, 3)
+                          block_q=bq, block_k=bk).transpose(0, 2, 1, 3)
     expected = _dense(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal,bq,bk", [
+    (True, None, None),    # production tiling via the public custom-VJP
+    (False, None, None),
+    (True, 128, 128),      # equal tiling: exercises straddles=False in
+                           # both backward kernels (fully-visible blocks)
+])
+def test_flash_pallas_backward_interpret(causal, bq, bk):
+    """The Pallas backward kernels (dQ / dK+dV, used on TPU) must match
+    dense-attention gradients; exercised in interpret mode."""
+    from horovod_tpu.ops.flash_attention import (
+        _flash, _pallas_backward, _pallas_forward_lse)
+    B, L, H, D = 1, 512, 1, 32
+    q, k, v = _rand_qkv(B, L, H, D, seed=11)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    w = jnp.asarray(np.random.RandomState(12).randn(B, H, L, D),
+                    jnp.float32)
+
+    if bq is None:
+        def loss_flash(qt, kt, vt):
+            return jnp.sum(_flash(qt, kt, vt, D ** -0.5, causal, True) * w)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(qt, kt, vt)
+    else:
+        out, lse = _pallas_forward_lse(qt, kt, vt, D ** -0.5, causal,
+                                       True, block_q=bq, block_k=bk)
+        g_flash = _pallas_backward(qt, kt, vt, out, lse, w, D ** -0.5,
+                                   causal, True, block_q=bq, block_k=bk)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(
+            _dense(q, k, v, causal).transpose(0, 2, 1, 3) * w)
+
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd.transpose(0, 2, 1, 3)),
+            rtol=2e-4, atol=2e-4)
+
+
 def test_flash_fallback_tail_block():
-    """L not a multiple of BLOCK_Q (192 = 128 + 64 tail): the blockwise
+    """L not a multiple of BLOCK_Q (160 = 128 + 32 tail): the blockwise
     fallback must cover the remainder, full shape, values AND grads."""
     from horovod_tpu.ops import flash_attention
-    B, L, H, D = 1, 192, 2, 16
+    B, L, H, D = 1, 160, 1, 8
     q, k, v = _rand_qkv(B, L, H, D, seed=5)
 
     out = flash_attention(q, k, v, causal=True)

@@ -72,6 +72,34 @@ def test_resolve_topology_worker_chosen_ports(server):
         assert env["HVD_TPU_CROSS_SIZE"] == "1"
 
 
+def test_hmac_auth(monkeypatch):
+    """Signed-request parity with the reference's HMAC-authenticated
+    launcher services (run/common/util/secret.py): unsigned or
+    wrongly-signed requests are rejected, signed ones succeed."""
+    import urllib.error
+
+    key = rendezvous.make_secret()
+    server = rendezvous.RendezvousServer(host="127.0.0.1", key=key)
+    server.start()
+    addr = "127.0.0.1:%d" % server.port
+    try:
+        monkeypatch.delenv(rendezvous.KEY_ENV, raising=False)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            rendezvous.put(addr, "s", "k", b"unsigned")
+        assert e.value.code == 403
+
+        monkeypatch.setenv(rendezvous.KEY_ENV, "wrong-" + key)
+        with pytest.raises(RuntimeError) as e2:
+            rendezvous.wait_all(addr, "s", ["k"], timeout=2)
+        assert "auth failed" in str(e2.value)
+
+        monkeypatch.setenv(rendezvous.KEY_ENV, key)
+        rendezvous.put(addr, "s", "k", b"signed")
+        assert rendezvous.get(addr, "s", "k") == b"signed"
+    finally:
+        server.stop()
+
+
 @pytest.mark.e2e
 def test_launcher_dynamic_rendezvous(run_launcher):
     """Launcher end-to-end with NO pre-assigned ports: workers bind their
