@@ -127,6 +127,8 @@ class HorovodBasics:
         lib.horovod_tpu_allgather_copy.restype = ctypes.c_int
         lib.horovod_tpu_allgather_copy.argtypes = [ctypes.c_int,
                                                    ctypes.c_void_p]
+        lib.horovod_tpu_allgather_data.restype = ctypes.c_void_p
+        lib.horovod_tpu_allgather_data.argtypes = [ctypes.c_int]
         lib.horovod_tpu_release.argtypes = [ctypes.c_int]
 
     # -- lifecycle ---------------------------------------------------------

@@ -75,12 +75,17 @@ def poll(handle):
 
 
 def synchronize(handle):
-    """Blocks until completion; returns the result array."""
+    """Blocks until completion; returns the result array.
+
+    Allgather results are zero-copy views over the core-owned gather
+    buffer; the handle (and with it the buffer) is released when the
+    returned array is garbage-collected."""
     basics = get_basics()
     if handle not in _handle_map:
         raise ValueError("unknown handle %d" % handle)
-    status = basics.lib.horovod_tpu_wait(handle)
+    released = False
     try:
+        status = basics.lib.horovod_tpu_wait(handle)
         if status != _STATUS_OK:
             msg = basics.lib.horovod_tpu_error_string(handle)
             raise HorovodInternalError(
@@ -88,7 +93,7 @@ def synchronize(handle):
         arr, out = _handle_map[handle]
         if out is not None:
             return out
-        # Allgather: copy the core-owned result out.
+        # Allgather: view the core-owned result in place.
         nbytes = basics.lib.horovod_tpu_allgather_bytes(handle)
         if nbytes < 0:
             raise HorovodInternalError("allgather produced no result")
@@ -100,16 +105,35 @@ def synchronize(handle):
                 raise HorovodInternalError("allgather sizes missing")
             first_dim += d
         shape = (first_dim,) + tuple(arr.shape[1:])
-        result = np.empty(shape, dtype=arr.dtype)
-        if nbytes != result.nbytes:
+        expected = int(np.prod(shape, dtype=np.int64)) * arr.dtype.itemsize
+        if nbytes != expected:
             raise HorovodInternalError(
-                "allgather size mismatch: %d != %d" % (nbytes, result.nbytes))
-        basics.lib.horovod_tpu_allgather_copy(
-            handle, result.ctypes.data_as(ctypes.c_void_p))
+                "allgather size mismatch: %d != %d" % (nbytes, expected))
+        if nbytes == 0:  # empty gather: a vector's data() may be null
+            return np.empty(shape, dtype=arr.dtype)
+        ptr = basics.lib.horovod_tpu_allgather_data(handle)
+        if not ptr:
+            raise HorovodInternalError("allgather buffer missing")
+        result = _view_core_buffer(basics, handle, ptr, nbytes, arr.dtype,
+                                   shape)
+        released = True  # ownership moved to the view's finalizer
         return result
     finally:
-        basics.lib.horovod_tpu_release(handle)
+        if not released:
+            basics.lib.horovod_tpu_release(handle)
         del _handle_map[handle]
+
+
+def _view_core_buffer(basics, handle, ptr, nbytes, dtype, shape):
+    """Wraps the core-owned gather buffer as a numpy array without
+    copying; `horovod_tpu_release` fires when the array (and any views
+    of it) is garbage-collected."""
+    import weakref
+
+    buf = (ctypes.c_char * nbytes).from_address(ptr)
+    result = np.frombuffer(buf, dtype=dtype).reshape(shape)
+    weakref.finalize(buf, basics.lib.horovod_tpu_release, handle)
+    return result
 
 
 def allreduce(tensor, name, average=False, prescale_factor=1.0,

@@ -519,6 +519,15 @@ int horovod_tpu_allgather_copy(int handle, void* out) {
   return 1;
 }
 
+// Zero-copy access: the returned pointer stays valid until
+// horovod_tpu_release(handle) (the Python side wraps it in a numpy view
+// whose finalizer performs the release).
+const void* horovod_tpu_allgather_data(int handle) {
+  auto entry = g_handles.Get(handle);
+  if (entry == nullptr || entry->gathered == nullptr) return nullptr;
+  return entry->gathered->data();
+}
+
 void horovod_tpu_release(int handle) { g_handles.Release(handle); }
 
 }  // extern "C"

@@ -47,11 +47,12 @@ Available frameworks:
     [%s] MXNet
 
 Available controllers:
-    [X] TCP
+    [X] TCP (dynamic rendezvous)
 
 Available data planes:
-    [X] CPU (TCP ring)
+    [X] CPU (TCP ring + hierarchical)
     [%s] XLA/ICI (in-jit)
+    [%s] TF graph kernels
 """ % (hvd.__version__,
        binding("jax", "horovod_tpu.jax"),
        binding("torch", "horovod_tpu.torch"),
@@ -59,7 +60,22 @@ Available data planes:
        flag((_importable("tensorflow") or _importable("keras"))
             and _importable("horovod_tpu.keras")),
        binding("mxnet", "horovod_tpu.mxnet"),
-       flag(_importable("jax"))))
+       flag(_importable("jax")),
+       flag(_tf_native_kernels())))
+
+
+def _tf_native_kernels():
+    """True when the compiled TF custom-op library is present on disk.
+    Deliberately does NOT import TF or trigger the on-demand build — the
+    capability printout must stay instant (the library builds lazily on
+    first `horovod_tpu.tensorflow` collective use)."""
+    import os
+
+    if not _importable("tensorflow"):
+        return False
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.exists(os.path.join(
+        here, "..", "native", "libhorovod_tpu_tf.so"))
 
 
 def _importable(mod):

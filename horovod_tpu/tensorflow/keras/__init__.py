@@ -1,0 +1,10 @@
+"""``horovod_tpu.tensorflow.keras`` — source-compatible alias of the
+Keras binding (reference parity: ``horovod/tensorflow/keras/__init__.py``
+is the same thin shell over ``horovod/_keras`` as ``horovod/keras``; a
+user switching from ``import horovod.tensorflow.keras as hvd`` keeps the
+identical import path here)."""
+
+from horovod_tpu.keras import *  # noqa: F401,F403
+from horovod_tpu.keras import (  # noqa: F401
+    DistributedOptimizer, broadcast_model_weights, load_model, callbacks,
+)

@@ -1,0 +1,7 @@
+"""Callback aliases (reference: horovod/tensorflow/keras/callbacks.py)."""
+
+from horovod_tpu.keras.callbacks import *  # noqa: F401,F403
+from horovod_tpu.keras.callbacks import (  # noqa: F401
+    BroadcastGlobalVariablesCallback, MetricAverageCallback,
+    LearningRateScheduleCallback, LearningRateWarmupCallback,
+)

@@ -38,6 +38,13 @@ def test_host_allgather_broadcast():
     assert np.allclose(hvd.broadcast(x, 0), x)
 
 
+def test_host_allgather_empty():
+    # Zero rows is legal (reference allgatherv semantics); the zero-copy
+    # view path must not choke on the core's null empty-buffer pointer.
+    out = hvd.allgather(jnp.zeros((0, 4), jnp.float32))
+    assert out.shape[0] == 0 and out.shape[1:] == (4,)
+
+
 def test_compression_fp16_roundtrip():
     x = jnp.arange(8, dtype=jnp.float32)
     out = hvd.allreduce(x, average=False, compression=hvd.Compression.fp16)
