@@ -30,6 +30,51 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
+def _masked_scores(q_ref, k_ref, scale, causal, q_off, kv_off, fill):
+    """s = (q.k^T)*scale with causal masking by global row/col offsets.
+    Only blocks straddling the diagonal pay the elementwise mask pass
+    (the kernels are VPU-bound, every pass counts); `fill` is -inf for
+    scores, 0 for probabilities."""
+    block_q, block_k = q_ref.shape[0], k_ref.shape[0]
+    s = jax.lax.dot_general(
+        q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+    if not causal:
+        return s
+
+    def _mask(s):
+        rows = q_off + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = kv_off + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        return jnp.where(rows >= cols, s, fill)
+
+    straddles = kv_off + (block_k - 1) > q_off
+    return jax.lax.cond(straddles, _mask, lambda s: s, s)
+
+
+def _online_softmax_update(s, v_ref, acc_ref, m_ref, l_ref, guard_empty):
+    """One online-softmax block update of the (acc, m, l) state refs.
+    `guard_empty` handles rows no block has touched yet (m == -inf, the
+    ring-step case where visitation order is data-dependent); the plain
+    forward's ascending k order makes the first visible block cover
+    every row, so it skips the two extra passes."""
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    if guard_empty:
+        alpha = jnp.where(jnp.isneginf(m_new), 0.0, alpha)
+        p = jnp.where(jnp.isneginf(m_new), 0.0, p)
+    l_ref[...] = jnp.broadcast_to(
+        l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
                 l_ref, *, scale, causal, num_kb):
     # q_ref: [BQ, D]; k_ref/v_ref: [BK, D]; o_ref: [BQ, D];
@@ -52,34 +97,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
     def _compute():
         # Matmuls take the inputs' native (bf16) dtype — the MXU's fast
         # path — and accumulate in f32; only softmax runs in f32.
-        s = jax.lax.dot_general(
-            q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [BQ, BK]
-        if causal:
-            # Mask only blocks straddling the diagonal; fully-visible
-            # blocks (max col <= min row) skip the elementwise pass
-            # entirely (the kernel is VPU-bound, every pass counts).
-            def _mask(s):
-                rows = qi * block_q + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                cols = kj * block_k + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                return jnp.where(rows >= cols, s, -jnp.inf)
-
-            straddles = kj * block_k + (block_k - 1) > qi * block_q
-            s = jax.lax.cond(straddles, _mask, lambda s: s, s)
-        m_prev = m_ref[:, :1]
-        l_prev = l_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_ref[...] = jnp.broadcast_to(
-            l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True),
-            l_ref.shape)
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        s = _masked_scores(q_ref, k_ref, scale, causal,
+                           q_off=qi * block_q, kv_off=kj * block_k,
+                           fill=-jnp.inf)
+        _online_softmax_update(s, v_ref, acc_ref, m_ref, l_ref,
+                               guard_empty=False)
 
     @pl.when(kj == num_kb - 1)
     def _finalize():
@@ -155,6 +177,101 @@ def _pallas_forward(q, k, v, scale, causal, interpret,
                                block_q, block_k)[0]
 
 
+def _ring_step_kernel(offs_ref, q_ref, k_ref, v_ref, oi_ref, mi_ref,
+                      li_ref, oo_ref, mo_ref, lo_ref, acc_ref, m_ref,
+                      l_ref, *, scale, causal, num_kb):
+    """One ring-attention step as a flash kernel with carried state.
+
+    Same online-softmax update as `_fwd_kernel`, but the (acc, m, l)
+    state is loaded from the previous ring step's outputs instead of
+    initialized, and written back un-normalized (the caller divides by l
+    after the last ring step). Causal masking uses *global* token
+    offsets (offs_ref in SMEM: [[q_offset, kv_offset]]) because the
+    local q and the rotating k/v block sit at different positions of the
+    full sequence; block skipping is dynamic for the same reason.
+    """
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    block_q, block_k = q_ref.shape[0], k_ref.shape[0]
+    q_off = offs_ref[0, 0] + qi * block_q
+    kv_off = offs_ref[0, 1] + kj * block_k
+
+    @pl.when(kj == 0)
+    def _load_state():
+        acc_ref[...] = oi_ref[...]
+        m_ref[...] = jnp.broadcast_to(mi_ref[:, :1], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(li_ref[:, :1], l_ref.shape)
+
+    # A k/v block entirely in this q block's future contributes nothing.
+    visible = (kv_off <= q_off + block_q - 1) if causal else kj >= 0
+
+    @pl.when(visible)
+    def _compute():
+        s = _masked_scores(q_ref, k_ref, scale, causal, q_off=q_off,
+                           kv_off=kv_off, fill=-jnp.inf)
+        _online_softmax_update(s, v_ref, acc_ref, m_ref, l_ref,
+                               guard_empty=True)
+
+    @pl.when(kj == num_kb - 1)
+    def _store_state():
+        oo_ref[...] = acc_ref[...]
+        mo_ref[...] = jnp.broadcast_to(m_ref[:, :1], mo_ref.shape)
+        lo_ref[...] = jnp.broadcast_to(l_ref[:, :1], lo_ref.shape)
+
+
+def flash_ring_step(q, k, v, o, m, l, q_offset, kv_offset, causal=True,
+                    scale=None, interpret=False, block_q=None,
+                    block_k=None):
+    """One ring-attention local step over kernel-layout shards.
+
+    Args: q [BH, Lq, D] (bf16/f32), k/v [BH, Lk, D], carried state
+    o [BH, Lq, D] f32 (un-normalized accumulator), m/l [BH, Lq, 8] f32
+    (running max / normalizer stripes), q_offset/kv_offset global token
+    offsets (traced int32 scalars). Returns updated (o, m, l).
+    """
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    bq = block_q or _pick_block(Lq, 256)
+    bk = block_k or _pick_block(Lk, 512)
+    num_kb = Lk // bk
+    offs = jnp.array([[0, 0]], jnp.int32).at[0, 0].set(q_offset) \
+        .at[0, 1].set(kv_offset)
+    kernel = functools.partial(_ring_step_kernel, scale=scale,
+                               causal=causal, num_kb=num_kb)
+    grid = (BH, Lq // bq, num_kb)
+    state_specs = [
+        pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((None, bq, 8), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((None, bq, 8), lambda b, i, j: (b, i, 0)),
+    ]
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # offsets [[q, kv]]
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+        ] + state_specs,
+        out_specs=state_specs,
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Lq, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Lq, 8), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Lq, 8), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v, o, m, l)
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *, scale, causal, num_kb):
     """dQ: grid (bh, q-block, k-block), k innermost sequential.
@@ -173,20 +290,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(visible)
     def _compute():
-        s = jax.lax.dot_general(
-            q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        p = jnp.exp(s - lse_ref[:, :1])
-        if causal:
-            def _mask(p):
-                rows = qi * block_q + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                cols = kj * block_k + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                return jnp.where(rows >= cols, p, 0.0)
-
-            straddles = kj * block_k + (block_k - 1) > qi * block_q
-            p = jax.lax.cond(straddles, _mask, lambda p: p, p)
+        s = _masked_scores(q_ref, k_ref, scale, causal,
+                           q_off=qi * block_q, kv_off=kj * block_k,
+                           fill=-jnp.inf)
+        p = jnp.exp(s - lse_ref[:, :1])  # masked entries: exp(-inf) = 0
         dp = jax.lax.dot_general(
             do_ref[...], v_ref[...], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -220,20 +327,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(visible)
     def _compute():
-        s = jax.lax.dot_general(
-            q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        p = jnp.exp(s - lse_ref[:, :1])
-        if causal:
-            def _mask(p):
-                rows = qi * block_q + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                cols = kj * block_k + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                return jnp.where(rows >= cols, p, 0.0)
-
-            straddles = kj * block_k + (block_k - 1) > qi * block_q
-            p = jax.lax.cond(straddles, _mask, lambda p: p, p)
+        s = _masked_scores(q_ref, k_ref, scale, causal,
+                           q_off=qi * block_q, kv_off=kj * block_k,
+                           fill=-jnp.inf)
+        p = jnp.exp(s - lse_ref[:, :1])  # masked entries: exp(-inf) = 0
         p_lo = p.astype(do_ref.dtype)
         dv_acc[...] += jax.lax.dot_general(
             p_lo, do_ref[...], (((0,), (0,)), ((), ())),

@@ -50,12 +50,25 @@ def _block_attention(q, k, v, o, m, l, q_offset, kv_offset, causal, scale):
     return o_new, m_new, l_new
 
 
+def _use_flash_ring(Lq, Lk):
+    """The Pallas carry-state kernel needs TPU + 128-aligned sequence
+    shards (any head dim: blocks span the full D)."""
+    return (jax.default_backend() == "tpu" and Lq % 128 == 0
+            and Lk % 128 == 0)
+
+
 def ring_attention(q, k, v, axis_name, causal=True, scale=None):
     """Exact multi-head attention over a sequence sharded on `axis_name`.
 
     Args: q, k, v of shape [B, L_local, H, D] (per-device shards, equal
     L_local on every device), inside shard_map over `axis_name`.
     Returns [B, L_local, H, D] in q.dtype.
+
+    On TPU with 128-aligned shards the per-step local compute runs as a
+    Pallas flash kernel with carried online-softmax state
+    (`horovod_tpu.ops.flash_attention.flash_ring_step`), so per-step
+    memory is O(block) instead of the O(Lq * Lk) score matrix; other
+    backends/shapes use the blockwise jnp path below.
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -63,11 +76,41 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None):
     Lk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    if _use_flash_ring(Lq, Lk):
+        from horovod_tpu.ops.flash_attention import flash_ring_step
+
+        # Kernel layout: [B*H, L, D]; state carried across ring steps.
+        def to_kernel(x):
+            return x.transpose(0, 2, 1, 3).reshape(B * H, -1, x.shape[-1])
+
+        # Transpose once; the ring circulates kernel-layout k/v shards.
+        qk, kk, vk = to_kernel(q), to_kernel(k), to_kernel(v)
+        o0 = jnp.zeros((B * H, Lq, D), jnp.float32)
+        m0 = jnp.full((B * H, Lq, 8), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((B * H, Lq, 8), jnp.float32)
+
+        def body(i, carry):
+            o, m, l, k_blk, v_blk = carry
+            src = (idx - i) % n
+            o, m, l = flash_ring_step(
+                qk, k_blk, v_blk, o, m, l,
+                q_offset=idx * Lq, kv_offset=src * Lk, causal=causal,
+                scale=scale)
+            k_nxt = lax.ppermute(k_blk, axis_name, perm)
+            v_nxt = lax.ppermute(v_blk, axis_name, perm)
+            return o, m, l, k_nxt, v_nxt
+
+        o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, kk, vk))
+        l1 = l[:, :, :1]
+        out = o / jnp.where(l1 == 0.0, 1.0, l1)
+        return out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3) \
+            .astype(q.dtype)
 
     o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
     m0 = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, H, Lq), jnp.float32)
-    perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(i, carry):
         o, m, l, k_blk, v_blk = carry

@@ -147,6 +147,48 @@ def test_flash_fallback_tail_block():
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_ring_step_carry(causal):
+    """Two chained `flash_ring_step` calls (q at global offset Lq, k/v
+    blocks arriving diagonal-first, the ring order) must equal dense
+    attention of the q shard over the concatenated sequence — validates
+    the carried online-softmax state and global-offset masking."""
+    from horovod_tpu.ops.flash_attention import flash_ring_step
+    BH, Lq, D = 2, 256, 32
+    rng = np.random.RandomState(21)
+    q = jnp.asarray(rng.randn(BH, Lq, D), jnp.float32)
+    k_blocks = [jnp.asarray(rng.randn(BH, Lq, D), jnp.float32)
+                for _ in range(2)]
+    v_blocks = [jnp.asarray(rng.randn(BH, Lq, D), jnp.float32)
+                for _ in range(2)]
+    scale = D ** -0.5
+
+    o = jnp.zeros((BH, Lq, D), jnp.float32)
+    m = jnp.full((BH, Lq, 8), -jnp.inf, jnp.float32)
+    l = jnp.zeros((BH, Lq, 8), jnp.float32)
+    # q is the SECOND shard (offset Lq); ring delivers own (diagonal)
+    # k/v block first, then the previous shard's.
+    for kv_idx in (1, 0):
+        o, m, l = flash_ring_step(
+            q, k_blocks[kv_idx], v_blocks[kv_idx], o, m, l,
+            q_offset=jnp.int32(Lq), kv_offset=jnp.int32(kv_idx * Lq),
+            causal=causal, scale=scale, interpret=True)
+    l1 = l[:, :, :1]
+    out = o / jnp.where(l1 == 0.0, 1.0, l1)
+
+    k_full = jnp.concatenate(k_blocks, axis=1)
+    v_full = jnp.concatenate(v_blocks, axis=1)
+    s = jnp.einsum("bqd,bkd->bqk", q, k_full) * scale
+    if causal:
+        rows = Lq + np.arange(Lq)[:, None]
+        cols = np.arange(2 * Lq)[None, :]
+        s = jnp.where(jnp.asarray(rows >= cols)[None], s, -jnp.inf)
+    expected = jnp.einsum("bqk,bkd->bqd", jax.nn.softmax(s, axis=-1),
+                          v_full)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_transformer_flash_matches_dense():
     from horovod_tpu.models import Transformer, TransformerConfig
     base = dict(vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,
