@@ -65,7 +65,12 @@ def main():
     ap.add_argument("--num-iters", type=int, default=10)
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet50", "resnet101", "resnet152",
-                             "transformer"])
+                             "vgg16", "inception3", "transformer"],
+                    help="vgg16/inception3 are the other models in the "
+                         "reference's published scaling table "
+                         "(docs/benchmarks.rst:13-14); use "
+                         "--image-size 299 for inception3's canonical "
+                         "input")
     ap.add_argument("--seq-len", type=int, default=2048,
                     help="sequence length (transformer model)")
     ap.add_argument("--tokens-batch", type=int, default=8,
@@ -122,17 +127,28 @@ def main():
     else:
         model_cls = {"resnet50": models.ResNet50,
                      "resnet101": models.ResNet101,
-                     "resnet152": models.ResNet152}[args.model]
+                     "resnet152": models.ResNet152,
+                     "vgg16": models.VGG16,
+                     "inception3": models.InceptionV3}[args.model]
         model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
 
         s = args.image_size
         variables = model.init(rng, jnp.zeros((1, s, s, 3)), train=False)
-        params, batch_stats = variables["params"], variables["batch_stats"]
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        mutable = ["batch_stats"] if batch_stats else []
+        drop_rng = jax.random.PRNGKey(1)
 
         def loss_fn(params, batch):
-            logits, _ = model.apply(
-                {"params": params, "batch_stats": batch_stats}, batch["x"],
-                train=True, mutable=["batch_stats"])
+            state = {"params": params}
+            if batch_stats:
+                state["batch_stats"] = batch_stats
+                logits, _ = model.apply(state, batch["x"], train=True,
+                                        mutable=mutable,
+                                        rngs={"dropout": drop_rng})
+            else:
+                logits = model.apply(state, batch["x"], train=True,
+                                     rngs={"dropout": drop_rng})
             return cross_entropy_loss(logits, batch["y"])
 
         opt = optax.sgd(0.01, momentum=0.9)

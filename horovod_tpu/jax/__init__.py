@@ -292,6 +292,41 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def init_distributed(local_device_ids=None):
+    """Bootstraps ``jax.distributed`` from horovod_tpu's topology so jit
+    programs span every host's chips (XLA collectives over ICI within a
+    host/slice and DCN across hosts — the reference's multi-host NCCL
+    role, SURVEY §2.6/§5.8).
+
+    Call after ``init()``. Rank 0 reserves the coordinator port and
+    broadcasts it through the host core, so no extra configuration is
+    needed beyond the launcher's own rendezvous. No-op at size 1 or when
+    jax.distributed is already initialized (idempotent: users following
+    the standard JAX convention may have called
+    ``jax.distributed.initialize`` themselves).
+    """
+    import os
+
+    if not _hvd.is_initialized():
+        raise RuntimeError("call hvd.init() before init_distributed()")
+    if jax.distributed.is_initialized():
+        return
+    size = _hvd.size()
+    if size <= 1:
+        return
+    from horovod_tpu.run.rendezvous import reserve_port
+
+    port = reserve_port() if _hvd.rank() == 0 else 0
+    port = int(np.asarray(_ops.broadcast(
+        np.array([port], np.int64), 0, "jax_dist.coordinator_port"))[0])
+    addrs = (os.environ.get("HVD_TPU_ADDRS") or "").split(",")
+    host = addrs[0].rsplit(":", 1)[0] if addrs[0] else "127.0.0.1"
+    jax.distributed.initialize(
+        coordinator_address="%s:%d" % (host, port),
+        num_processes=size, process_id=_hvd.rank(),
+        local_device_ids=local_device_ids)
+
+
 def metric_average(value, name=None):
     """Averages a scalar metric across ranks (reference:
     _keras/callbacks.py MetricAverageCallback semantics)."""

@@ -10,6 +10,9 @@
   `examples/tensorflow_word2vec.py`).
 * :mod:`.transformer` — decoder-only transformer with optional ring
   attention for long-context sequence parallelism (TPU-first extension).
+* :mod:`.imagenet_extras` — VGG-16 and Inception V3, the other models in
+  the reference's published 512-GPU scaling table
+  (`docs/benchmarks.rst:13-14`).
 
 All models are written TPU-first: NHWC conv layouts, bfloat16 compute with
 float32 parameters, static shapes, no data-dependent Python control flow.
@@ -19,3 +22,4 @@ from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152  
 from .mnist import MnistCNN  # noqa: F401
 from .word2vec import SkipGram  # noqa: F401
 from .transformer import Transformer, TransformerConfig  # noqa: F401
+from .imagenet_extras import VGG16, InceptionV3  # noqa: F401

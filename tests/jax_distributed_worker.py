@@ -1,0 +1,64 @@
+"""Self-verifying multi-process jax.distributed bootstrap test: 2 ranks
+initialize jax's distributed runtime from horovod_tpu topology, see each
+other's devices as one global mesh, and run a cross-process psum."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import horovod_tpu.jax as hvd
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n >= 2
+
+    hvd.init_distributed()
+    hvd.init_distributed()  # idempotent: second call is a no-op
+
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.process_count() == n, jax.process_count()
+    assert jax.process_index() == r, (jax.process_index(), r)
+    local = jax.local_device_count()
+    assert jax.device_count() == n * local, (jax.device_count(), n, local)
+    if r == 0:
+        print("PASS global_device_view (%d devices over %d processes)"
+              % (jax.device_count(), n), flush=True)
+
+    # Cross-process collective through the global runtime: every process
+    # contributes its rank; psum must see them all.
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    local_vals = [jnp.full((1,), float(r) + 1.0)
+                  for _ in range(local)]
+    arr = jax.make_array_from_single_device_arrays(
+        (jax.device_count(),), sharding,
+        [jax.device_put(v, d)
+         for v, d in zip(local_vals, jax.local_devices())])
+
+    @jax.jit
+    def total(x):
+        return jnp.sum(x)
+
+    result = float(total(arr))
+    expected = sum((rr + 1.0) * local for rr in range(n))
+    assert abs(result - expected) < 1e-6, (result, expected)
+    if r == 0:
+        print("PASS cross_process_sum", flush=True)
+
+    jax.distributed.shutdown()
+    print("rank %d: jax.distributed bootstrap tests passed" % r,
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+"""jax.distributed bootstrap e2e: horovod_tpu topology drives
+jax.distributed.initialize so jit programs span hosts (the reference's
+multi-host NCCL role, carried by XLA collectives over ICI/DCN —
+SURVEY §2.6/§5.8). CPU backend stands in for multi-host here; the
+cross-process sum rides jax's own distributed runtime."""
+
+import pytest
+
+pytestmark = pytest.mark.e2e
+
+
+def test_jax_distributed_bootstrap(run_launcher):
+    result = run_launcher(2, "jax_distributed_worker.py",
+                          extra_env={"JAX_PLATFORMS": "cpu"})
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "PASS cross_process_sum" in result.stdout

@@ -35,6 +35,30 @@ def test_resnet18_param_count():
     assert 11e6 < n < 12e6
 
 
+def test_vgg16_param_count():
+    from horovod_tpu.models import VGG16
+    model = VGG16(num_classes=1000, dtype=jnp.float32)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 224, 224, 3)), train=False))
+    n = sum(p.size for p in jax.tree_util.tree_leaves(variables["params"]))
+    assert abs(n - 138_357_544) < 1e5, n  # the canonical VGG-16 count
+
+
+def test_inception_v3_shapes_and_params():
+    from horovod_tpu.models import InceptionV3
+    model = InceptionV3(num_classes=1000, dtype=jnp.float32)
+    x = jax.ShapeDtypeStruct((2, 299, 299, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda x: model.init(jax.random.PRNGKey(0), x, train=False), x)
+    n = sum(p.size for p in jax.tree_util.tree_leaves(variables["params"]))
+    # Keras InceptionV3 (no aux head): 23,851,784 params.
+    assert 23e6 < n < 25e6, n
+    logits = jax.eval_shape(
+        lambda v, x: model.apply(v, x, train=False), variables, x)
+    assert logits.shape == (2, 1000)
+
+
 def test_mnist_cnn_forward():
     from horovod_tpu.models import MnistCNN
     model = MnistCNN(dtype=jnp.float32)
