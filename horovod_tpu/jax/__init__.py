@@ -320,7 +320,13 @@ def init_distributed(local_device_ids=None):
     port = int(np.asarray(_ops.broadcast(
         np.array([port], np.int64), 0, "jax_dist.coordinator_port"))[0])
     addrs = (os.environ.get("HVD_TPU_ADDRS") or "").split(",")
-    host = addrs[0].rsplit(":", 1)[0] if addrs[0] else "127.0.0.1"
+    if not addrs[0]:
+        # Unreachable after a size>1 init (the core requires the addr
+        # table); fail fast rather than pointing peers at loopback.
+        raise RuntimeError(
+            "HVD_TPU_ADDRS is not set; cannot derive the jax.distributed "
+            "coordinator host")
+    host = addrs[0].rsplit(":", 1)[0]
     jax.distributed.initialize(
         coordinator_address="%s:%d" % (host, port),
         num_processes=size, process_id=_hvd.rank(),

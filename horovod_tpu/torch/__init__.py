@@ -110,14 +110,78 @@ def synchronize(handle):
     return result
 
 
+# -- differentiable collectives (reference: the autograd Functions in
+# horovod/torch/mpi_ops.py:117-128,243-261,325-339) ------------------------
+
+class _AllreduceFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, average, name, prescale, postscale):
+        ctx.average, ctx.name = average, name
+        ctx.prescale, ctx.postscale = prescale, postscale
+        return synchronize(
+            allreduce_async(tensor, average, name, prescale, postscale))
+
+    @staticmethod
+    def backward(ctx, grad):
+        # The gradient of an allreduce is the allreduce of the gradient
+        # with the same scaling.
+        reduced = _AllreduceFunction.apply(
+            grad, ctx.average, ctx.name and ctx.name + ".grad",
+            ctx.prescale, ctx.postscale)
+        return reduced, None, None, None, None
+
+
+class _AllgatherFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim0 = tensor.shape[0]
+        ctx.name = name or _auto_name("allgather")
+        return synchronize(allgather_async(tensor, ctx.name))
+
+    @staticmethod
+    def backward(ctx, grad):
+        # Sum (not average) the upstream grads — the reference's exact
+        # convention (torch/mpi_ops.py:254 `allreduce(grad_output,
+        # average=False)`): the objective is implicitly the sum of every
+        # rank's loss. Then slice out this rank's segment; the segment
+        # table comes from an allgather of first dims so unequal gathers
+        # differentiate correctly.
+        grad_sum = synchronize(allreduce_async(
+            grad.contiguous(), average=False, name=ctx.name + ".grad"))
+        sizes = synchronize(allgather_async(
+            torch.tensor([ctx.dim0], dtype=torch.int64),
+            name=ctx.name + ".grad_sizes"))
+        offset = int(sizes[:rank()].sum())
+        return grad_sum[offset:offset + ctx.dim0], None
+
+
+class _BroadcastFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        ctx.name = name or _auto_name("broadcast")
+        return synchronize(broadcast_async(tensor, root_rank, ctx.name))
+
+    @staticmethod
+    def backward(ctx, grad):
+        # Every rank's output grad sums onto the root's input (reference
+        # torch/mpi_ops.py:336 uses average=False the same way);
+        # non-root inputs are unused.
+        reduced = synchronize(allreduce_async(
+            grad.contiguous(), average=False, name=ctx.name + ".grad"))
+        if rank() != ctx.root_rank:
+            reduced = torch.zeros_like(reduced)
+        return reduced, None, None
+
+
 # -- sync wrappers ---------------------------------------------------------
 
 def allreduce(tensor, average=True, name=None, compression=Compression.none,
               prescale_factor=1.0, postscale_factor=1.0):
     compressed, ctx = compression.compress(tensor)
-    handle = allreduce_async(compressed, average, name, prescale_factor,
-                             postscale_factor)
-    return compression.decompress(synchronize(handle), ctx)
+    reduced = _AllreduceFunction.apply(compressed, average, name,
+                                       prescale_factor, postscale_factor)
+    return compression.decompress(reduced, ctx)
 
 
 def allreduce_(tensor, average=True, name=None,
@@ -127,11 +191,11 @@ def allreduce_(tensor, average=True, name=None,
 
 
 def allgather(tensor, name=None):
-    return synchronize(allgather_async(tensor, name))
+    return _AllgatherFunction.apply(tensor, name)
 
 
 def broadcast(tensor, root_rank, name=None):
-    return synchronize(broadcast_async(tensor, root_rank, name))
+    return _BroadcastFunction.apply(tensor, root_rank, name)
 
 
 def broadcast_(tensor, root_rank, name=None):

@@ -1,0 +1,38 @@
+"""Fault-injection worker: rank 1 dies mid-job; surviving ranks must be
+torn down by the launcher's failure fan-out (no hang) and the job exits
+nonzero (reference behavior: horovod's launcher kills the remaining
+ranks when any rank fails)."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    # One successful collective proves the job was healthy first.
+    out = hvd.allreduce(np.ones(4, np.float32), "pre_crash")
+    assert np.allclose(out, n), out
+    if r == 1:
+        print("rank 1 crashing now", flush=True)
+        os._exit(17)
+    # Survivors enqueue another collective that can never complete and
+    # wait for the launcher to kill them; exiting on our own would make
+    # the test pass vacuously.
+    try:
+        hvd.allreduce(np.ones(4, np.float32), "post_crash")
+    except Exception as e:  # stall shutdown also acceptable
+        print("rank %d: collective failed after crash: %s" % (r, e),
+              flush=True)
+        return 1
+    time.sleep(300)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,26 @@
+"""Failure fan-out e2e: when one rank dies, the launcher must kill the
+survivors and report failure promptly (reference: run.py's
+one-failed-rank teardown; SURVEY §5.3 failure-detection obligations)."""
+
+import time
+
+import pytest
+
+pytestmark = pytest.mark.e2e
+
+
+def test_worker_crash_tears_down_job(run_launcher):
+    t0 = time.monotonic()
+    # Tight stall timers so the survivors' pending collective is also
+    # bounded if teardown were to miss them.
+    result = run_launcher(3, "crash_worker.py", extra_env={
+        "HVD_TPU_STALL_CHECK_TIME_SECONDS": "5",
+        "HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS": "60",
+    }, timeout=120)
+    elapsed = time.monotonic() - t0
+    assert result.returncode != 0, "job must fail when a rank dies"
+    assert "rank 1 crashing now" in result.stdout
+    # Teardown must come from the launcher's failure fan-out (seconds),
+    # not from the workers' own 300s sleep or the stall shutdown.
+    assert elapsed < 60, "teardown took %.0fs - failure fan-out broken" \
+        % elapsed

@@ -38,6 +38,22 @@ def test_host_allgather_broadcast():
     assert np.allclose(hvd.broadcast(x, 0), x)
 
 
+def test_shutdown_reinit_cycles():
+    """The core must survive init/shutdown/init cycles in one process
+    (VERDICT round-1 lifecycle obligation; exercised by spark task reuse
+    and notebook workflows)."""
+    import horovod_tpu as hvd_core
+    for cycle in range(2):
+        hvd_core.init()
+        assert hvd_core.is_initialized()
+        out = hvd.allreduce(jnp.ones(3), average=False,
+                            name="cycle.%d" % cycle)
+        assert np.allclose(out, 1.0)
+        hvd_core.shutdown()
+        assert not hvd_core.is_initialized()
+    hvd_core.init()  # leave initialized for the rest of the module
+
+
 def test_host_allgather_empty():
     # Zero rows is legal (reference allgatherv semantics); the zero-copy
     # view path must not choke on the core's null empty-buffer pointer.

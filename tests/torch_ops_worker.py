@@ -52,6 +52,39 @@ def test_allgather(r, n):
         off += rr + 1
 
 
+def test_gradients_through_collectives(r, n):
+    """Collectives are differentiable autograd nodes (reference:
+    torch/mpi_ops.py autograd Functions); same sum-of-per-rank-losses
+    gradient convention as the TF binding."""
+    # allreduce: y = mean_r(x_r); L_r = sum(y) * (r+1); dL/dx on every
+    # rank is mean_r(r+1) (the grad itself is allreduce-averaged).
+    x = torch.ones(3, requires_grad=True)
+    y = hvd.allreduce(x, average=True, name="t_gar")
+    (y.sum() * (r + 1)).backward()
+    exp = sum(rr + 1 for rr in range(n)) / n
+    assert np.allclose(x.grad.numpy(), exp), x.grad
+
+    # allgather with unequal first dims: rank r contributes r+1 rows;
+    # grads sum across ranks then slice this rank's segment.
+    x = torch.full((r + 1, 2), float(r), requires_grad=True)
+    y = hvd.allgather(x, name="t_gag")
+    w = torch.arange(1.0, y.shape[0] + 1)
+    (y[:, 0] * w).sum().backward()
+    offset = sum(rr + 1 for rr in range(r))
+    exp_rows = (np.arange(offset, offset + r + 1) + 1) * n
+    assert np.allclose(x.grad.numpy()[:, 0], exp_rows), x.grad
+    assert np.allclose(x.grad.numpy()[:, 1], 0.0)
+
+    # broadcast: every rank's ones-grad sums onto the root; non-roots
+    # get zeros.
+    x = torch.ones(4, requires_grad=True) * 1.0
+    x.retain_grad()
+    y = hvd.broadcast(x, 0, name="t_gbc")
+    y.sum().backward()
+    exp = float(n) if r == 0 else 0.0
+    assert np.allclose(x.grad.numpy(), exp), x.grad
+
+
 def test_broadcast(r, n):
     x = torch.full((2, 2), float(r + 1))
     out = hvd.broadcast(x, 0, name="t_bc")
