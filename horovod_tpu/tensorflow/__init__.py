@@ -118,8 +118,13 @@ def broadcast_variables(variables, root_rank=0):
     broadcast_global_variables / tensorflow/__init__.py:87-141)."""
     for i, var in enumerate(variables):
         name = "bc_var.%d.%s" % (i, getattr(var, "name", i))
-        var.assign(broadcast(var.value() if hasattr(var, "value") else var,
-                             root_rank, name=name))
+        # tf.Variable has .value() (method); Keras-3 variables have
+        # .value (property).
+        value = getattr(var, "value", var)
+        if callable(value):
+            value = value()
+        var.assign(broadcast(tf.convert_to_tensor(value), root_rank,
+                             name=name))
 
 
 class DistributedGradientTape(tf.GradientTape):

@@ -61,6 +61,16 @@ def test_pytorch_imagenet_resnet50_example():
     assert "done" in proc.stdout
 
 
+def test_tensorflow2_synthetic_benchmark_example():
+    proc = run_example(2, "tensorflow2_synthetic_benchmark.py",
+                       ["--image-size", "64", "--num-classes", "10",
+                        "--batch-size", "4", "--num-warmup-batches", "1",
+                        "--num-batches-per-iter", "2", "--num-iters", "2"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Img/sec per rank" in proc.stdout
+    assert "done" in proc.stdout
+
+
 def test_keras_spark_rossmann_example():
     proc = run_example(2, "keras_spark_rossmann.py",
                        ["--local", "--epochs", "1",
