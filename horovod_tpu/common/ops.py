@@ -33,7 +33,9 @@ def allreduce_async(tensor, name, prescale_factor=1.0, postscale_factor=1.0):
     """Starts an allreduce (sum) on a numpy array; returns a handle."""
     basics = get_basics()
     arr = np.ascontiguousarray(tensor)
-    out = np.empty_like(arr)
+    # ascontiguousarray promotes 0-d to (1,); the result must round-trip
+    # the caller's shape (a reshape view shares the output buffer).
+    out = np.empty_like(arr).reshape(np.shape(tensor))
     handle = basics.lib.horovod_tpu_enqueue_allreduce(
         name.encode("utf-8"), arr.ctypes.data_as(ctypes.c_void_p),
         out.ctypes.data_as(ctypes.c_void_p), arr.ndim, _shape_array(arr),
@@ -60,7 +62,7 @@ def broadcast_async(tensor, root_rank, name):
     """Starts a broadcast from root_rank; returns a handle."""
     basics = get_basics()
     arr = np.ascontiguousarray(tensor)
-    out = np.empty_like(arr)
+    out = np.empty_like(arr).reshape(np.shape(tensor))
     handle = basics.lib.horovod_tpu_enqueue_broadcast(
         name.encode("utf-8"), arr.ctypes.data_as(ctypes.c_void_p),
         out.ctypes.data_as(ctypes.c_void_p), arr.ndim, _shape_array(arr),

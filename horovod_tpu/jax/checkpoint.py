@@ -1,0 +1,75 @@
+"""Consistent checkpoint/restore for data-parallel jax training.
+
+SURVEY §5.4 obligations: the reference has no checkpoint code of its own
+— its pattern is "rank 0 saves, everyone restores (or rank 0 restores
+and broadcasts)" (reference `examples/pytorch_imagenet_resnet50.py`
+resume_from_epoch + `hvd.broadcast`). This module packages that pattern
+over orbax for optax/flax pytrees:
+
+* :func:`save` — rank 0 writes the pytree(s); other ranks no-op. A
+  barrier (tiny allreduce) ensures no rank races ahead before the write
+  is durable.
+* :func:`restore` — rank 0 reads from disk, every rank receives the
+  values via the core broadcast plane — so shared filesystems are NOT
+  required (exactly the reference's broadcast-restore shape).
+"""
+
+import numpy as np
+
+import horovod_tpu as _hvd
+from horovod_tpu.common import ops as _ops
+
+from . import broadcast_parameters
+
+
+def _barrier(name):
+    _ops.allreduce(np.zeros(1, np.float32), name)
+
+
+def save(path, tree, step=None):
+    """Saves `tree` (any pytree of arrays) at `path` from rank 0.
+
+    `step` appends a numbered subdirectory (path/<step>), the usual
+    orbax layout for training runs. Returns the concrete directory
+    written (on every rank, for logging)."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    target = os.path.join(str(path), str(step)) if step is not None \
+        else str(path)
+    if _hvd.rank() == 0:
+        with ocp.PyTreeCheckpointer() as ckpt:
+            ckpt.save(os.path.abspath(target), tree, force=True)
+    if _hvd.size() > 1:
+        _barrier("ckpt_save.%s" % (step if step is not None else "x"))
+    return target
+
+
+def restore(path, template, step=None, root_rank=0):
+    """Restores the pytree written by :func:`save`.
+
+    `template` supplies the structure/dtypes (e.g. a freshly-initialized
+    params/opt_state pytree). Only `root_rank` touches the filesystem;
+    the values reach every other rank over the core broadcast plane, so
+    workers without access to the checkpoint directory still restore
+    consistently."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    target = os.path.join(str(path), str(step)) if step is not None \
+        else str(path)
+    if _hvd.rank() == root_rank:
+        # Restore WITH the template so orbax rebuilds the exact pytree
+        # structure (namedtuples/custom nodes would otherwise come back
+        # as dicts whose sorted-key leaf order can silently permute
+        # same-shaped leaves).
+        with ocp.PyTreeCheckpointer() as ckpt:
+            tree = ckpt.restore(os.path.abspath(target), item=template)
+    else:
+        tree = template
+    if _hvd.size() > 1:
+        tree = broadcast_parameters(tree, root_rank=root_rank,
+                                    name_prefix="ckpt_restore")
+    return tree

@@ -1,0 +1,48 @@
+"""2-rank checkpoint/restore worker: rank 0 saves and reads; rank 1
+receives values purely over the broadcast plane (it passes a
+nonexistent path, proving no shared filesystem is needed). The state
+includes a non-alphabetical namedtuple (the optax-state shape) to pin
+structure-faithful restore: same-dtype scalar fields must not permute."""
+
+import collections
+import os
+import sys
+import tempfile
+
+import numpy as np
+import jax.numpy as jnp
+
+import horovod_tpu.jax as hvd
+from horovod_tpu.jax import checkpoint
+
+# Field order deliberately non-alphabetical (zz before aa).
+Counters = collections.namedtuple("Counters", ["zz_mini", "aa_grad"])
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    tree = {"w": jnp.full((2, 2), 10.0 + r),  # ranks differ pre-restore
+            "step": jnp.int32(5 * (r + 1)),
+            "counters": Counters(zz_mini=jnp.int32(111),
+                                 aa_grad=jnp.int32(222))}
+    tmpdir = tempfile.mkdtemp() if r == 0 else "/nonexistent/ckpt"
+    checkpoint.save(tmpdir, tree, step=1)  # rank 1's path never touched
+
+    template = {"w": jnp.zeros((2, 2)), "step": jnp.int32(0),
+                "counters": Counters(zz_mini=jnp.int32(0),
+                                     aa_grad=jnp.int32(0))}
+    out = checkpoint.restore(tmpdir, template, step=1)
+    # Everyone must hold rank 0's values, fields un-permuted.
+    assert np.allclose(out["w"], 10.0), out["w"]
+    assert int(out["step"]) == 5, out["step"]
+    assert int(out["counters"].zz_mini) == 111, out["counters"]
+    assert int(out["counters"].aa_grad) == 222, out["counters"]
+    print("rank %d: checkpoint tests passed" % r, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,13 @@
+"""Checkpoint/consistent-restore e2e (SURVEY §5.4): rank 0 owns the
+files; other ranks restore over the broadcast plane with no shared
+filesystem."""
+
+import pytest
+
+pytestmark = pytest.mark.e2e
+
+
+def test_checkpoint_restore_via_broadcast(run_launcher):
+    result = run_launcher(2, "checkpoint_worker.py")
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert result.stdout.count("checkpoint tests passed") == 2

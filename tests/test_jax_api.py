@@ -54,6 +54,15 @@ def test_shutdown_reinit_cycles():
     hvd_core.init()  # leave initialized for the rest of the module
 
 
+def test_scalar_shape_roundtrip():
+    """0-d tensors must come back 0-d (ascontiguousarray promotes them
+    to (1,) internally; the caller's shape wins)."""
+    out = hvd.allreduce(jnp.float32(2.0), average=False)
+    assert out.shape == (), out.shape
+    out = hvd.broadcast(jnp.int32(5), 0)
+    assert out.shape == (), out.shape
+
+
 def test_host_allgather_empty():
     # Zero rows is legal (reference allgatherv semantics); the zero-copy
     # view path must not choke on the core's null empty-buffer pointer.
