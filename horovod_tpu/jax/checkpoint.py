@@ -26,8 +26,9 @@ def _barrier(name):
     _ops.allreduce(np.zeros(1, np.float32), name)
 
 
-def save(path, tree, step=None):
-    """Saves `tree` (any pytree of arrays) at `path` from rank 0.
+def save(path, tree, step=None, root_rank=0):
+    """Saves `tree` (any pytree of arrays) at `path` from `root_rank`
+    (pass the same root to :func:`restore`).
 
     `step` appends a numbered subdirectory (path/<step>), the usual
     orbax layout for training runs. Returns the concrete directory
@@ -38,7 +39,7 @@ def save(path, tree, step=None):
 
     target = os.path.join(str(path), str(step)) if step is not None \
         else str(path)
-    if _hvd.rank() == 0:
+    if _hvd.rank() == root_rank:
         with ocp.PyTreeCheckpointer() as ckpt:
             ckpt.save(os.path.abspath(target), tree, force=True)
     if _hvd.size() > 1:
@@ -67,6 +68,15 @@ def restore(path, template, step=None, root_rank=0):
         # same-shaped leaves).
         with ocp.PyTreeCheckpointer() as ckpt:
             tree = ckpt.restore(os.path.abspath(target), item=template)
+        # Conform dtypes to the template BEFORE the broadcast: the saved
+        # dtypes may differ (e.g. bf16 checkpoint, f32 template) and the
+        # controller rejects mixed-dtype collectives across ranks.
+        import jax
+        import jax.numpy as jnp
+
+        tree = jax.tree_util.tree_map(
+            lambda r, t: jnp.asarray(r, dtype=t.dtype)
+            if hasattr(t, "dtype") else r, tree, template)
     else:
         tree = template
     if _hvd.size() > 1:

@@ -26,18 +26,25 @@ def main():
 
     tree = {"w": jnp.full((2, 2), 10.0 + r),  # ranks differ pre-restore
             "step": jnp.int32(5 * (r + 1)),
+            # Saved bf16, restored into an f32 template: the restore
+            # must conform dtypes before the cross-rank broadcast.
+            "mu": jnp.full((3,), 0.5, jnp.bfloat16),
             "counters": Counters(zz_mini=jnp.int32(111),
                                  aa_grad=jnp.int32(222))}
     tmpdir = tempfile.mkdtemp() if r == 0 else "/nonexistent/ckpt"
     checkpoint.save(tmpdir, tree, step=1)  # rank 1's path never touched
 
     template = {"w": jnp.zeros((2, 2)), "step": jnp.int32(0),
+                "mu": jnp.zeros((3,), jnp.float32),
                 "counters": Counters(zz_mini=jnp.int32(0),
                                      aa_grad=jnp.int32(0))}
     out = checkpoint.restore(tmpdir, template, step=1)
-    # Everyone must hold rank 0's values, fields un-permuted.
+    # Everyone must hold rank 0's values, fields un-permuted, dtypes
+    # conformed to the template.
     assert np.allclose(out["w"], 10.0), out["w"]
     assert int(out["step"]) == 5, out["step"]
+    assert out["mu"].dtype == jnp.float32, out["mu"].dtype
+    assert np.allclose(np.asarray(out["mu"]), 0.5), out["mu"]
     assert int(out["counters"].zz_mini) == 111, out["counters"]
     assert int(out["counters"].aa_grad) == 222, out["counters"]
     print("rank %d: checkpoint tests passed" % r, flush=True)

@@ -178,6 +178,18 @@ def test_keras_distributed_optimizer(r, n):
         assert np.allclose(avg, wt, atol=1e-6), i
 
 
+def test_tensorflow_keras_alias(r, n):
+    """horovod_tpu.tensorflow.keras is the same shell as .keras
+    (reference import-path parity: horovod.tensorflow.keras)."""
+    import horovod_tpu.keras as hk
+    import horovod_tpu.tensorflow.keras as htk
+
+    assert htk.DistributedOptimizer is hk.DistributedOptimizer
+    assert htk.callbacks.MetricAverageCallback \
+        is hk.callbacks.MetricAverageCallback
+    assert htk.rank() == r and htk.size() == n
+
+
 def test_keras_callbacks(r, n):
     import keras
     import horovod_tpu.keras as hvd_keras
