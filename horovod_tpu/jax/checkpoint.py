@@ -6,12 +6,13 @@ and broadcasts)" (reference `examples/pytorch_imagenet_resnet50.py`
 resume_from_epoch + `hvd.broadcast`). This module packages that pattern
 over orbax for optax/flax pytrees:
 
-* :func:`save` — rank 0 writes the pytree(s); other ranks no-op. A
-  barrier (tiny allreduce) ensures no rank races ahead before the write
-  is durable.
-* :func:`restore` — rank 0 reads from disk, every rank receives the
-  values via the core broadcast plane — so shared filesystems are NOT
-  required (exactly the reference's broadcast-restore shape).
+* :func:`save` — the root rank (default 0) writes the pytree(s); other
+  ranks no-op. A barrier (tiny allreduce) ensures no rank races ahead
+  before the write is durable.
+* :func:`restore` — the same root rank reads from disk, every rank
+  receives the values via the core broadcast plane — so shared
+  filesystems are NOT required (exactly the reference's
+  broadcast-restore shape).
 """
 
 import numpy as np

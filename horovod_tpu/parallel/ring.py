@@ -151,14 +151,10 @@ def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):
                               tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg,
-                   preferred_element_type=jnp.float32) * scale
-    if causal:
-        L = s.shape[2]
-        mask = lax.broadcasted_iota(jnp.int32, (L, L), 0) >= \
-            lax.broadcasted_iota(jnp.int32, (L, L), 1)
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1).astype(vg.dtype)
-    og = jnp.einsum("bhqk,bkhd->bqhd", p, vg,
-                    preferred_element_type=jnp.float32).astype(q.dtype)
+    # Local attention over the full sequence: flash_attention keeps it
+    # O(L) memory on TPU (custom VJP covers the backward) and itself
+    # falls back to the numerically-identical blockwise implementation
+    # on other backends/unaligned shapes.
+    from horovod_tpu.ops import flash_attention
+    og = flash_attention(qg, kg, vg, causal=causal, scale=scale)
     return heads_to_seq(og)
