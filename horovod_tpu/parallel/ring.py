@@ -18,6 +18,8 @@ Both are meant to run inside ``shard_map`` over a mesh axis (see
 `horovod_tpu.parallel.mesh.hybrid_mesh`).
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -50,11 +52,127 @@ def _block_attention(q, k, v, o, m, l, q_offset, kv_offset, causal, scale):
     return o_new, m_new, l_new
 
 
-def _use_flash_ring(Lq, Lk):
-    """The Pallas carry-state kernel needs TPU + 128-aligned sequence
-    shards (any head dim: blocks span the full D)."""
-    return (jax.default_backend() == "tpu" and Lq % 128 == 0
-            and Lk % 128 == 0)
+def _interpret_mode():
+    """HVD_TPU_PALLAS_INTERPRET=1 runs the ring kernel in Pallas
+    interpret mode on any backend (test coverage of the kernel path
+    without TPU hardware)."""
+    import os
+    return os.environ.get("HVD_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
+def _use_flash_ring(Lq, Lk, scale):
+    """The Pallas carry-state kernel needs 128-aligned sequence shards
+    (any head dim: blocks span the full D), a static scale (the kernel
+    closes over it), and a TPU default backend. The backend check is a
+    heuristic: a CPU mesh built on a TPU-attached host would be
+    misrouted for aligned shards — set HVD_TPU_RING_KERNEL=0 to force
+    the jnp path there (or HVD_TPU_PALLAS_INTERPRET=1 to run the kernel
+    in interpret mode anywhere)."""
+    import os
+
+    if Lq % 128 != 0 or Lk % 128 != 0:
+        return False
+    if not isinstance(scale, (int, float)):
+        return False  # traced scale: the jnp path differentiates it
+    if os.environ.get("HVD_TPU_RING_KERNEL", "1") == "0":
+        return False
+    return jax.default_backend() == "tpu" or _interpret_mode()
+
+
+def _ring_jnp(q, k, v, axis_name, causal, scale, remat=False):
+    """Blockwise jnp ring (fallback + the backward's recompute target).
+
+    ``remat=True`` wraps the per-step block update in ``jax.checkpoint``
+    so differentiating this function stores only the O(shard) step
+    inputs instead of every step's [B, H, Lq, Lk] score/probability
+    residuals — the flash path's backward uses that to keep its memory
+    profile."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    step = functools.partial(_block_attention, causal=causal, scale=scale)
+    if remat:
+        step = jax.checkpoint(step)
+
+    o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+
+    def body(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        src = (idx - i) % n  # which global block we currently hold
+        o, m, l = step(q, k_blk, v_blk, o, m, l,
+                       q_offset=idx * Lq, kv_offset=src * Lk)
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return o, m, l, k_nxt, v_nxt
+
+    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, scale):
+    """Pallas ring forward: each arriving k/v shard is consumed by the
+    carry-state flash kernel. Wrapped in a custom VJP because Pallas
+    kernels are not auto-differentiable; the backward recomputes through
+    the jnp ring (exact, ppermute transposes cleanly)."""
+    from horovod_tpu.ops.flash_attention import flash_ring_step
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    # Kernel layout: [B*H, L, D]; state carried across ring steps.
+    def to_kernel(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, -1, x.shape[-1])
+
+    # Transpose once; the ring circulates kernel-layout k/v shards.
+    qk, kk, vk = to_kernel(q), to_kernel(k), to_kernel(v)
+    o0 = jnp.zeros((B * H, Lq, D), jnp.float32)
+    m0 = jnp.full((B * H, Lq, 8), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B * H, Lq, 8), jnp.float32)
+
+    def body(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        src = (idx - i) % n
+        o, m, l = flash_ring_step(
+            qk, k_blk, v_blk, o, m, l,
+            q_offset=idx * Lq, kv_offset=src * Lk, causal=causal,
+            scale=scale, interpret=_interpret_mode())
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return o, m, l, k_nxt, v_nxt
+
+    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, kk, vk))
+    l1 = l[:, :, :1]
+    out = o / jnp.where(l1 == 0.0, 1.0, l1)
+    return out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale):
+    return _ring_flash(q, k, v, axis_name, causal, scale), (q, k, v)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, res, g):
+    q, k, v = res
+    # remat: store O(shard) step inputs, rebuild each step's scores
+    # during the backward instead of keeping n full score matrices.
+    _, vjp = jax.vjp(
+        lambda q, k, v: _ring_jnp(q, k, v, axis_name, causal, scale,
+                                  remat=True),
+        q, k, v)
+    return vjp(g)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ring_attention(q, k, v, axis_name, causal=True, scale=None):
@@ -68,64 +186,17 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None):
     Pallas flash kernel with carried online-softmax state
     (`horovod_tpu.ops.flash_attention.flash_ring_step`), so per-step
     memory is O(block) instead of the O(Lq * Lk) score matrix; other
-    backends/shapes use the blockwise jnp path below.
+    backends/shapes use the blockwise jnp path. Gradients flow on both
+    paths (the kernel path recomputes its backward through the jnp
+    ring).
     """
-    n = lax.psum(1, axis_name)
-    idx = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
-    perm = [(j, (j + 1) % n) for j in range(n)]
-
-    if _use_flash_ring(Lq, Lk):
-        from horovod_tpu.ops.flash_attention import flash_ring_step
-
-        # Kernel layout: [B*H, L, D]; state carried across ring steps.
-        def to_kernel(x):
-            return x.transpose(0, 2, 1, 3).reshape(B * H, -1, x.shape[-1])
-
-        # Transpose once; the ring circulates kernel-layout k/v shards.
-        qk, kk, vk = to_kernel(q), to_kernel(k), to_kernel(v)
-        o0 = jnp.zeros((B * H, Lq, D), jnp.float32)
-        m0 = jnp.full((B * H, Lq, 8), -jnp.inf, jnp.float32)
-        l0 = jnp.zeros((B * H, Lq, 8), jnp.float32)
-
-        def body(i, carry):
-            o, m, l, k_blk, v_blk = carry
-            src = (idx - i) % n
-            o, m, l = flash_ring_step(
-                qk, k_blk, v_blk, o, m, l,
-                q_offset=idx * Lq, kv_offset=src * Lk, causal=causal,
-                scale=scale)
-            k_nxt = lax.ppermute(k_blk, axis_name, perm)
-            v_nxt = lax.ppermute(v_blk, axis_name, perm)
-            return o, m, l, k_nxt, v_nxt
-
-        o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, kk, vk))
-        l1 = l[:, :, :1]
-        out = o / jnp.where(l1 == 0.0, 1.0, l1)
-        return out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3) \
-            .astype(q.dtype)
-
-    o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
-    m0 = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B, H, Lq), jnp.float32)
-
-    def body(i, carry):
-        o, m, l, k_blk, v_blk = carry
-        src = (idx - i) % n  # which global block we currently hold
-        o, m, l = _block_attention(q, k_blk, v_blk, o, m, l,
-                                   q_offset=idx * Lq, kv_offset=src * Lk,
-                                   causal=causal, scale=scale)
-        k_nxt = lax.ppermute(k_blk, axis_name, perm)
-        v_nxt = lax.ppermute(v_blk, axis_name, perm)
-        return o, m, l, k_nxt, v_nxt
-
-    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
-    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
-    out = o / l.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    if _use_flash_ring(Lq, Lk, scale):
+        return _ring_flash(q, k, v, axis_name, causal, scale)
+    return _ring_jnp(q, k, v, axis_name, causal, scale)
 
 
 def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):

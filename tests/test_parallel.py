@@ -51,6 +51,45 @@ def test_ring_attention_matches_dense(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ring_attention_flash_path_values_and_grads(monkeypatch):
+    """The TPU kernel ring path (forced via interpret mode on CPU):
+    values AND gradients must match dense — pins the custom VJP that
+    makes the Pallas path differentiable (a plain pallas_call is not)."""
+    from horovod_tpu.parallel import ring_attention
+    monkeypatch.setenv("HVD_TPU_PALLAS_INTERPRET", "1")
+    n = 2
+    B, L, H, D = 1, 256, 2, 16  # 128-per-shard, kernel path eligible
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    expected = _dense_reference(q, k, v, causal=True)
+
+    mesh = _mesh(n, "sp")
+
+    def loss(q, k, v):
+        out = ring_attention(q, k, v, "sp", causal=True)
+        return out, jnp.sum(out.astype(jnp.float32) ** 2)
+
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: (loss(q, k, v)[0],) + tuple(
+            jax.grad(lambda q, k, v: loss(q, k, v)[1],
+                     argnums=(0, 1, 2))(q, k, v)),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+        out_specs=(P(None, "sp"),) * 4, check_vma=False))
+    out, gq, gk, gv = f(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(_dense_reference(q, k, v, True) ** 2)
+
+    dq, dk, dv = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for got, exp in ((gq, dq), (gk, dk), (gv, dv)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_ulysses_attention_matches_dense():
     from horovod_tpu.parallel import ulysses_attention
     n = 4
