@@ -121,8 +121,14 @@ class RendezvousServer:
                                        "application/json")
                 self._reply(400, b"bad path")
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._httpd.daemon_threads = True
+        class Server(ThreadingHTTPServer):
+            # socketserver's default listen backlog of 5 drops
+            # connections when every worker of a large job publishes at
+            # once (observed at 32 local ranks: ECONNRESET on PUT).
+            request_queue_size = 512
+            daemon_threads = True
+
+        self._httpd = Server((host, port), Handler)
         self._thread = None
 
     @property
@@ -159,12 +165,32 @@ def _request(method, addr, path, body=None):
     return urllib.request.urlopen(req, timeout=10)
 
 
-def put(addr, scope, key, value):
+def put(addr, scope, key, value, timeout=30):
+    """Publishes key=value, retrying transient connection failures:
+    under a large simultaneous fan-out the server may reset or refuse
+    individual connections even with a deep listen backlog."""
     if isinstance(value, str):
         value = value.encode()
-    with _request("PUT", addr, "/set/%s/%s" % (scope, key), value) as resp:
-        if resp.status != 200:
-            raise RuntimeError("rendezvous PUT failed: HTTP %d" % resp.status)
+    deadline = time.monotonic() + timeout
+    delay = 0.05
+    while True:
+        try:
+            with _request("PUT", addr, "/set/%s/%s" % (scope, key),
+                          value):
+                return
+        except urllib.error.HTTPError:
+            raise  # auth/size errors are not transient
+        except OSError as e:
+            # DNS failure means a misconfigured address, not a burst —
+            # surface it immediately instead of retrying for 30s.
+            reason = getattr(e, "reason", e)
+            if isinstance(reason, socket.gaierror):
+                raise
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "rendezvous PUT to %s kept failing: %s" % (addr, e))
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
 
 
 def get(addr, scope, key):

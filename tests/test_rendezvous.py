@@ -72,6 +72,31 @@ def test_resolve_topology_worker_chosen_ports(server):
         assert env["HVD_TPU_CROSS_SIZE"] == "1"
 
 
+def test_publish_burst(server):
+    """Every worker of a large job publishes at the same instant; the
+    deep listen backlog + client retry must absorb the burst (the
+    socketserver default backlog of 5 dropped connections at 32 ranks)."""
+    addr = "127.0.0.1:%d" % server.port
+    n = 64
+    errors = []
+
+    def publish(i):
+        try:
+            rendezvous.put(addr, "burst", str(i), b"w%d" % i, timeout=30)
+        except Exception as e:  # pragma: no cover
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=publish, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors[:3]
+    table = rendezvous.list_scope(addr, "burst")
+    assert len(table) == n
+
+
 def test_hmac_auth(monkeypatch):
     """Signed-request parity with the reference's HMAC-authenticated
     launcher services (run/common/util/secret.py): unsigned or
