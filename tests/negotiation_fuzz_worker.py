@@ -1,0 +1,76 @@
+"""Negotiation fuzz: every rank enqueues the same set of collectives in
+a different (rank-seeded) order, interleaving allreduce/allgather/
+broadcast, then synchronizes in yet another order. The coordinator's
+whole job is to make this safe (reference CI covers it implicitly via
+framework-threaded enqueue; here it is explicit)."""
+
+import random
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import ops
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n >= 2
+
+    num_tensors = 40
+    jobs = []
+    for i in range(num_tensors):
+        kind = ("allreduce", "allgather", "broadcast")[i % 3]
+        jobs.append((i, kind))
+
+    # Same job set, rank-specific enqueue order.
+    order = list(range(num_tensors))
+    random.Random(1234 + r).shuffle(order)
+
+    handles = {}
+    for i in order:
+        idx, kind = jobs[i]
+        if kind == "allreduce":
+            arr = np.full((idx + 1, 3), float(r + 1), np.float32)
+            handles[idx] = ("allreduce",
+                            ops.allreduce_async(arr, "fuzz.%d" % idx))
+        elif kind == "allgather":
+            # Rank-dependent fill so a permuted segment order is caught.
+            arr = np.full((r + 1, 2), float(idx * 1000 + r), np.float32)
+            handles[idx] = ("allgather",
+                            ops.allgather_async(arr, "fuzz.%d" % idx))
+        else:
+            arr = np.full((2, idx + 1), float(r * 100 + idx), np.float32)
+            handles[idx] = ("broadcast",
+                            ops.broadcast_async(arr, idx % n,
+                                                "fuzz.%d" % idx))
+
+    # Synchronize in a different rank-specific order.
+    sync_order = list(range(num_tensors))
+    random.Random(4321 + r).shuffle(sync_order)
+    for idx in sync_order:
+        kind, handle = handles[idx]
+        out = ops.synchronize(handle)
+        if kind == "allreduce":
+            expected = sum(rr + 1 for rr in range(n))
+            assert out.shape == (idx + 1, 3), (idx, out.shape)
+            assert np.allclose(out, expected), (idx, out)
+        elif kind == "allgather":
+            assert out.shape == (sum(rr + 1 for rr in range(n)), 2), \
+                (idx, out.shape)
+            expected = np.concatenate(
+                [np.full((rr + 1, 2), float(idx * 1000 + rr), np.float32)
+                 for rr in range(n)])
+            assert np.allclose(out, expected), (idx, out)
+        else:
+            root = idx % n
+            assert out.shape == (2, idx + 1), (idx, out.shape)
+            assert np.allclose(out, float(root * 100 + idx)), (idx, out)
+
+    print("rank %d: negotiation fuzz passed" % r, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+"""Out-of-order negotiation e2e: ranks enqueue and synchronize the same
+collectives in different orders; the coordinator must still match and
+complete everything (the property the response cache, fusion look-ahead
+and cycle machinery all depend on)."""
+
+import pytest
+
+pytestmark = pytest.mark.e2e
+
+
+@pytest.mark.parametrize("np_", [2, 4])
+def test_negotiation_out_of_order(run_launcher, np_):
+    result = run_launcher(np_, "negotiation_fuzz_worker.py")
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert result.stdout.count("negotiation fuzz passed") == np_
