@@ -120,6 +120,16 @@ def _pick_block(L, preferred):
     return None
 
 
+def _require_block(L, preferred, what):
+    b = _pick_block(L, preferred)
+    if b is None:
+        raise ValueError(
+            f"{what}={L} must be a multiple of 128 for the Pallas ring "
+            f"kernels (got {L} % 128 == {L % 128}); pad the sequence "
+            "shard or use the jnp ring path")
+    return b
+
+
 def _pallas_forward_lse(q, k, v, scale, causal, interpret,
                         block_q=None, block_k=None):
     """Returns (out [B,H,L,D], lse [B*H, L, 8] f32) — lse is the
@@ -233,8 +243,8 @@ def flash_ring_step(q, k, v, o, m, l, q_offset, kv_offset, causal=True,
     Lk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
-    bq = block_q or _pick_block(Lq, 256)
-    bk = block_k or _pick_block(Lk, 512)
+    bq = block_q or _require_block(Lq, 256, "q shard length")
+    bk = block_k or _require_block(Lk, 512, "k/v shard length")
     num_kb = Lk // bk
     offs = jnp.array([[0, 0]], jnp.int32).at[0, 0].set(q_offset) \
         .at[0, 1].set(kv_offset)
@@ -270,6 +280,166 @@ def flash_ring_step(q, k, v, o, m, l, q_offset, kv_offset, causal=True,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(offs, q, k, v, o, m, l)
+
+
+def _ring_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                        delta_ref, dqi_ref, dqo_ref, dq_acc, *, scale,
+                        causal, num_kb):
+    """dQ contribution of one backward ring step (FlashAttention-2
+    math, global offsets like `_ring_step_kernel`). The dq accumulator
+    is carried *across ring steps* (dqi -> dqo, f32): each arriving k/v
+    shard adds its `sum_k dS.K` term; no forward recompute — p comes
+    from the saved per-row lse."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    block_q, block_k = q_ref.shape[0], k_ref.shape[0]
+    q_off = offs_ref[0, 0] + qi * block_q
+    kv_off = offs_ref[0, 1] + kj * block_k
+
+    @pl.when(kj == 0)
+    def _load():
+        dq_acc[...] = dqi_ref[...]
+
+    visible = (kv_off <= q_off + block_q - 1) if causal else kj >= 0
+
+    @pl.when(visible)
+    def _compute():
+        s = _masked_scores(q_ref, k_ref, scale, causal, q_off=q_off,
+                           kv_off=kv_off, fill=-jnp.inf)
+        p = jnp.exp(s - lse_ref[:, :1])  # masked entries: exp(-inf) = 0
+        dp = jax.lax.dot_general(
+            do_ref[...], v_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[:, :1]) * scale)
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == num_kb - 1)
+    def _store():
+        dqo_ref[...] = dq_acc[...]
+
+
+def _ring_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dki_ref, dvi_ref, dko_ref, dvo_ref,
+                         dk_acc, dv_acc, *, scale, causal, num_qb):
+    """dK/dV contribution of one backward ring step. The dk/dv
+    accumulators travel around the ring with their k/v shard (the
+    caller ppermutes them together), so after n steps each shard
+    arrives home with its full gradient. Grid (bh, k-block, q-block),
+    q innermost sequential."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    block_q, block_k = q_ref.shape[0], k_ref.shape[0]
+    q_off = offs_ref[0, 0] + qi * block_q
+    kv_off = offs_ref[0, 1] + kj * block_k
+
+    @pl.when(qi == 0)
+    def _load():
+        dk_acc[...] = dki_ref[...]
+        dv_acc[...] = dvi_ref[...]
+
+    visible = (q_off + block_q - 1 >= kv_off) if causal else qi >= 0
+
+    @pl.when(visible)
+    def _compute():
+        s = _masked_scores(q_ref, k_ref, scale, causal, q_off=q_off,
+                           kv_off=kv_off, fill=-jnp.inf)
+        p = jnp.exp(s - lse_ref[:, :1])  # masked entries: exp(-inf) = 0
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_ref[...], v_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[:, :1]) * scale).astype(q_ref.dtype)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_qb - 1)
+    def _store():
+        dko_ref[...] = dk_acc[...]
+        dvo_ref[...] = dv_acc[...]
+
+
+def flash_ring_bwd_step(q, k, v, do, lse, delta, dq, dk, dv, q_offset,
+                        kv_offset, causal=True, scale=None,
+                        interpret=False, block_q=None, block_k=None):
+    """One backward ring step over kernel-layout shards.
+
+    Args: q/do [BH, Lq, D], k/v [BH, Lk, D], lse/delta [BH, Lq, 8] f32
+    (per-row log-sum-exp from the forward; delta = rowsum(dO*O)),
+    dq [BH, Lq, D] f32 (local accumulator), dk/dv [BH, Lk, D] f32
+    (accumulators traveling with the k/v shard), q_offset/kv_offset
+    global token offsets. Returns updated (dq, dk, dv).
+    """
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    bq = block_q or _require_block(Lq, 256, "q shard length")
+    bk = block_k or _require_block(Lk, 512, "k/v shard length")
+    num_kb, num_qb = Lk // bk, Lq // bq
+    offs = jnp.array([[0, 0]], jnp.int32).at[0, 0].set(q_offset) \
+        .at[0, 1].set(kv_offset)
+
+    q_spec = lambda b, i, j: (b, i, 0)      # noqa: E731
+    stripe_spec = lambda b, i, j: (b, i, 0)  # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_ring_bwd_dq_kernel, scale=scale, causal=causal,
+                          num_kb=num_kb),
+        grid=(BH, num_qb, num_kb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((None, bq, D), q_spec),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, bq, D), q_spec),
+            pl.BlockSpec((None, bq, 8), stripe_spec),
+            pl.BlockSpec((None, bq, 8), stripe_spec),
+            pl.BlockSpec((None, bq, D), q_spec),
+        ],
+        out_specs=pl.BlockSpec((None, bq, D), q_spec),
+        out_shape=jax.ShapeDtypeStruct((BH, Lq, D), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v, do, lse, delta, dq)
+
+    k_spec = lambda b, j, i: (b, j, 0)  # noqa: E731
+    dk, dv = pl.pallas_call(
+        functools.partial(_ring_bwd_dkv_kernel, scale=scale,
+                          causal=causal, num_qb=num_qb),
+        grid=(BH, num_kb, num_qb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), k_spec),
+            pl.BlockSpec((None, bk, D), k_spec),
+            pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, 8), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, 8), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), k_spec),
+            pl.BlockSpec((None, bk, D), k_spec),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, D), k_spec),
+            pl.BlockSpec((None, bk, D), k_spec),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Lk, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Lk, D), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v, do, lse, delta, dk, dv)
+    return dq, dk, dv
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,

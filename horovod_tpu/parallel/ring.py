@@ -79,14 +79,8 @@ def _use_flash_ring(Lq, Lk, scale):
     return jax.default_backend() == "tpu" or _interpret_mode()
 
 
-def _ring_jnp(q, k, v, axis_name, causal, scale, remat=False):
-    """Blockwise jnp ring (fallback + the backward's recompute target).
-
-    ``remat=True`` wraps the per-step block update in ``jax.checkpoint``
-    so differentiating this function stores only the O(shard) step
-    inputs instead of every step's [B, H, Lq, Lk] score/probability
-    residuals — the flash path's backward uses that to keep its memory
-    profile."""
+def _ring_jnp(q, k, v, axis_name, causal, scale):
+    """Blockwise jnp ring (non-TPU / unaligned-shape fallback)."""
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
@@ -94,8 +88,6 @@ def _ring_jnp(q, k, v, axis_name, causal, scale, remat=False):
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     step = functools.partial(_block_attention, causal=causal, scale=scale)
-    if remat:
-        step = jax.checkpoint(step)
 
     o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
     m0 = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
@@ -116,12 +108,21 @@ def _ring_jnp(q, k, v, axis_name, causal, scale, remat=False):
     return out.astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_flash(q, k, v, axis_name, causal, scale):
-    """Pallas ring forward: each arriving k/v shard is consumed by the
-    carry-state flash kernel. Wrapped in a custom VJP because Pallas
-    kernels are not auto-differentiable; the backward recomputes through
-    the jnp ring (exact, ppermute transposes cleanly)."""
+def _to_kernel(x, B, H):
+    """[B, L, H, D] -> kernel layout [B*H, L, D]."""
+    return x.transpose(0, 2, 1, 3).reshape(B * H, -1, x.shape[-1])
+
+
+def _from_kernel(x, B, H):
+    """Kernel layout [B*H, L, D] -> [B, L, H, D]."""
+    BH, L, D = x.shape
+    return x.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+
+
+def _ring_flash_impl(q, k, v, axis_name, causal, scale):
+    """Pallas ring forward. Returns (out [B,Lq,H,D], out_k, lse) where
+    out_k is the normalized output in kernel layout and lse [B*H,Lq,8]
+    is the per-row log-sum-exp stripe the backward ring consumes."""
     from horovod_tpu.ops.flash_attention import flash_ring_step
 
     n = lax.psum(1, axis_name)
@@ -130,12 +131,10 @@ def _ring_flash(q, k, v, axis_name, causal, scale):
     Lk = k.shape[1]
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    # Kernel layout: [B*H, L, D]; state carried across ring steps.
-    def to_kernel(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, -1, x.shape[-1])
-
     # Transpose once; the ring circulates kernel-layout k/v shards.
-    qk, kk, vk = to_kernel(q), to_kernel(k), to_kernel(v)
+    qk = _to_kernel(q, B, H)
+    kk = _to_kernel(k, B, H)
+    vk = _to_kernel(v, B, H)
     o0 = jnp.zeros((B * H, Lq, D), jnp.float32)
     m0 = jnp.full((B * H, Lq, 8), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B * H, Lq, 8), jnp.float32)
@@ -152,24 +151,71 @@ def _ring_flash(q, k, v, axis_name, causal, scale):
         return o, m, l, k_nxt, v_nxt
 
     o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, kk, vk))
-    l1 = l[:, :, :1]
-    out = o / jnp.where(l1 == 0.0, 1.0, l1)
-    return out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3).astype(q.dtype)
+    l1 = jnp.where(l[:, :, :1] == 0.0, 1.0, l[:, :, :1])
+    out_k = (o / l1).astype(q.dtype)
+    # lse = m + log(l); untouched rows (m == -inf, l == 0) stay -inf.
+    lse = jnp.broadcast_to(m[:, :, :1] + jnp.log(l1), m.shape)
+    return _from_kernel(out_k, B, H), out_k, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, scale):
+    """Pallas ring attention, wrapped in a custom VJP because Pallas
+    kernels are not auto-differentiable. The backward is a second ring
+    pass (FlashAttention-2 style) over the saved per-row log-sum-exp —
+    no forward recompute: dq accumulates locally while dk/dv travel
+    around the ring with their k/v shard."""
+    return _ring_flash_impl(q, k, v, axis_name, causal, scale)[0]
 
 
 def _ring_flash_fwd(q, k, v, axis_name, causal, scale):
-    return _ring_flash(q, k, v, axis_name, causal, scale), (q, k, v)
+    out, out_k, lse = _ring_flash_impl(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out_k, lse)
 
 
 def _ring_flash_bwd(axis_name, causal, scale, res, g):
-    q, k, v = res
-    # remat: store O(shard) step inputs, rebuild each step's scores
-    # during the backward instead of keeping n full score matrices.
-    _, vjp = jax.vjp(
-        lambda q, k, v: _ring_jnp(q, k, v, axis_name, causal, scale,
-                                  remat=True),
-        q, k, v)
-    return vjp(g)
+    from horovod_tpu.ops.flash_attention import flash_ring_bwd_step
+
+    q, k, v, out_k, lse = res
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    qk = _to_kernel(q, B, H)
+    kk = _to_kernel(k, B, H)
+    vk = _to_kernel(v, B, H)
+    gk = _to_kernel(g, B, H)
+    # delta = rowsum(dO * O): one fused XLA pass per shard, reused by
+    # every ring step (both backward kernels stream it per q block).
+    delta = jnp.broadcast_to(
+        jnp.sum(gk.astype(jnp.float32) * out_k.astype(jnp.float32),
+                axis=-1, keepdims=True), lse.shape)
+
+    dq0 = jnp.zeros((B * H, Lq, D), jnp.float32)
+    dk0 = jnp.zeros((B * H, Lk, D), jnp.float32)
+    dv0 = jnp.zeros((B * H, Lk, D), jnp.float32)
+
+    def body(i, carry):
+        dq, k_blk, v_blk, dk, dv = carry
+        src = (idx - i) % n
+        dq, dk, dv = flash_ring_bwd_step(
+            qk, k_blk, v_blk, gk, lse, delta, dq, dk, dv,
+            q_offset=idx * Lq, kv_offset=src * Lk, causal=causal,
+            scale=scale, interpret=_interpret_mode())
+        # dk/dv ride the ring with their k/v shard; after n steps each
+        # shard's gradient arrives back on its home device.
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        dk_nxt = lax.ppermute(dk, axis_name, perm)
+        dv_nxt = lax.ppermute(dv, axis_name, perm)
+        return dq, k_nxt, v_nxt, dk_nxt, dv_nxt
+
+    dq, _, _, dk, dv = lax.fori_loop(0, n, body, (dq0, kk, vk, dk0, dv0))
+    return (_from_kernel(dq, B, H).astype(q.dtype),
+            _from_kernel(dk, B, H).astype(k.dtype),
+            _from_kernel(dv, B, H).astype(v.dtype))
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
@@ -187,8 +233,10 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None):
     (`horovod_tpu.ops.flash_attention.flash_ring_step`), so per-step
     memory is O(block) instead of the O(Lq * Lk) score matrix; other
     backends/shapes use the blockwise jnp path. Gradients flow on both
-    paths (the kernel path recomputes its backward through the jnp
-    ring).
+    paths; the kernel path's backward is a second ring pass over the
+    saved per-row log-sum-exp (FlashAttention-2 style — no forward
+    recompute), with dk/dv accumulators riding the ring alongside
+    their k/v shard.
     """
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
