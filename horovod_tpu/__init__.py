@@ -136,6 +136,10 @@ def init(ranks=None):
                 os.environ[k] = v
         _world_env = None
     get_basics().init()
+    # The native listener has bound; drop any rendezvous port
+    # reservation held across init (see rendezvous.reserve_port).
+    from .run.rendezvous import release_held_ports
+    release_held_ports()
     if not _initialized_here:
         _atexit.register(shutdown)
         _initialized_here = True

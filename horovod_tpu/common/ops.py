@@ -7,6 +7,7 @@ keeps the numpy buffers alive until the background thread is done with them.
 """
 
 import ctypes
+import os
 
 import numpy as np
 
@@ -81,7 +82,11 @@ def synchronize(handle):
 
     Allgather results are zero-copy views over the core-owned gather
     buffer; the handle (and with it the buffer) is released when the
-    returned array is garbage-collected."""
+    returned array is garbage-collected. Callers that retain a result
+    long-term (or cache it where reference cycles may delay GC) should
+    ``np.copy`` it — or set ``HVD_TPU_ALLGATHER_COPY=1`` to make every
+    allgather return an owned copy and release the core buffer
+    immediately (trades one memcpy for deterministic lifetime)."""
     basics = get_basics()
     if handle not in _handle_map:
         raise ValueError("unknown handle %d" % handle)
@@ -116,6 +121,10 @@ def synchronize(handle):
         ptr = basics.lib.horovod_tpu_allgather_data(handle)
         if not ptr:
             raise HorovodInternalError("allgather buffer missing")
+        if os.environ.get("HVD_TPU_ALLGATHER_COPY", "0") == "1":
+            buf = (ctypes.c_char * nbytes).from_address(ptr)
+            return np.frombuffer(buf, dtype=arr.dtype).reshape(
+                shape).copy()
         result = _view_core_buffer(basics, handle, ptr, nbytes, arr.dtype,
                                    shape)
         released = True  # ownership moved to the view's finalizer

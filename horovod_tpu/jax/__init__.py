@@ -192,7 +192,19 @@ def allgather(tensor, name=None, axis_name=AXIS_NAME):
 
 
 def broadcast(tensor, root_rank=0, name=None, axis_name=AXIS_NAME):
-    """Broadcasts the root rank's tensor to every rank."""
+    """Broadcasts the root rank's tensor — or pytree of tensors,
+    leaf-wise with order-stable names — to every rank."""
+    leaves, treedef = jax.tree_util.tree_flatten(tensor)
+    if len(leaves) != 1 or leaves[0] is not tensor:
+        base = name or _auto_name("broadcast")
+        out = [_broadcast_one(leaf, root_rank, "%s.%d" % (base, i),
+                              axis_name)
+               for i, leaf in enumerate(leaves)]
+        return jax.tree_util.tree_unflatten(treedef, out)
+    return _broadcast_one(tensor, root_rank, name, axis_name)
+
+
+def _broadcast_one(tensor, root_rank, name, axis_name):
     if _is_traced(tensor):
         if _axis_in_scope(axis_name):
             # In-jit: mask every rank but the root to zero and psum — XLA

@@ -9,6 +9,7 @@
 #include <unistd.h>
 
 #include <chrono>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 
@@ -113,6 +114,15 @@ bool Listener::Start(int port) {
   if (fd_ < 0) return false;
   int one = 1;
   ::setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  // Dynamic rendezvous holds the reserved ephemeral port open in a
+  // bound (never listening) Python socket until init completes, so no
+  // other process can be handed it; binding alongside that reservation
+  // requires SO_REUSEPORT on both. Only set when the port is such a
+  // reservation — fixed-port configs keep strict EADDRINUSE semantics.
+  const char* held = std::getenv("HVD_TPU_LISTEN_REUSEPORT");
+  if (held && held[0] == '1') {
+    ::setsockopt(fd_, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
+  }
   sockaddr_in addr;
   std::memset(&addr, 0, sizeof(addr));
   addr.sin_family = AF_INET;

@@ -90,6 +90,15 @@ class RendezvousServer:
                 parts = self.path.strip("/").split("/")
                 if len(parts) != 3 or parts[0] != "set":
                     return self._reply(400, b"bad path")
+                # Before buffering the body: a peer without the key
+                # can't produce even a well-formed signature header, so
+                # reject it here rather than reading (and holding) up
+                # to MAX_VALUE_BYTES per unauthenticated connection.
+                if auth_key is not None:
+                    header = self.headers.get(AUTH_HEADER, "")
+                    if len(header) != 64 or any(
+                            c not in "0123456789abcdef" for c in header):
+                        return self._reply(403, b"bad signature")
                 length = int(self.headers.get("Content-Length", 0))
                 if length > MAX_VALUE_BYTES:
                     return self._reply(413, b"value too large")
@@ -258,15 +267,44 @@ def routable_ip(peer_host, peer_port=80):
         return "127.0.0.1"
 
 
-def reserve_port():
-    """Binds an ephemeral port and releases it (the native listener
-    re-binds it within milliseconds of init)."""
+# Reservation sockets held open (bound, not listening) until the native
+# listener re-binds their port — see reserve_port(hold=True).
+_held_sockets = []
+
+
+def reserve_port(hold=False):
+    """Binds an ephemeral port; with ``hold=False`` releases it
+    immediately (callers that only need a number and tolerate the tiny
+    reuse window, e.g. picking a coordinator port to broadcast).
+
+    ``hold=True`` keeps the socket open with SO_REUSEPORT so no other
+    process can be handed the port in the release-to-rebind window; the
+    native listener (which also sets SO_REUSEPORT when told the port is
+    a held reservation) binds alongside it, and `release_held_ports()`
+    closes the reservation after init. The reservation socket never
+    listens, so every incoming connection reaches the native listener.
+    """
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    if hold and hasattr(socket, "SO_REUSEPORT"):
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        s.bind(("0.0.0.0", 0))
+        _held_sockets.append(s)
+        return s.getsockname()[1]
     s.bind(("0.0.0.0", 0))
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def release_held_ports():
+    """Closes reservation sockets held by reserve_port(hold=True);
+    called once the native listener has bound. Also clears the
+    REUSEPORT hint so any later (re-)init binds with strict
+    EADDRINUSE semantics again."""
+    while _held_sockets:
+        _held_sockets.pop().close()
+    os.environ.pop("HVD_TPU_LISTEN_REUSEPORT", None)
 
 
 def resolve_topology(rank, size, rendezvous_addr, timeout=60):
@@ -277,9 +315,17 @@ def resolve_topology(rank, size, rendezvous_addr, timeout=60):
     host = rendezvous_addr.rsplit(":", 1)[0]
     port = int(rendezvous_addr.rsplit(":", 1)[1])
     my_ip = routable_ip(host, port)
-    my_port = reserve_port()
+    my_port = reserve_port(hold=True)
+    env = {}
+    if _held_sockets:
+        # Tell the native listener its port is a held reservation (it
+        # must set SO_REUSEPORT to bind alongside the reservation
+        # socket). Only ever set on kernel-allocated ephemeral ports, so
+        # the static fixed-port path keeps strict EADDRINUSE semantics.
+        env["HVD_TPU_LISTEN_REUSEPORT"] = "1"
     put(rendezvous_addr, SCOPE_ADDRS, str(rank),
         "%s:%d" % (my_ip, my_port))
     table = wait_all(rendezvous_addr, SCOPE_ADDRS, range(size), timeout)
     addrs = [table[str(r)] for r in range(size)]
-    return topology_env(rank, addrs)
+    env.update(topology_env(rank, addrs))
+    return env

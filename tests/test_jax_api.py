@@ -107,6 +107,25 @@ def test_injit_allgather():
     assert np.allclose(out.ravel(), np.arange(8))
 
 
+def test_injit_broadcast_pytree():
+    """In-jit broadcast accepts a pytree and broadcasts leaf-wise (the
+    masked-psum rewrite must not regress the tree-accepting API)."""
+    devices = jax.devices("cpu")
+    mesh = Mesh(np.array(devices), (hvd.AXIS_NAME,))
+
+    def step(rank_arr):
+        tree = {"w": rank_arr, "b": rank_arr * 2.0}
+        return hvd.broadcast(tree, root_rank=3)
+
+    f = shard_map(step, mesh=mesh, in_specs=P(hvd.AXIS_NAME),
+                  out_specs=P(hvd.AXIS_NAME))
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+    out = jax.jit(f)(x)
+    # Every shard receives rank 3's values.
+    assert np.allclose(out["w"].ravel(), 3.0)
+    assert np.allclose(out["b"].ravel(), 6.0)
+
+
 def test_distributed_optimizer_host():
     opt = hvd.DistributedOptimizer(optax.sgd(0.1))
     params = {"w": jnp.ones(4), "b": jnp.zeros(2)}
