@@ -18,10 +18,16 @@ the chip's peak bf16 FLOP/s.
 
 import argparse
 import json
+import os
+import re
+import socket
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
 
 # Peak bf16 dense FLOP/s per chip, by jax device_kind substring (public
 # TPU spec sheet numbers). Used only for the MFU denominator.
@@ -53,6 +59,210 @@ def compiled_flops(step, *args):
         return None
 
 
+def _time_steps(step, state, batch, iters, warmup=3):
+    """Median-of-3 step time (seconds) with a host-read barrier."""
+    params_p, opt_state = state
+    for _ in range(warmup):
+        params_p, opt_state, loss = step(params_p, opt_state, batch)
+    float(loss)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params_p, opt_state, loss = step(params_p, opt_state, batch)
+        float(loss)
+        times.append((time.perf_counter() - t0) / iters)
+    return sorted(times)[1]
+
+
+def scaling_worker(args):
+    """Weak-scaling measurement subprocess (virtual CPU mesh): runs the
+    full jitted DP train step over an `n`-device mesh (or the same total
+    work on one device with --scaling-single — the contention-fair
+    baseline on a shared-core host) and prints a JSON step-time line."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.parallel import data_parallel_mesh, make_train_step
+
+    n = args.scaling_worker
+    b = args.scaling_batch
+    width, layers = 1024, 4
+    rng = jax.random.PRNGKey(0)
+
+    def init_params():
+        ks = jax.random.split(rng, layers)
+        return [jax.random.normal(k, (width, width), jnp.float32) * 0.02
+                for k in ks]
+
+    def loss_fn(params, batch):
+        h = batch["x"]
+        for w in params:
+            h = jnp.tanh(h @ w)
+        return jnp.mean((h - batch["y"]) ** 2)
+
+    params = init_params()
+    opt = optax.sgd(0.01)
+    total_batch = b * n
+    x = jax.random.normal(rng, (total_batch, width), jnp.float32)
+    y = jax.random.normal(rng, (total_batch, width), jnp.float32)
+
+    if len(jax.devices()) < n:
+        raise RuntimeError(
+            "scaling worker expected >=%d devices, got %d (XLA_FLAGS "
+            "device-count override lost?)" % (n, len(jax.devices())))
+    if args.scaling_single:
+        devices = jax.devices()[:1]
+    else:
+        devices = jax.devices()[:n]
+    mesh = data_parallel_mesh(devices=devices)
+    step = make_train_step(loss_fn, opt, mesh, donate=False)
+    params_p, opt_state, batch = step.place(params, opt.init(params),
+                                            {"x": x, "y": y})
+    dt = _time_steps(step, (params_p, opt_state), batch, args.num_iters)
+    print(json.dumps({"n": n, "single": bool(args.scaling_single),
+                      "step_ms": round(dt * 1000.0, 3)}))
+
+
+def _run_weak_scaling(batch, iters):
+    """Spawns scaling_worker subprocesses on a virtual CPU mesh; returns
+    rows of {n, mesh_ms, single_ms, efficiency}."""
+    rows = []
+    for n in (1, 2, 4, 8):
+        res = {}
+        for single in (False, True):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            # Appended last: XLA's flag parsing takes the last
+            # occurrence, so an inherited device-count flag can't
+            # silently shrink the mesh under us.
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "") +
+                " --xla_force_host_platform_device_count=%d" % n)
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--scaling-worker", str(n),
+                   "--scaling-batch", str(batch),
+                   "--num-iters", str(iters)]
+            if single:
+                cmd.append("--scaling-single")
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 env=env, timeout=1200)
+            if out.returncode != 0:
+                raise RuntimeError("scaling worker n=%d failed:\n%s" %
+                                   (n, out.stderr))
+            res[single] = json.loads(out.stdout.strip().splitlines()[-1])
+        mesh_ms = res[False]["step_ms"]
+        single_ms = res[True]["step_ms"]
+        rows.append({"n": n, "mesh_step_ms": mesh_ms,
+                     "single_device_same_work_ms": single_ms,
+                     "efficiency": round(single_ms / mesh_ms, 3)})
+        print("weak-scaling n=%d: mesh %.1f ms, single-device-same-work "
+              "%.1f ms, efficiency %.3f" %
+              (n, mesh_ms, single_ms, rows[-1]["efficiency"]),
+              file=sys.stderr)
+    return rows
+
+
+def _reserve_ports(n):
+    """Reserves n ephemeral ports, HOLDING the sockets (SO_REUSEPORT)
+    so no other process can be handed one before the slowest worker
+    binds; workers bind alongside via HVD_TPU_LISTEN_REUSEPORT=1 (the
+    same mechanism rendezvous.reserve_port(hold=True) uses)."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if hasattr(socket, "SO_REUSEPORT"):
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    return socks, ports
+
+
+def _run_negotiation_bench(n, iters, extra_env=None, timeout=1800):
+    """Launches n local control-plane workers (numpy+ctypes only) and
+    returns rank 0's negotiation latency in us/op."""
+    socks, ports = _reserve_ports(n)
+    addrs = ",".join("127.0.0.1:%d" % p for p in ports)
+    procs, outputs = [], []
+    for r in range(n):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.update({
+            "HVD_TPU_RANK": str(r), "HVD_TPU_SIZE": str(n),
+            "HVD_TPU_LOCAL_RANK": str(r), "HVD_TPU_LOCAL_SIZE": str(n),
+            "HVD_TPU_CROSS_RANK": "0", "HVD_TPU_CROSS_SIZE": "1",
+            "HVD_TPU_ADDRS": addrs, "HVD_TPU_CYCLE_TIME": "0",
+            "HVD_TPU_BENCH_ITERS": str(iters),
+            "HVD_TPU_LISTEN_REUSEPORT": "1",
+        })
+        if extra_env:
+            env.update(extra_env)
+        procs.append(subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "tests", "negotiation_bench_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    us = None
+    try:
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=timeout)
+            outputs.append(out)
+            if p.returncode != 0:
+                raise RuntimeError("rank %d failed:\n%s" % (r, out))
+            m = re.search(r"NEGOTIATION_US_PER_OP ([\d.]+)", out)
+            if m:
+                us = float(m.group(1))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for s in socks:
+            s.close()
+    if us is None:
+        raise RuntimeError(
+            "no NEGOTIATION_US_PER_OP line in any worker output; rank 0 "
+            "said:\n%s" % (outputs[0] if outputs else "<no output>"))
+    return us
+
+
+def scaling_main(args):
+    """bench.py --scaling: regenerates the SCALING.md evidence — (a)
+    weak-scaling efficiency of the full jitted DP train step on the
+    virtual CPU mesh, (b) control-plane negotiation latency curves at
+    32..max-ranks local ranks (cached fast path and full uncached
+    negotiation)."""
+    weak = _run_weak_scaling(args.scaling_batch, args.num_iters)
+
+    rank_counts = [n for n in (32, 64, 128, 256)
+                   if n <= args.scaling_max_ranks]
+    negotiation = []
+    for n in rank_counts:
+        iters = max(25, 3200 // n)
+        cached = _run_negotiation_bench(n, iters)
+        uncached = _run_negotiation_bench(
+            n, max(10, iters // 4), {"HVD_TPU_CACHE_CAPACITY": "0"})
+        negotiation.append({"ranks": n, "cached_us_per_op": cached,
+                            "uncached_us_per_op": uncached})
+        print("negotiation n=%d: cached %.0f us/op, uncached %.0f us/op"
+              % (n, cached, uncached), file=sys.stderr)
+
+    out = {
+        "metric": "scaling_evidence",
+        "value": weak[-1]["efficiency"],
+        "unit": "weak_scaling_efficiency_n8_virtual_mesh",
+        "vs_baseline": round(weak[-1]["efficiency"] / 0.90, 3),
+        "baseline": "reference claims 90% scaling efficiency at 512 GPUs "
+                    "(README.rst:75); projection model in SCALING.md",
+        "weak_scaling": weak,
+        "negotiation_latency": negotiation,
+        "host_cores": os.cpu_count(),
+    }
+    print(json.dumps(out))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=256,
@@ -75,7 +285,25 @@ def main():
                     help="sequence length (transformer model)")
     ap.add_argument("--tokens-batch", type=int, default=8,
                     help="per-chip sequences per step (transformer model)")
+    ap.add_argument("--scaling", action="store_true",
+                    help="regenerate the SCALING.md evidence (weak "
+                         "scaling on the virtual CPU mesh + negotiation "
+                         "latency curves) instead of the throughput bench")
+    ap.add_argument("--scaling-max-ranks", type=int, default=256,
+                    help="largest local rank count for the negotiation "
+                         "latency curve")
+    ap.add_argument("--scaling-batch", type=int, default=128,
+                    help="per-shard batch for the weak-scaling step")
+    ap.add_argument("--scaling-worker", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--scaling-single", action="store_true",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.scaling_worker is not None:
+        return scaling_worker(args)
+    if args.scaling:
+        return scaling_main(args)
 
     import jax
     import jax.numpy as jnp
