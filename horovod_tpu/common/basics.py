@@ -130,6 +130,16 @@ class HorovodBasics:
         lib.horovod_tpu_allgather_data.restype = ctypes.c_void_p
         lib.horovod_tpu_allgather_data.argtypes = [ctypes.c_int]
         lib.horovod_tpu_release.argtypes = [ctypes.c_int]
+        lib.horovod_tpu_perf_counters.restype = None
+        lib.horovod_tpu_perf_counters.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.horovod_tpu_effective_fusion_threshold.restype = ctypes.c_int64
+        lib.horovod_tpu_autotune_params.restype = None
+        lib.horovod_tpu_autotune_params.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ]
 
     # -- lifecycle ---------------------------------------------------------
     def init(self):
@@ -143,6 +153,39 @@ class HorovodBasics:
 
     def initialized(self):
         return bool(self.lib.horovod_tpu_initialized())
+
+    def perf_counters(self):
+        """(responses_performed, tensors_performed) — fusion
+        diagnostics: equal counts mean no tensor shared a response."""
+        responses = ctypes.c_int64()
+        tensors = ctypes.c_int64()
+        self.lib.horovod_tpu_perf_counters(ctypes.byref(responses),
+                                           ctypes.byref(tensors))
+        return responses.value, tensors.value
+
+    def effective_fusion_threshold(self):
+        """The controller's working fusion threshold in bytes, after
+        hierarchical divisibility rounding; -1 before init."""
+        return self.lib.horovod_tpu_effective_fusion_threshold()
+
+    def autotune_params(self):
+        """Current synchronized knob values (autotune introspection):
+        dict with fusion_mb, cycle_time_ms, cache_enabled,
+        hierarchical_allreduce, hierarchical_allgather, active."""
+        fusion = ctypes.c_double()
+        cycle = ctypes.c_double()
+        cache = ctypes.c_int()
+        har = ctypes.c_int()
+        hag = ctypes.c_int()
+        active = ctypes.c_int()
+        self.lib.horovod_tpu_autotune_params(
+            ctypes.byref(fusion), ctypes.byref(cycle), ctypes.byref(cache),
+            ctypes.byref(har), ctypes.byref(hag), ctypes.byref(active))
+        return {"fusion_mb": fusion.value, "cycle_time_ms": cycle.value,
+                "cache_enabled": bool(cache.value),
+                "hierarchical_allreduce": bool(har.value),
+                "hierarchical_allgather": bool(hag.value),
+                "active": bool(active.value)}
 
     # -- topology ----------------------------------------------------------
     def rank(self):

@@ -5,6 +5,7 @@
 #define HVD_TPU_GLOBAL_STATE_H
 
 #include <atomic>
+#include <cstdint>
 #include <memory>
 #include <thread>
 
@@ -27,6 +28,10 @@ struct HorovodGlobalState {
   std::atomic<bool> initialization_done{false};
   std::atomic<bool> initialization_failed{false};
   std::atomic<bool> shut_down{false};
+
+  // Fusion diagnostics (see PerformOperation).
+  std::atomic<int64_t> responses_performed{0};
+  std::atomic<int64_t> tensors_performed{0};
 
   TcpContext tcp_context;
   TensorQueue tensor_queue;

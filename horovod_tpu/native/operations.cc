@@ -19,6 +19,7 @@
 #include <mutex>
 #include <thread>
 
+#include "bayesian_optimization.h"
 #include "collective_operations.h"
 #include "common.h"
 #include "controller.h"
@@ -155,6 +156,11 @@ void PerformOperation(HorovodGlobalState& state, const Response& response) {
   std::vector<TensorTableEntry> entries;
   state.tensor_queue.GetTensorEntriesFromResponse(response, entries);
   if (entries.empty()) return;
+  // Fusion diagnostics: responses vs tensors executed (a fused response
+  // carries several tensors; with fusion off the counts are equal).
+  state.responses_performed.fetch_add(1);
+  state.tensors_performed.fetch_add(
+      static_cast<int64_t>(entries.size()));
   for (const auto& e : entries) {
     state.timeline.Start(e.tensor_name, response.response_type());
   }
@@ -443,6 +449,58 @@ int horovod_tpu_is_homogeneous() {
 // Build/capability probes (reference: horovod_mpi_built etc.).
 int horovod_tpu_tcp_built() { return 1; }
 int horovod_tpu_cpu_ops_built() { return 1; }
+
+// Fusion diagnostics: executed responses vs tensors (tensors >
+// responses means fusion grouped tensors into shared cycles), and the
+// controller's effective (divisibility-rounded) fusion threshold.
+void horovod_tpu_perf_counters(int64_t* responses, int64_t* tensors) {
+  if (responses) *responses = g_state.responses_performed.load();
+  if (tensors) *tensors = g_state.tensors_performed.load();
+}
+int64_t horovod_tpu_effective_fusion_threshold() {
+  return g_state.controller
+             ? g_state.controller->TensorFusionThresholdBytes()
+             : -1;
+}
+
+// BayesianOptimizer handle API: unit-tests the autotune math from
+// Python (not part of the training path).
+void* horovod_tpu_bo_create(double lo0, double hi0, double lo1, double hi1,
+                            uint64_t seed) {
+  return new BayesianOptimizer({{lo0, hi0}, {lo1, hi1}}, seed);
+}
+void horovod_tpu_bo_next(void* bo, double* out2) {
+  auto next = static_cast<BayesianOptimizer*>(bo)->NextSample();
+  out2[0] = next[0];
+  out2[1] = next[1];
+}
+void horovod_tpu_bo_add(void* bo, const double* x2, double y) {
+  static_cast<BayesianOptimizer*>(bo)->AddSample({x2[0], x2[1]}, y);
+}
+void horovod_tpu_bo_best(void* bo, double* out2, double* best_y) {
+  auto* opt = static_cast<BayesianOptimizer*>(bo);
+  auto best = opt->BestSample();
+  out2[0] = best.size() > 0 ? best[0] : 0.0;
+  out2[1] = best.size() > 1 ? best[1] : 0.0;
+  *best_y = opt->BestValue();
+}
+void horovod_tpu_bo_destroy(void* bo) {
+  delete static_cast<BayesianOptimizer*>(bo);
+}
+
+// Autotune introspection (tests + diagnostics): current synchronized
+// knob values and whether tuning is still active.
+void horovod_tpu_autotune_params(double* fusion_mb, double* cycle_ms,
+                                 int* cache_enabled, int* hier_allreduce,
+                                 int* hier_allgather, int* active) {
+  ParameterManager::Params p = g_state.parameter_manager.GetParams();
+  if (fusion_mb) *fusion_mb = p.fusion_mb;
+  if (cycle_ms) *cycle_ms = p.cycle_time_ms;
+  if (cache_enabled) *cache_enabled = p.cache_enabled;
+  if (hier_allreduce) *hier_allreduce = p.hierarchical_allreduce;
+  if (hier_allgather) *hier_allgather = p.hierarchical_allgather;
+  if (active) *active = p.active;
+}
 
 int horovod_tpu_enqueue_allreduce(const char* name, const void* data,
                                   void* output, int ndim, const int64_t* shape,

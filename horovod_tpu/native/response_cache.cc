@@ -90,6 +90,12 @@ void ResponseCache::put(const Response& response, TensorQueue& tensor_queue) {
       entry.postscale_factor = te.postscale_factor;
       if (response.response_type() == Response::ALLGATHER) {
         single.set_tensor_sizes(response.tensor_sizes());
+      } else {
+        // Allreduce/broadcast: carry the element count so the
+        // cached-path FuseResponses sees real bytes — without it a
+        // cached response weighs 0 and fusion merges past the
+        // threshold.
+        single.add_tensor_size(te.shape.num_elements());
       }
     } else {
       continue;

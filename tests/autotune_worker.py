@@ -1,0 +1,40 @@
+"""Autotune e2e worker: runs collectives with HVD_TPU_AUTOTUNE=1 so the
+parameter manager cycles through warmup + Bayesian samples while the job
+trains. Batched async enqueues keep tensors flowing every cycle without
+paying a full (possibly autotuned-to-100ms) cycle wait per op. Asserts
+every allreduce stays correct while knobs change underneath, then prints
+the final synchronized parameters as one JSON line."""
+
+import json
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    size = hvd.size()
+    r = hvd.rank()
+    base = np.arange(65536, dtype=np.float32)  # 256 KB
+    for i in range(120):
+        handles = []
+        for j in range(8):
+            x = base + float(r)
+            handles.append(hvd.allreduce_async(
+                x, "autotune.%d" % j))
+        for j, h in enumerate(handles):
+            out = hvd.synchronize(h)
+            expected = base * size + sum(range(size))
+            if not np.allclose(out, expected):
+                print("MISMATCH iter %d tensor %d" % (i, j))
+                return 1
+    params = hvd.get_basics().autotune_params()
+    print("AUTOTUNE_PARAMS %s" % json.dumps(params))
+    print("rank %d done" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

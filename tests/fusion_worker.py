@@ -1,0 +1,39 @@
+"""Fusion-knob boundary worker: 8 batches of 4 concurrent 1 KB (256
+float32) allreduces with a long (50 ms) cycle so all four tensors of a
+batch are queued when the cycle fires; grouping is then decided purely
+by HVD_TPU_FUSION_THRESHOLD. Verifies every value and prints rank 0's
+response/tensor counters and the effective threshold."""
+
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    size = hvd.size()
+    r = hvd.rank()
+    base = np.arange(256, dtype=np.float32)  # 1 KB
+    batches, per_batch = 8, 4
+    for i in range(batches):
+        handles = [hvd.allreduce_async(base + float(r), "fuse.%d" % j)
+                   for j in range(per_batch)]
+        for h in handles:
+            out = hvd.synchronize(h)
+            expected = base * size + sum(range(size))
+            if not np.allclose(out, expected):
+                print("MISMATCH batch %d" % i)
+                return 1
+    if r == 0:
+        responses, tensors = hvd.get_basics().perf_counters()
+        print("FUSION_COUNTERS responses=%d tensors=%d threshold=%d" %
+              (responses, tensors,
+               hvd.get_basics().effective_fusion_threshold()))
+    print("rank %d done" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,124 @@
+"""Autotune coverage: the Bayesian-optimization math (unit) and a live
+HVD_TPU_AUTOTUNE=1 job (e2e). Reference semantics: ParameterManager
+warmup/sample/score flow (`/root/reference/horovod/common/parameter_manager.cc:27-30`)
++ BayesianOptimization (`common/optim/bayesian_optimization.cc`)."""
+
+import ctypes
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import get_basics
+
+FUSION_LO, FUSION_HI = 0.0, 64.0
+CYCLE_LO, CYCLE_HI = 1.0, 100.0
+
+
+def _bo(lo0, hi0, lo1, hi1, seed):
+    lib = get_basics().lib
+    lib.horovod_tpu_bo_create.restype = ctypes.c_void_p
+    lib.horovod_tpu_bo_create.argtypes = [
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ctypes.c_uint64]
+    lib.horovod_tpu_bo_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double)]
+    lib.horovod_tpu_bo_add.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_double]
+    lib.horovod_tpu_bo_best.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double)]
+    lib.horovod_tpu_bo_destroy.argtypes = [ctypes.c_void_p]
+    return lib, lib.horovod_tpu_bo_create(lo0, hi0, lo1, hi1, seed)
+
+
+def test_bayesian_optimizer_finds_optimum_2d():
+    """EI over the GP surrogate must localize the optimum of a smooth
+    2-D function within the sample budget the autotuner actually uses
+    (kSamplesPerCombo=10 per categorical combo, up to kMaxSamples=40) —
+    and never propose points outside the bounds."""
+    lib, bo = _bo(FUSION_LO, FUSION_HI, CYCLE_LO, CYCLE_HI, seed=7)
+    opt_x, opt_y = 20.0, 70.0
+
+    def f(x, y):
+        return -((x - opt_x) / (FUSION_HI - FUSION_LO)) ** 2 \
+            - ((y - opt_y) / (CYCLE_HI - CYCLE_LO)) ** 2
+
+    try:
+        pt = (ctypes.c_double * 2)()
+        for _ in range(25):
+            lib.horovod_tpu_bo_next(bo, pt)
+            x, y = pt[0], pt[1]
+            assert FUSION_LO <= x <= FUSION_HI, x
+            assert CYCLE_LO <= y <= CYCLE_HI, y
+            lib.horovod_tpu_bo_add(bo, pt, f(x, y))
+        best_y = ctypes.c_double()
+        lib.horovod_tpu_bo_best(bo, pt, ctypes.byref(best_y))
+        # Within ~15% of each axis of the true optimum, and a function
+        # value close to the max of 0.
+        assert abs(pt[0] - opt_x) < 0.15 * (FUSION_HI - FUSION_LO), pt[0]
+        assert abs(pt[1] - opt_y) < 0.15 * (CYCLE_HI - CYCLE_LO), pt[1]
+        assert best_y.value > -0.05, best_y.value
+    finally:
+        lib.horovod_tpu_bo_destroy(bo)
+
+
+def test_bayesian_optimizer_survives_many_samples():
+    """100 samples (beyond kMaxSamples) on a noisy constant function:
+    the Cholesky must stay finite (no NaN proposals) even with
+    near-duplicate inputs."""
+    lib, bo = _bo(FUSION_LO, FUSION_HI, CYCLE_LO, CYCLE_HI, seed=3)
+    rng = np.random.RandomState(0)
+    try:
+        pt = (ctypes.c_double * 2)()
+        for i in range(100):
+            lib.horovod_tpu_bo_next(bo, pt)
+            assert np.isfinite(pt[0]) and np.isfinite(pt[1]), (i, pt[0],
+                                                              pt[1])
+            assert FUSION_LO <= pt[0] <= FUSION_HI
+            assert CYCLE_LO <= pt[1] <= CYCLE_HI
+            lib.horovod_tpu_bo_add(bo, pt, 1.0 + 1e-3 * rng.randn())
+    finally:
+        lib.horovod_tpu_bo_destroy(bo)
+
+
+@pytest.mark.e2e
+def test_autotune_e2e(run_launcher, tmp_path):
+    """A 2-rank job with autotuning live: collectives must stay correct
+    while the coordinator re-tunes fusion/cycle/cache knobs under the
+    running job (cross-rank agreement is implicit — a desynchronized
+    cache or fusion config deadlocks negotiation and the run times
+    out), the CSV log must be well-formed with >= warmup + 2 samples,
+    and every sampled/final knob must lie inside the search bounds."""
+    log = tmp_path / "autotune.csv"
+    proc = run_launcher(2, "autotune_worker.py",
+                        extra_env={"HVD_TPU_AUTOTUNE": "1",
+                                   "HVD_TPU_AUTOTUNE_LOG": str(log)},
+                        timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "MISMATCH" not in proc.stdout, proc.stdout
+
+    # Every rank reports synchronized params inside the search bounds.
+    params = [json.loads(m) for m in
+              re.findall(r"AUTOTUNE_PARAMS (\{.*?\})", proc.stdout)]
+    assert len(params) == 2, proc.stdout
+    for p in params:
+        assert FUSION_LO <= p["fusion_mb"] <= FUSION_HI, p
+        assert CYCLE_LO <= p["cycle_time_ms"] <= CYCLE_HI, p
+
+    # CSV: header + >= 2 post-warmup samples, all rows in bounds.
+    lines = log.read_text().strip().splitlines()
+    assert lines[0].startswith("fusion_mb,cycle_time_ms,cache_enabled"), \
+        lines[0]
+    rows = [line.split(",") for line in lines[1:]]
+    assert len(rows) >= 2, lines
+    for row in rows:
+        assert len(row) == 6, row
+        fusion, cycle = float(row[0]), float(row[1])
+        assert FUSION_LO <= fusion <= FUSION_HI, row
+        assert CYCLE_LO <= cycle <= CYCLE_HI, row
+        assert row[2] in ("0", "1") and row[3] in ("0", "1") \
+            and row[4] in ("0", "1"), row
+        assert np.isfinite(float(row[5])), row
