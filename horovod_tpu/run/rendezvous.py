@@ -38,6 +38,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 MAX_VALUE_BYTES = 1 << 20
 
 SCOPE_ADDRS = "addrs"
+# Rank 0 publishes the probed, globally-consistent address table here;
+# every rank consumes it verbatim (a per-rank interface choice could
+# diverge and split the local/cross topology).
+SCOPE_RESOLVED = "resolved"
+
+PROBE_CONNECT_TIMEOUT = 2.0
 
 AUTH_HEADER = "X-Hvd-Auth"
 KEY_ENV = "HVD_TPU_RENDEZVOUS_KEY"
@@ -267,6 +273,88 @@ def routable_ip(peer_host, peer_port=80):
         return "127.0.0.1"
 
 
+def candidate_ips(peer_host=None, peer_port=80):
+    """All plausible local IPv4 addresses, the kernel-routed guess
+    toward `peer_host` first. On a multi-NIC host the interface the
+    kernel routes toward the launcher may not be the one peers can
+    reach — publishing every candidate lets the coordinator probe and
+    pick a working one (reference analogue: the driver/task services'
+    interface discovery, /root/reference/horovod/run/run.py:189-259).
+    """
+    cands = []
+    if peer_host:
+        primary = routable_ip(peer_host, peer_port)
+        if primary:
+            cands.append(primary)
+    try:
+        import fcntl
+        import struct
+        for _, name in socket.if_nameindex():
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                try:
+                    packed = fcntl.ioctl(
+                        s.fileno(), 0x8915,  # SIOCGIFADDR
+                        struct.pack("256s", name.encode()[:15]))
+                except OSError:  # interface without an IPv4 address
+                    continue
+            ip = socket.inet_ntoa(packed[20:24])
+            if ip not in cands and not ip.startswith("127."):
+                cands.append(ip)
+    except OSError:
+        pass
+    return cands or ["127.0.0.1"]
+
+
+class ProbeListener:
+    """Accept-and-close TCP listener: lets the coordinator verify this
+    worker's advertised interfaces actually accept connections, before
+    the native listener exists. Runs until release_held_ports()."""
+
+    def __init__(self):
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(128)
+        self._sock.settimeout(0.25)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hvd-tpu-probe")
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+                conn.close()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+_probe_listeners = []
+
+
+def probe_connect(ip, port, timeout=None):
+    """True when a TCP connect to ip:port succeeds within timeout."""
+    try:
+        socket.create_connection(
+            (ip, port),
+            timeout=PROBE_CONNECT_TIMEOUT if timeout is None else timeout
+        ).close()
+        return True
+    except OSError:
+        return False
+
+
 # Reservation sockets held open (bound, not listening) until the native
 # listener re-binds their port — see reserve_port(hold=True).
 _held_sockets = []
@@ -298,24 +386,78 @@ def reserve_port(hold=False):
 
 
 def release_held_ports():
-    """Closes reservation sockets held by reserve_port(hold=True);
-    called once the native listener has bound. Also clears the
-    REUSEPORT hint so any later (re-)init binds with strict
-    EADDRINUSE semantics again."""
+    """Closes reservation sockets held by reserve_port(hold=True) and
+    stops probe listeners; called once the native listener has bound.
+    Also clears the REUSEPORT hint so any later (re-)init binds with
+    strict EADDRINUSE semantics again."""
     while _held_sockets:
         _held_sockets.pop().close()
+    while _probe_listeners:
+        _probe_listeners.pop().stop()
     os.environ.pop("HVD_TPU_LISTEN_REUSEPORT", None)
 
 
+def _parse_entry(value):
+    """A published worker entry: JSON {"cands": [...], "port": p,
+    "probe": pp}, or the legacy plain "ip:port" form."""
+    try:
+        d = json.loads(value)
+        return list(d["cands"]), int(d["port"]), int(d.get("probe", 0))
+    except (ValueError, KeyError, TypeError):
+        ip, _, port = value.rpartition(":")
+        return [ip], int(port), 0
+
+
+def _resolve_table(table, size, my_rank):
+    """Coordinator-side interface selection: for each worker, the first
+    published candidate that accepts a TCP connect to the worker's
+    probe listener. Raises (fast, actionably) when none does — the
+    failure that previously surfaced as a silent native-init hang.
+
+    Known blind spot: candidates of workers colocated with the
+    coordinator's host are probed over local routing, which succeeds
+    even for interfaces other hosts can't reach (the reference's
+    interface-set intersection has the same single-vantage limitation,
+    run/run.py:189-259). Cross-host misadvertises from the
+    coordinator's own host still fall through to the bounded native
+    HVD_TPU_START_TIMEOUT; HVD_TPU_RENDEZVOUS_HOST overrides the
+    launcher side."""
+    import concurrent.futures
+
+    entries = {r: _parse_entry(table[str(r)]) for r in range(size)}
+
+    def pick(r):
+        cands, port, probe_port = entries[r]
+        if not probe_port:  # legacy entry without a probe listener
+            return "%s:%d" % (cands[0], port)
+        for ip in cands:
+            if probe_connect(ip, probe_port):
+                return "%s:%d" % (ip, port)
+        raise RuntimeError(
+            "rank %d advertised interface(s) %s but none accepts "
+            "connections from rank %d (probe port %d). Check firewalls "
+            "and that the hosts share a network; on multi-NIC hosts "
+            "verify the advertised interfaces are the routable ones."
+            % (r, ",".join(cands), my_rank, probe_port))
+
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(32, size)) as pool:
+        return list(pool.map(pick, range(size)))
+
+
 def resolve_topology(rank, size, rendezvous_addr, timeout=60):
-    """Worker-side rendezvous: publish my address, fetch the peer table,
-    derive the HVD_TPU_* topology env (index == rank)."""
+    """Worker-side rendezvous: publish my candidate addresses + chosen
+    port, let rank 0 probe reachability and publish ONE resolved table
+    (globally consistent — per-rank interface choices could split the
+    derived local/cross topology), derive the HVD_TPU_* env from it."""
     from .util import topology_env
 
     host = rendezvous_addr.rsplit(":", 1)[0]
     port = int(rendezvous_addr.rsplit(":", 1)[1])
-    my_ip = routable_ip(host, port)
+    cands = candidate_ips(host, port)
     my_port = reserve_port(hold=True)
+    probe = ProbeListener()
+    _probe_listeners.append(probe)
     env = {}
     if _held_sockets:
         # Tell the native listener its port is a held reservation (it
@@ -324,8 +466,35 @@ def resolve_topology(rank, size, rendezvous_addr, timeout=60):
         # the static fixed-port path keeps strict EADDRINUSE semantics.
         env["HVD_TPU_LISTEN_REUSEPORT"] = "1"
     put(rendezvous_addr, SCOPE_ADDRS, str(rank),
-        "%s:%d" % (my_ip, my_port))
-    table = wait_all(rendezvous_addr, SCOPE_ADDRS, range(size), timeout)
-    addrs = [table[str(r)] for r in range(size)]
+        json.dumps({"cands": cands, "port": my_port, "probe": probe.port}))
+    deadline = time.monotonic() + timeout
+    if rank == 0:
+        table = wait_all(rendezvous_addr, SCOPE_ADDRS, range(size),
+                         timeout)
+        try:
+            addrs = _resolve_table(table, size, my_rank=0)
+        except RuntimeError as e:
+            # Publish the failure so waiting ranks fail fast with the
+            # actionable message instead of a generic timeout.
+            put(rendezvous_addr, SCOPE_RESOLVED, "table",
+                json.dumps({"error": str(e)}))
+            raise
+        put(rendezvous_addr, SCOPE_RESOLVED, "table", json.dumps(addrs))
+    else:
+        # Wait out the shared publish deadline PLUS a probing allowance
+        # (rank 0 starts probing only after the last publish, and each
+        # unreachable candidate burns PROBE_CONNECT_TIMEOUT).
+        resolved = wait_all(
+            rendezvous_addr, SCOPE_RESOLVED, ["table"],
+            max(30.0, deadline - time.monotonic() + 30.0))
+        addrs = json.loads(resolved["table"])
+        if isinstance(addrs, dict):
+            raise RuntimeError(
+                "rendezvous coordinator failed: %s"
+                % addrs.get("error", "unknown error"))
+        if len(addrs) != size:
+            raise RuntimeError(
+                "resolved rendezvous table has %d entries for world "
+                "size %d" % (len(addrs), size))
     env.update(topology_env(rank, addrs))
     return env

@@ -170,6 +170,39 @@ def ssh_preflight(hostnames, ssh_port=None, timeout=5):
             (len(failures), detail, failures[0][0]))
 
 
+def rendezvous_preflight(remote_host, addr, port, ssh_port=None,
+                         timeout=8):
+    """Connect-back check: `remote_host` must be able to open a TCP
+    connection to the launcher's advertised rendezvous address. Raises
+    with an actionable message naming the override knob when it can't
+    (reference analogue: the driver/task service reachability probes,
+    run/run.py:189-259)."""
+    cmd = ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
+           "-o", "ConnectTimeout=%d" % timeout]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    probe = "timeout %d bash -c 'exec 3<>/dev/tcp/%s/%d' 2>&1" % (
+        timeout, addr, port)
+    cmd += [remote_host, probe]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout + 15)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        raise RuntimeError(
+            "rendezvous connect-back preflight could not run on %s: %s"
+            % (remote_host, e))
+    if r.returncode != 0:
+        raise RuntimeError(
+            "remote host %s cannot reach the launcher's rendezvous "
+            "address %s:%d (%s). The launcher guessed this interface "
+            "from its route toward %s; on multi-NIC machines set "
+            "HVD_TPU_RENDEZVOUS_HOST=<ip reachable from the workers> "
+            "or fix the firewall/route." %
+            (remote_host, addr, port,
+             (r.stdout + r.stderr).strip() or "connection refused/timed "
+             "out", remote_host))
+
+
 def launch(slots, rank_envs, command, ssh_port=None, verbose=False):
     """Launches one process per slot; returns the list of Popens."""
     procs = []
@@ -231,8 +264,11 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
 
     # Local slots must be advertised with an address the *other hosts*
     # can reach; 127.0.0.1 is only valid when every slot is local.
-    local_addr = ("127.0.0.1" if all_local
-                  else rendezvous.routable_ip(remote_hosts[0]))
+    # HVD_TPU_RENDEZVOUS_HOST overrides the kernel-route guess on
+    # multi-NIC launchers.
+    local_addr = base_env.get("HVD_TPU_RENDEZVOUS_HOST") or (
+        "127.0.0.1" if all_local
+        else rendezvous.routable_ip(remote_hosts[0]))
 
     server = None
     if start_port:
@@ -253,6 +289,13 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
         rdv_key = rendezvous.make_secret()
         server = rendezvous.RendezvousServer(key=rdv_key)
         rdv_addr = "%s:%d" % (local_addr, server.start())
+        if remote_hosts:
+            # Connect-back preflight: before launching all ranks,
+            # verify one remote host can actually reach the advertised
+            # rendezvous address (a wrong interface guess otherwise
+            # surfaces as every worker hanging until timeout).
+            rendezvous_preflight(remote_hosts[0], local_addr,
+                                 server.port, ssh_port=ssh_port)
         rank_envs = []
         for slot in slots:
             rank_env = dict(base_env)
