@@ -45,8 +45,9 @@ def native_ops_available():
 
 def _py_collective(fn, tensor, name):
     """py_function fallback: runs `fn(numpy) -> numpy` on a tf tensor,
-    eagerly or via tf.py_function inside tf.function graphs."""
-    if tf.inside_function():
+    eagerly or via tf.py_function inside tf.function graphs and TF1
+    graph construction."""
+    if tf.inside_function() or not tf.executing_eagerly():
         out = tf.py_function(lambda t: fn(t.numpy()), [tensor],
                              Tout=tensor.dtype, name=name)
         out.set_shape(tensor.shape)
@@ -125,6 +126,44 @@ def broadcast_variables(variables, root_rank=0):
             value = value()
         var.assign(broadcast(tf.convert_to_tensor(value), root_rank,
                              name=name))
+
+
+def broadcast_global_variables(root_rank=0):
+    """TF1 graph mode: one op assigning every global variable its
+    root-rank value (reference: ``broadcast_global_variables``,
+    ``tensorflow/__init__.py:160-193``). Build after the variables,
+    run once in the session after initialization; in eager mode use
+    :func:`broadcast_variables` instead."""
+    v1 = tf.compat.v1
+    if tf.executing_eagerly():
+        raise RuntimeError(
+            "broadcast_global_variables is graph-mode only; in eager "
+            "TF2 use broadcast_variables(model.variables)")
+    assigns = []
+    for i, var in enumerate(v1.global_variables()):
+        name = "bc_gvar.%d" % i
+        assigns.append(v1.assign(var, broadcast(var, root_rank,
+                                                name=name)))
+    return tf.group(*assigns)
+
+
+class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+    """TF1 ``SessionRunHook`` that broadcasts rank 0's global variables
+    once the session is created — drop-in for estimator /
+    MonitoredTrainingSession training (reference:
+    ``tensorflow/__init__.py:87-141``)."""
+
+    def __init__(self, root_rank=0, device=""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.bcast_op = None
+        self.device = device  # accepted for API parity; host-core path
+
+    def begin(self):
+        self.bcast_op = broadcast_global_variables(self.root_rank)
+
+    def after_create_session(self, session, coord):
+        session.run(self.bcast_op)
 
 
 class DistributedGradientTape(tf.GradientTape):

@@ -18,6 +18,17 @@ def test_tensorflow_distributed(run_launcher):
             proc.stdout + proc.stderr
 
 
+def test_tf1_graph_mode_broadcast(run_launcher):
+    """TF1 compat surface: BroadcastGlobalVariablesHook +
+    broadcast_global_variables under Session/MonitoredTrainingSession
+    (reference tensorflow/__init__.py:87-141,160-193)."""
+    proc = run_launcher(2, "tf1_worker.py", timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for r in range(2):
+        assert ("rank %d: tf1 graph-mode broadcast tests passed" % r) in \
+            proc.stdout, proc.stdout + proc.stderr
+
+
 def test_tf_compression_roundtrip():
     from horovod_tpu.tensorflow.compression import Compression
     x = tf.constant(np.random.randn(16).astype(np.float32))

@@ -1,0 +1,53 @@
+"""TF1 graph-mode worker: eager disabled process-wide (hence a
+dedicated worker), variables initialized differently per rank, then
+synchronized via BroadcastGlobalVariablesHook under a
+MonitoredTrainingSession and via a direct broadcast_global_variables
+run — the reference's TF1 estimator-era API surface
+(`/root/reference/horovod/tensorflow/__init__.py:87-141,160-193`)."""
+
+import sys
+
+import numpy as np
+
+
+def main():
+    import tensorflow as tf
+    tf.compat.v1.disable_eager_execution()
+    v1 = tf.compat.v1
+
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    r = hvd.rank()
+
+    # --- hook path under MonitoredTrainingSession ---
+    g1 = tf.Graph()
+    with g1.as_default():
+        var = v1.get_variable(
+            "w", initializer=tf.constant([10.0 + r, 20.0 + r]))
+        hook = hvd.BroadcastGlobalVariablesHook(root_rank=0)
+        with v1.train.MonitoredTrainingSession(hooks=[hook]) as sess:
+            got = sess.run(var)
+    if not np.allclose(got, [10.0, 20.0]):
+        print("HOOK MISMATCH rank %d: %r" % (r, got))
+        return 1
+
+    # --- direct graph-mode broadcast_global_variables ---
+    g2 = tf.Graph()
+    with g2.as_default():
+        var2 = v1.get_variable(
+            "w2", initializer=tf.constant([float(100 + r)]))
+        bcast = hvd.broadcast_global_variables(0)
+        with v1.Session() as sess:
+            sess.run(v1.global_variables_initializer())
+            sess.run(bcast)
+            got2 = sess.run(var2)
+    if not np.allclose(got2, [100.0]):
+        print("BCAST MISMATCH rank %d: %r" % (r, got2))
+        return 1
+
+    print("rank %d: tf1 graph-mode broadcast tests passed" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
