@@ -1,0 +1,97 @@
+"""Profile one benchmark model's train step and print the aggregated
+per-op-category device time (the PERF.md breakdown tables).
+
+Usage: python examples/profile_step.py [--model transformer] [--steps 5]
+
+Writes a jax.profiler trace, then aggregates XLA op durations from the
+trace's .xplane.pb via tensorflow's profiler proto (both are in the
+image); falls back to printing the trace path for manual inspection.
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+
+def aggregate_trace(logdir, top=25):
+    """Aggregates device-side op durations from the trace.json.gz the
+    profiler writes alongside the xplane."""
+    pats = glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
+                     recursive=True)
+    if not pats:
+        print("no trace.json.gz under %s" % logdir, file=sys.stderr)
+        return None
+    with gzip.open(pats[0], "rt") as f:
+        trace = json.load(f)
+    # Only the device's "XLA Ops" lane: leaf per-op events (the Steps /
+    # XLA Modules lanes are enclosing spans and would double-count).
+    device_pids = set()
+    op_lanes = set()
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "M":
+            continue
+        args = ev.get("args", {})
+        if ev.get("name") == "process_name":
+            name = args.get("name", "")
+            if "TPU" in name or "/device" in name.lower():
+                device_pids.add(ev["pid"])
+    for ev in trace.get("traceEvents", []):
+        if (ev.get("ph") == "M" and ev.get("name") == "thread_name"
+                and ev.get("pid") in device_pids
+                and ev.get("args", {}).get("name") == "XLA Ops"):
+            op_lanes.add((ev["pid"], ev.get("tid")))
+    totals = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X" or \
+                (ev.get("pid"), ev.get("tid")) not in op_lanes:
+            continue
+        name = ev.get("name", "")
+        # Collapse fusion instance suffixes: "fusion.123" -> "fusion",
+        # "convert_reduce_fusion.5" -> "convert_reduce_fusion".
+        base = name.split(".")[0]
+        totals[base] = totals.get(base, 0.0) + ev.get("dur", 0.0)
+    rows = sorted(totals.items(), key=lambda kv: -kv[1])[:top]
+    total = sum(totals.values())
+    print("device op time (us, all steps, lanes=%s):" % sorted(op_lanes))
+    for name, dur in rows:
+        print("  %-44s %10.0f  (%4.1f%%)" % (name, dur, 100 * dur / total))
+    print("  %-44s %10.0f" % ("TOTAL", total))
+    return totals
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="transformer")
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--logdir", default=None)
+    args = ap.parse_args()
+
+    logdir = args.logdir or tempfile.mkdtemp(prefix="hvdtpu_prof_")
+    bench = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    env = dict(os.environ)
+    env["HVD_TPU_PROFILE_DIR"] = logdir
+    env["HVD_TPU_PROFILE_STEPS"] = str(args.steps)
+    cmd = [sys.executable, bench, "--model", args.model,
+           "--num-warmup", "2", "--num-rounds", "1",
+           "--num-iters", str(args.steps),
+           "--batch-size", str(args.batch_size),
+           "--seq-len", str(args.seq_len)]
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    sys.stderr.write(proc.stderr[-1500:])
+    if proc.returncode != 0:
+        raise RuntimeError("bench failed")
+    print(proc.stdout.strip().splitlines()[-1])
+    aggregate_trace(logdir)
+    print("trace dir: %s" % logdir)
+
+
+if __name__ == "__main__":
+    main()

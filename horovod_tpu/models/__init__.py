@@ -18,7 +18,8 @@ All models are written TPU-first: NHWC conv layouts, bfloat16 compute with
 float32 parameters, static shapes, no data-dependent Python control flow.
 """
 
-from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152  # noqa: F401
+from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet50GN,  # noqa: F401
+                     ResNet50NF, ResNet101, ResNet152)
 from .mnist import MnistCNN  # noqa: F401
 from .word2vec import SkipGram  # noqa: F401
 from .transformer import Transformer, TransformerConfig  # noqa: F401

@@ -74,20 +74,38 @@ class BottleneckBlock(nn.Module):
 
 
 class ResNet(nn.Module):
-    """ResNet v1.5 over NHWC inputs."""
+    """ResNet v1.5 over NHWC inputs.
+
+    `norm="group"` swaps BatchNorm for GroupNorm(32) — the PERF.md
+    roofline experiment: BN's cross-batch statistics force f32
+    convert+reduce passes over every activation (the measured HBM
+    bottleneck), while GN's within-sample stats stay in the compute
+    dtype with f32 reduce accumulation only."""
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    norm: str = "batch"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
                        param_dtype=jnp.float32)
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                       param_dtype=jnp.float32, axis_name=None)
+        if self.norm == "none":
+            # Normalizer-free roofline probe: measures the conv-only
+            # ceiling (NF-ResNet-style models train like this with
+            # weight standardization + scalers, which add no
+            # activation-pass traffic).
+            def norm(name=None, scale_init=None):
+                return lambda y: y
+        elif self.norm == "group":
+            norm = partial(nn.GroupNorm, num_groups=32, epsilon=1e-5,
+                           dtype=self.dtype, param_dtype=jnp.float32)
+        else:
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                           param_dtype=jnp.float32, axis_name=None)
         act = nn.relu
 
         x = x.astype(self.dtype)
@@ -114,3 +132,7 @@ ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3],
                     block_cls=BottleneckBlock)
 ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3],
                     block_cls=BottleneckBlock)
+ResNet50GN = partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                     block_cls=BottleneckBlock, norm="group")
+ResNet50NF = partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                     block_cls=BottleneckBlock, norm="none")
