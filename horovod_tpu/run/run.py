@@ -211,8 +211,13 @@ def launch(slots, rank_envs, command, ssh_port=None, verbose=False):
             if verbose:
                 sys.stderr.write("[launcher] rank %d local: %s\n" %
                                  (slot.rank, " ".join(command)))
-            procs.append(subprocess.Popen(command, env=rank_env,
-                                          start_new_session=True))
+            # Via the middleman so teardown reaps the worker's WHOLE
+            # descendant tree — killpg alone misses grandchildren that
+            # re-sessioned with setsid (see exec_middleman.py).
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "horovod_tpu.run.exec_middleman",
+                 "--"] + list(command),
+                env=rank_env, start_new_session=True))
         else:
             # Remote launch over ssh with explicit env exports. The
             # rendezvous secret must NOT ride the command line (argv is
@@ -227,9 +232,15 @@ def launch(slots, rank_envs, command, ssh_port=None, verbose=False):
             ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
             if ssh_port:
                 ssh_cmd += ["-p", str(ssh_port)]
-            remote = "cd %s && env %s %s" % (
-                shlex.quote(os.getcwd()), exports,
-                " ".join(shlex.quote(c) for c in command))
+            # Same middleman wrapping as local slots: the remote
+            # worker's descendant tree (incl. setsid'd helpers) dies
+            # with the ssh channel, not just its process group.
+            # Requires python3 + horovod_tpu importable remotely —
+            # both already required to run the worker itself.
+            remote = "cd %s && env %s python3 -m " \
+                "horovod_tpu.run.exec_middleman -- %s" % (
+                    shlex.quote(os.getcwd()), exports,
+                    " ".join(shlex.quote(c) for c in command))
             if secret is not None:
                 remote = ("IFS= read -r %s && export %s && " %
                           (rendezvous.KEY_ENV, rendezvous.KEY_ENV)) + remote

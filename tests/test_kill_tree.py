@@ -1,0 +1,110 @@
+"""Kill-tree hardening: a worker's descendant that re-sessioned with
+setsid escapes process-group kills; the exec middleman must still reap
+it (reference analogue: safe_shell_exec's middleman,
+run/common/util/safe_shell_exec.py)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import os, subprocess, sys, time
+pidfile = sys.argv[1]
+# Grandchild in its OWN session: killpg on the worker's group misses it.
+subprocess.Popen(
+    [sys.executable, "-c",
+     "import os,sys,time; open(sys.argv[1],'w').write(str(os.getpid()));"
+     "time.sleep(300)", pidfile],
+    start_new_session=True)
+time.sleep(300)
+"""
+
+
+def _alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+def _wait_for(path, timeout=20):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            with open(path) as f:
+                return int(f.read())
+        time.sleep(0.1)
+    raise TimeoutError(path)
+
+
+def test_middleman_reaps_setsid_grandchild(tmp_path):
+    pidfile = str(tmp_path / "grandchild.pid")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    mm = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run.exec_middleman", "--",
+         sys.executable, "-c", WORKER, pidfile],
+        env=env, start_new_session=True)
+    try:
+        grandchild = _wait_for(pidfile)
+        assert _alive(grandchild)
+        # The launcher's teardown path: signal the middleman's group.
+        os.killpg(os.getpgid(mm.pid), signal.SIGTERM)
+        mm.wait(timeout=30)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and _alive(grandchild):
+            time.sleep(0.2)
+        assert not _alive(grandchild), \
+            "setsid grandchild %d survived the kill" % grandchild
+    finally:
+        if mm.poll() is None:
+            mm.kill()
+        if os.path.exists(pidfile):
+            try:
+                os.kill(int(open(pidfile).read()), signal.SIGKILL)
+            except (OSError, ValueError):
+                pass
+
+
+def test_middleman_sweeps_stragglers_on_clean_exit(tmp_path):
+    """Command exits 0 but left a re-sessioned helper behind: the
+    middleman sweeps it instead of leaking it past the job."""
+    pidfile = str(tmp_path / "straggler.pid")
+    script = (
+        "import os, subprocess, sys, time\n"
+        "subprocess.Popen([sys.executable, '-c',\n"
+        " \"import os,sys,time; open(sys.argv[1],'w')"
+        ".write(str(os.getpid())); time.sleep(300)\", sys.argv[1]],\n"
+        " start_new_session=True)\n"
+        # Exit only once the straggler is up (interpreter boot takes
+        # seconds on this host), so the sweep provably kills a LIVE,
+        # observable straggler.\n"
+        "for _ in range(600):\n"
+        "    if os.path.exists(sys.argv[1]) and "
+        "os.path.getsize(sys.argv[1]) > 0: break\n"
+        "    time.sleep(0.1)\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    mm = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run.exec_middleman", "--",
+         sys.executable, "-c", script, pidfile],
+        env=env, timeout=60)
+    assert mm.returncode == 0
+    straggler = _wait_for(pidfile, timeout=5)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and _alive(straggler):
+        time.sleep(0.2)
+    try:
+        assert not _alive(straggler), \
+            "straggler %d outlived the middleman" % straggler
+    finally:
+        try:
+            os.kill(straggler, signal.SIGKILL)
+        except OSError:
+            pass
