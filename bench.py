@@ -189,7 +189,15 @@ def _run_negotiation_bench(n, iters, extra_env=None, timeout=1800):
     procs, outputs = [], []
     for r in range(n):
         env = dict(os.environ)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        # Negotiation workers are numpy+ctypes only; drop PYTHONPATH
+        # entries that exist to register accelerator plugins (their
+        # sitecustomize costs seconds of interpreter boot per worker —
+        # at 256 serialized starts that dwarfs the measurement).
+        inherited = [
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and not os.path.exists(os.path.join(p,
+                                                     "sitecustomize.py"))]
+        env["PYTHONPATH"] = os.pathsep.join([REPO] + inherited)
         env.update({
             "HVD_TPU_RANK": str(r), "HVD_TPU_SIZE": str(n),
             "HVD_TPU_LOCAL_RANK": str(r), "HVD_TPU_LOCAL_SIZE": str(n),

@@ -1,0 +1,97 @@
+"""PyTorch synthetic benchmark — CLI/output parity with the
+reference's `examples/pytorch_synthetic_benchmark.py` (same flags,
+same "Img/sec per rank" report), rewritten for the CPU-torch +
+horovod_tpu host-core path (TPU-resident training belongs to the jax
+binding; this exercises the torch binding end to end)."""
+
+import argparse
+import timeit
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+import torch.optim as optim
+
+import horovod_tpu.torch as hvd
+
+try:
+    from torchvision import models as _models
+except ImportError:  # torchvision absent: use the sibling example's net
+    _models = None
+
+parser = argparse.ArgumentParser(
+    description="PyTorch Synthetic Benchmark",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--fp16-allreduce", action="store_true", default=False,
+                    help="use fp16 compression during allreduce")
+parser.add_argument("--model", type=str, default="resnet50",
+                    help="model to benchmark")
+parser.add_argument("--batch-size", type=int, default=32,
+                    help="input batch size")
+parser.add_argument("--image-size", type=int, default=224)
+parser.add_argument("--num-classes", type=int, default=1000)
+parser.add_argument("--num-warmup-batches", type=int, default=10,
+                    help="number of warm-up batches")
+parser.add_argument("--num-batches-per-iter", type=int, default=10,
+                    help="number of batches per benchmark iteration")
+parser.add_argument("--num-iters", type=int, default=10,
+                    help="number of benchmark iterations")
+args = parser.parse_args()
+
+hvd.init()
+torch.manual_seed(42 + hvd.rank())
+
+if _models is not None:
+    model = getattr(_models, args.model)(num_classes=args.num_classes)
+elif args.model == "resnet50":
+    from pytorch_imagenet_resnet50 import ResNet50
+    model = ResNet50(num_classes=args.num_classes)
+else:
+    raise SystemExit("torchvision is unavailable; only --model resnet50 "
+                     "has a built-in fallback")
+optimizer = optim.SGD(model.parameters(), lr=0.01)
+
+compression = (hvd.Compression.fp16 if args.fp16_allreduce
+               else hvd.Compression.none)
+optimizer = hvd.DistributedOptimizer(
+    optimizer, named_parameters=model.named_parameters(),
+    compression=compression)
+
+hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+data = torch.randn(args.batch_size, 3, args.image_size, args.image_size)
+target = torch.randint(0, args.num_classes, (args.batch_size,))
+
+
+def benchmark_step():
+    optimizer.zero_grad()
+    output = model(data)
+    loss = F.cross_entropy(output, target)
+    loss.backward()
+    optimizer.step()
+
+
+def log(s):
+    if hvd.rank() == 0:
+        print(s, flush=True)
+
+
+log("Model: %s" % args.model)
+log("Batch size: %d" % args.batch_size)
+
+timeit.timeit(benchmark_step, number=args.num_warmup_batches)
+
+img_secs = []
+for x in range(args.num_iters):
+    time = timeit.timeit(benchmark_step, number=args.num_batches_per_iter)
+    img_sec = args.batch_size * args.num_batches_per_iter / time
+    log("Iter #%d: %.1f img/sec per rank" % (x, img_sec))
+    img_secs.append(img_sec)
+
+img_sec_mean = np.mean(img_secs)
+img_sec_conf = 1.96 * np.std(img_secs)
+log("Img/sec per rank: %.1f +-%.1f" % (img_sec_mean, img_sec_conf))
+log("Total img/sec on %d rank(s): %.1f +-%.1f" %
+    (hvd.size(), hvd.size() * img_sec_mean, hvd.size() * img_sec_conf))
+print("rank %d done" % hvd.rank())

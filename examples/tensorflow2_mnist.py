@@ -1,0 +1,58 @@
+"""TF2 eager MNIST — the reference's `examples/tensorflow2_mnist.py`
+workflow (custom training loop, DistributedGradientTape, first-batch
+variable broadcast, rank-scaled learning rate) on synthetic
+MNIST-shaped data so no dataset download is needed."""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--steps", type=int, default=200)
+parser.add_argument("--batch-size", type=int, default=64)
+args = parser.parse_args()
+
+hvd.init()
+
+rng = np.random.RandomState(hvd.rank())
+images = rng.rand(args.batch_size * 4, 28, 28, 1).astype(np.float32)
+labels = rng.randint(0, 10, size=(args.batch_size * 4,)).astype(np.int64)
+dataset = tf.data.Dataset.from_tensor_slices((images, labels)) \
+    .repeat().shuffle(1000).batch(args.batch_size)
+
+mnist_model = tf.keras.Sequential([
+    tf.keras.layers.Conv2D(16, [3, 3], activation="relu"),
+    tf.keras.layers.MaxPooling2D(pool_size=(2, 2)),
+    tf.keras.layers.Flatten(),
+    tf.keras.layers.Dense(64, activation="relu"),
+    tf.keras.layers.Dense(10),
+])
+loss_fn = tf.losses.SparseCategoricalCrossentropy(from_logits=True)
+# Scale the learning rate by the number of ranks (reference convention).
+opt = tf.optimizers.Adam(0.001 * hvd.size())
+
+
+@tf.function
+def training_step(images, labels):
+    with hvd.DistributedGradientTape() as tape:
+        probs = mnist_model(images, training=True)
+        loss_value = loss_fn(labels, probs)
+    grads = tape.gradient(loss_value, mnist_model.trainable_variables)
+    opt.apply_gradients(zip(grads, mnist_model.trainable_variables))
+    return loss_value
+
+
+for batch, (images, labels) in enumerate(dataset.take(args.steps)):
+    loss_value = training_step(images, labels)
+    if batch == 0:
+        # Broadcast initial state after the first step so all ranks
+        # start from rank 0's weights (and optimizer slots exist).
+        hvd.broadcast_variables(mnist_model.variables, root_rank=0)
+        hvd.broadcast_variables(opt.variables, root_rank=0)
+    if batch % 50 == 0 and hvd.local_rank() == 0:
+        print("Step #%d\tLoss: %.6f" % (batch, loss_value), flush=True)
+
+print("rank %d done" % hvd.rank())

@@ -1,0 +1,76 @@
+"""TF1 graph-mode MNIST — the reference's `examples/tensorflow_mnist.py`
+workflow: graph built once, `MonitoredTrainingSession` with
+`BroadcastGlobalVariablesHook` + `StopAtStepHook`, rank-scaled
+learning rate, checkpoints only on rank 0. Synthetic MNIST-shaped data
+(no download); eager is disabled process-wide, so run standalone."""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--batch-size", type=int, default=64)
+    args = parser.parse_args()
+
+    tf.compat.v1.disable_eager_execution()
+    v1 = tf.compat.v1
+
+    hvd.init()
+    rng = np.random.RandomState(hvd.rank())
+
+    with tf.Graph().as_default():
+        images = v1.placeholder(tf.float32, [None, 784], name="images")
+        labels = v1.placeholder(tf.int64, [None], name="labels")
+
+        # v1.layers is gone under Keras 3; plain variables + matmul is
+        # the graph-mode-native way.
+        w1 = v1.get_variable("w1", [784, 64],
+                             initializer=v1.glorot_uniform_initializer())
+        b1 = v1.get_variable("b1", [64],
+                             initializer=v1.zeros_initializer())
+        hidden = tf.nn.relu(tf.matmul(images, w1) + b1)
+        w2 = v1.get_variable("w2", [64, 10],
+                             initializer=v1.glorot_uniform_initializer())
+        b2 = v1.get_variable("b2", [10],
+                             initializer=v1.zeros_initializer())
+        logits = tf.matmul(hidden, w2) + b2
+        loss = v1.losses.sparse_softmax_cross_entropy(labels, logits)
+
+        # Scale the learning rate by the number of ranks (reference
+        # convention), wrap in the distributed optimizer.
+        opt = v1.train.GradientDescentOptimizer(0.01 * hvd.size())
+        global_step = v1.train.get_or_create_global_step()
+        grads_and_vars = opt.compute_gradients(loss)
+        grads_and_vars = [
+            (hvd.allreduce(g, name="gr.%d" % i) if g is not None else g, v)
+            for i, (g, v) in enumerate(grads_and_vars)]
+        train_op = opt.apply_gradients(grads_and_vars,
+                                       global_step=global_step)
+
+        hooks = [
+            hvd.BroadcastGlobalVariablesHook(0),
+            v1.train.StopAtStepHook(last_step=args.steps),
+        ]
+        with v1.train.MonitoredTrainingSession(hooks=hooks) as sess:
+            step = 0
+            while not sess.should_stop():
+                x = rng.rand(args.batch_size, 784).astype(np.float32)
+                y = rng.randint(0, 10, size=(args.batch_size,))
+                _, l = sess.run([train_op, loss],
+                                feed_dict={images: x, labels: y})
+                if step % 50 == 0 and hvd.rank() == 0:
+                    print("Step #%d\tLoss: %.6f" % (step, l), flush=True)
+                step += 1
+
+    print("rank %d done" % hvd.rank())
+    return 0
+
+
+if __name__ == "__main__":
+    main()

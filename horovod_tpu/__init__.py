@@ -188,3 +188,42 @@ def tcp_built():
 
 def cpu_ops_built():
     return get_basics().cpu_ops_built()
+
+
+# Reference-named capability probes (horovod/common/basics.py:117-191),
+# for drop-in migration: the TCP controller fills the gloo role here;
+# MPI/NCCL/DDL/MLSL backends do not exist in the TPU redesign (ICI
+# collectives live inside XLA programs instead — see docs/DESIGN.md).
+
+def mpi_threads_supported():
+    return False
+
+
+def mpi_enabled():
+    return False
+
+
+def mpi_built():
+    return False
+
+
+def gloo_enabled():
+    """True: the TCP rendezvous/controller provides the gloo-role
+    host data plane."""
+    return tcp_built()
+
+
+def gloo_built():
+    return tcp_built()
+
+
+def nccl_built():
+    return False
+
+
+def ddl_built():
+    return False
+
+
+def mlsl_built():
+    return False

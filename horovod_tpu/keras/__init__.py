@@ -9,6 +9,8 @@ import horovod_tpu as _hvd
 from horovod_tpu import (  # noqa: F401
     init, shutdown, is_initialized, rank, local_rank, cross_rank, size,
     local_size, cross_size, is_homogeneous,
+    mpi_threads_supported, mpi_enabled, mpi_built, gloo_enabled,
+    gloo_built, nccl_built, ddl_built, mlsl_built,
 )
 from horovod_tpu.tensorflow import (  # noqa: F401
     allreduce, allgather, broadcast, Compression,

@@ -71,6 +71,30 @@ def test_tensorflow2_synthetic_benchmark_example():
     assert "done" in proc.stdout
 
 
+def test_pytorch_synthetic_benchmark_example():
+    proc = run_example(2, "pytorch_synthetic_benchmark.py",
+                       ["--image-size", "64", "--num-classes", "10",
+                        "--batch-size", "4", "--num-warmup-batches", "1",
+                        "--num-batches-per-iter", "2", "--num-iters", "2"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Img/sec per rank" in proc.stdout
+    assert "done" in proc.stdout
+
+
+def test_tensorflow2_mnist_example():
+    proc = run_example(2, "tensorflow2_mnist.py", ["--steps", "60"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Loss" in proc.stdout
+    assert "done" in proc.stdout
+
+
+def test_tensorflow_mnist_tf1_example():
+    proc = run_example(2, "tensorflow_mnist.py", ["--steps", "60"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Loss" in proc.stdout
+    assert "done" in proc.stdout
+
+
 def test_keras_spark_rossmann_example():
     proc = run_example(2, "keras_spark_rossmann.py",
                        ["--local", "--epochs", "1",

@@ -54,3 +54,14 @@ def test_reserve_port_valid():
     from horovod_tpu.run import rendezvous
     ports = {rendezvous.reserve_port() for _ in range(4)}
     assert all(0 < p < 65536 for p in ports)
+
+
+def test_reference_capability_probes():
+    """Migration shims (reference basics.py:117-191): gloo-role probes
+    track the TCP build; MPI/NCCL-family probes are honestly False."""
+    import horovod_tpu as hvd
+    assert hvd.gloo_built() and hvd.gloo_enabled()
+    assert not hvd.mpi_built() and not hvd.mpi_enabled()
+    assert not hvd.mpi_threads_supported()
+    assert not hvd.nccl_built() and not hvd.ddl_built() \
+        and not hvd.mlsl_built()
