@@ -247,6 +247,7 @@ def _run_negotiation_bench(n, iters, extra_env=None, timeout=1800):
 _ZOO = [
     ("resnet50", ["--batch-size", "256"]),
     ("resnet50gn", ["--batch-size", "256"]),
+    ("resnet50nf", ["--batch-size", "256"]),
     ("resnet101", ["--batch-size", "128"]),
     ("vgg16", ["--batch-size", "64"]),
     ("inception3", ["--batch-size", "128", "--image-size", "299"]),
@@ -266,12 +267,30 @@ def all_models_main(args):
                "--num-rounds", str(args.num_rounds),
                "--num-iters", str(args.num_iters)] + extra
         print("=== %s ===" % model, file=sys.stderr)
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=3600)
-        sys.stderr.write(proc.stderr[-2000:])
-        if proc.returncode != 0:
-            raise RuntimeError("bench for %s failed:\n%s" %
-                               (model, proc.stderr[-4000:]))
+        # One retry: the remote-compile tunnel occasionally drops a
+        # response mid-read; losing a 30-minute sweep to that transient
+        # is worse than a duplicate attempt.
+        proc = None
+        for attempt in (1, 2):
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=3600)
+            except subprocess.TimeoutExpired as e:
+                # A hung child (tunnel dropped mid-read) counts as a
+                # failed attempt too, not a sweep-ending exception.
+                print("=== %s attempt %d timed out: %s ===" %
+                      (model, attempt, e), file=sys.stderr)
+                proc = None
+                continue
+            sys.stderr.write(proc.stderr[-2000:])
+            if proc.returncode == 0:
+                break
+            print("=== %s attempt %d failed ===" % (model, attempt),
+                  file=sys.stderr)
+        if proc is None or proc.returncode != 0:
+            raise RuntimeError(
+                "bench for %s failed twice:\n%s" %
+                (model, proc.stderr[-4000:] if proc else "timed out"))
         results.append(json.loads(proc.stdout.strip().splitlines()[-1]))
     best_mfu = max(r.get("mfu", 0.0) or 0.0 for r in results)
     print(json.dumps({

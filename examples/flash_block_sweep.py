@@ -1,0 +1,92 @@
+"""Flash-kernel block-size sweep at a given attention shape.
+
+The default (bq=256, bk=512) was tuned at D=128; the GPT-2-shaped
+bench runs D=64 H=12 where the VMEM budget and the VPU/MXU balance
+differ. Sweeps (block_q, block_k) for fwd and fwd+bwd with the
+single-dispatch lax.scan recipe and prints a table.
+
+Usage: python examples/flash_block_sweep.py [--B 8 --L 2048 --H 12 --D 64]
+"""
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+import importlib
+
+# The ops package re-exports the flash_attention FUNCTION under the
+# same name; import the module itself for the block-size internals.
+fa = importlib.import_module("horovod_tpu.ops.flash_attention")
+
+
+def timed(fn, args, iters=30):
+    def body(carry, _):
+        out = fn(*carry)
+        if isinstance(out, tuple):
+            out = out[0]
+        return (carry[0] + 1e-30 * out,) + carry[1:], ()
+
+    def run(*args):
+        carry, _ = lax.scan(body, args, None, length=iters)
+        return jnp.sum(carry[0].astype(jnp.float32))
+
+    jitted = jax.jit(run)
+    float(jitted(*args))
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(jitted(*args))
+        times.append((time.perf_counter() - t0) / iters)
+    return sorted(times)[1]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--B", type=int, default=8)
+    ap.add_argument("--L", type=int, default=2048)
+    ap.add_argument("--H", type=int, default=12)
+    ap.add_argument("--D", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=30)
+    args = ap.parse_args()
+    B, L, H, D = args.B, args.L, args.H, args.D
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, L, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, H, L, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, H, L, D), jnp.bfloat16)
+    g = jnp.asarray(rng.randn(B, H, L, D), jnp.bfloat16)
+    scale = D ** -0.5
+
+    print("shape B=%d L=%d H=%d D=%d (kernel layout)" % (B, L, H, D))
+    print("%8s %8s | %9s | %9s" % ("bq", "bk", "fwd ms", "fwd+bwd ms"))
+    for bq in (128, 256, 512):
+        for bk in (256, 512, 1024):
+            if L % bq or L % bk:
+                continue
+            try:
+                fwd = functools.partial(
+                    fa._pallas_forward, scale=scale, causal=True,
+                    interpret=False, block_q=bq, block_k=bk)
+                t_fwd = timed(lambda q: fwd(q, k, v), (q,), args.iters)
+
+                def fb(q, k, v, g, bq=bq, bk=bk):
+                    out, lse = fa._pallas_forward_lse(
+                        q, k, v, scale, True, False, bq, bk)
+                    dq, dk, dv = fa._pallas_backward(
+                        q, k, v, out, lse, g, scale, True, False, bq, bk)
+                    return dq + dk + dv
+
+                t_fb = timed(lambda q: fb(q, k, v, g), (q,), args.iters)
+                print("%8d %8d | %9.3f | %9.3f" %
+                      (bq, bk, t_fwd * 1e3, t_fb * 1e3))
+            except Exception as e:
+                print("%8d %8d | failed: %s" % (bq, bk, str(e)[:60]))
+
+
+if __name__ == "__main__":
+    main()

@@ -120,6 +120,17 @@ def _pick_block(L, preferred):
     return None
 
 
+def _default_blocks(D, backward=False):
+    """Preferred (block_q, block_k) by head dim, from v5e sweeps
+    (examples/flash_block_sweep.py): (256, 512) at D=128; D<=64 leaves
+    VMEM headroom for wider k blocks — (256, 1024) forward,
+    (512, 1024) backward. ONE definition for the plain and ring paths
+    so a retune can't leave them inconsistent."""
+    if D <= 64:
+        return (512, 1024) if backward else (256, 1024)
+    return (256, 512)
+
+
 def _require_block(L, preferred, what):
     b = _pick_block(L, preferred)
     if b is None:
@@ -145,10 +156,11 @@ def _pallas_forward_lse(q, k, v, scale, causal, interpret,
 
     # Bigger blocks amortize per-grid-step overhead (the MXU work per
     # step is tiny); bounded so s [BQ, BK] and the double-buffered k/v
-    # blocks stay well inside VMEM. (256, 512) measured fastest on v5e
-    # across the {128,256,512}^2 sweep.
-    bq = block_q or _pick_block(L, 256)
-    bk = block_k or _pick_block(L, 512)
+    # blocks stay well inside VMEM. Preferences are D-aware — see
+    # _default_blocks.
+    pq, pk = _default_blocks(D)
+    bq = block_q or _pick_block(L, pq)
+    bk = block_k or _pick_block(L, pk)
     num_kb = L // bk
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_kb=num_kb)
@@ -243,8 +255,9 @@ def flash_ring_step(q, k, v, o, m, l, q_offset, kv_offset, causal=True,
     Lk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
-    bq = block_q or _require_block(Lq, 256, "q shard length")
-    bk = block_k or _require_block(Lk, 512, "k/v shard length")
+    pq, pk = _default_blocks(D)
+    bq = block_q or _require_block(Lq, pq, "q shard length")
+    bk = block_k or _require_block(Lk, pk, "k/v shard length")
     num_kb = Lk // bk
     offs = jnp.array([[0, 0]], jnp.int32).at[0, 0].set(q_offset) \
         .at[0, 1].set(kv_offset)
@@ -378,8 +391,9 @@ def flash_ring_bwd_step(q, k, v, do, lse, delta, dq, dk, dv, q_offset,
     Lk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
-    bq = block_q or _require_block(Lq, 256, "q shard length")
-    bk = block_k or _require_block(Lk, 512, "k/v shard length")
+    pq, pk = _default_blocks(D, backward=True)
+    bq = block_q or _require_block(Lq, pq, "q shard length")
+    bk = block_k or _require_block(Lk, pk, "k/v shard length")
     num_kb, num_qb = Lk // bk, Lq // bq
     offs = jnp.array([[0, 0]], jnp.int32).at[0, 0].set(q_offset) \
         .at[0, 1].set(kv_offset)
@@ -531,8 +545,12 @@ def _pallas_backward(q, k, v, out, lse, g, scale, causal, interpret,
         jnp.sum(gf.astype(jnp.float32) *
                 out.reshape(B * H, L, D).astype(jnp.float32), axis=-1,
                 keepdims=True), (B * H, L, 8))
-    bq = block_q or _pick_block(L, 256)
-    bk = block_k or _pick_block(L, 512)
+    # Backward blocks are independent of the forward's (lse/delta
+    # stripes are block-agnostic); see _default_blocks for the swept
+    # preferences.
+    pq, pk = _default_blocks(D, backward=True)
+    bq = block_q or _pick_block(L, pq)
+    bk = block_k or _pick_block(L, pk)
     num_kb, num_qb = L // bk, L // bq
 
     dq = pl.pallas_call(
