@@ -368,6 +368,12 @@ def main():
                     help="sequence length (transformer model)")
     ap.add_argument("--tokens-batch", type=int, default=8,
                     help="per-chip sequences per step (transformer model)")
+    ap.add_argument("--fused-xent", action="store_true",
+                    help="use the streaming chunked LM cross entropy "
+                         "(ops/losses.py) instead of the dense "
+                         "log_softmax loss — required for very long "
+                         "sequences (dense f32 logits at L=8192 "
+                         "exceed a v5e's HBM)")
     ap.add_argument("--all-models", action="store_true",
                     help="run the whole model-zoo sweep (one subprocess "
                          "per model) and print a single combined JSON "
@@ -426,13 +432,32 @@ def main():
             jnp.arange(L, dtype=jnp.int32)[None], tokens.shape)
         params = model.init(rng, tokens[:1], positions[:1])["params"]
 
-        def loss_fn(params, batch):
-            logits = model.apply({"params": params}, batch["x"],
-                                 batch["pos"])
-            tgt = jnp.roll(batch["x"], -1, axis=1)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-            return -jnp.mean(jnp.take_along_axis(
-                logp, tgt[..., None], axis=-1))
+        if args.fused_xent:
+            # Streaming LM loss: chunked vocab projection + logsumexp,
+            # never materializing [B, L, V] f32 logits (identical
+            # math; ops/losses.py).
+            from horovod_tpu.ops.losses import \
+                chunked_softmax_cross_entropy
+
+            # Largest power-of-two chunk (<=512) dividing L, so any
+            # --seq-len works; L itself as the degenerate fallback.
+            chunk = next((c for c in (512, 256, 128, 64)
+                          if args.seq_len % c == 0), args.seq_len)
+
+            def loss_fn(params, batch):
+                hidden = model.apply({"params": params}, batch["x"],
+                                     batch["pos"], return_hidden=True)
+                tgt = jnp.roll(batch["x"], -1, axis=1)
+                return chunked_softmax_cross_entropy(
+                    hidden, params["lm_head"]["kernel"], tgt, chunk=chunk)
+        else:
+            def loss_fn(params, batch):
+                logits = model.apply({"params": params}, batch["x"],
+                                     batch["pos"])
+                tgt = jnp.roll(batch["x"], -1, axis=1)
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+                return -jnp.mean(jnp.take_along_axis(
+                    logp, tgt[..., None], axis=-1))
 
         opt = optax.adam(1e-4)
         step = make_train_step(loss_fn, opt, mesh, donate=True)

@@ -105,11 +105,17 @@ class Block(nn.Module):
 
 class Transformer(nn.Module):
     """tokens [B, L_local] (+ global positions when sequence-sharded) ->
-    logits [B, L_local, vocab]."""
+    logits [B, L_local, vocab].
+
+    ``return_hidden=True`` skips the lm_head projection and returns the
+    final normed hidden states — pair with
+    `horovod_tpu.ops.losses.chunked_softmax_cross_entropy` (and the
+    lm_head kernel from the params tree) to train without ever
+    materializing the [B, L, vocab] f32 logits."""
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, return_hidden=False):
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(
@@ -121,6 +127,8 @@ class Transformer(nn.Module):
             x = Block(cfg, name="block_%d" % i)(x, positions)
         x = nn.RMSNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                        name="norm_f")(x)
+        if return_hidden:
+            return x
         logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
                           param_dtype=jnp.float32, use_bias=False,
                           name="lm_head")(x)

@@ -6,6 +6,13 @@ explicitly."""
 
 import os
 
+# The suite is CPU-only by design. An accelerator PJRT plugin that
+# dials a remote service during jax plugin REGISTRATION (the tunnel
+# plugin in this environment does) hangs every `import jax` when that
+# service is down — drop its pool pointer before anything imports jax
+# so registration never engages. bench.py / __graft_entry__.py keep it.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
 os.environ["JAX_PLATFORMS"] = os.environ.get("HVD_TPU_TEST_PLATFORM", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
@@ -44,6 +51,7 @@ def run_launcher():
         env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
         # Workers run plain CPU numpy; don't inherit test JAX flags.
         env.pop("JAX_PLATFORMS", None)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # workers never need the TPU
         # Workers compile identical jit programs; share a persistent
         # compilation cache so only the first worker pays the compile.
         env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/hvd_tpu_jax_cache")

@@ -39,6 +39,7 @@ def run_hierarchical_workers(script, extra_env=None, timeout=300):
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env.pop("JAX_PLATFORMS", None)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # workers never need the TPU
         env["JAX_PLATFORM_NAME"] = "cpu"
         env.update({
             "HVD_TPU_RANK": str(r),
