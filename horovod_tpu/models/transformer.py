@@ -33,7 +33,28 @@ class TransformerConfig:
     max_seq_len: int = 8192
     attention: str = "dense"      # dense | flash | ring | ulysses
     sp_axis: Optional[str] = None  # mesh axis holding the sequence shards
+    # Megatron-style tensor parallelism: when set, the module runs
+    # inside shard_map with attention heads and the MLP hidden dim
+    # sharded over this axis (num_heads/mlp_dim are the LOCAL sizes —
+    # build with `cfg.local(tp_size)`, place full params with
+    # parallel.tensor_parallel.tp_param_specs), and the attention-out
+    # / mlp-out projections psum their partial products across it.
+    tp_axis: Optional[str] = None
+    # Per-head width; defaults to embed_dim // num_heads. Set
+    # explicitly when num_heads is a LOCAL (tp-sharded) count.
+    head_dim: Optional[int] = None
     dtype: Any = jnp.bfloat16
+
+    def local(self, tp_size):
+        """The per-shard config for `tp_size`-way tensor parallelism."""
+        if self.num_heads % tp_size or self.mlp_dim % tp_size:
+            raise ValueError(
+                "tp_size=%d must divide both num_heads=%d and "
+                "mlp_dim=%d" % (tp_size, self.num_heads, self.mlp_dim))
+        return dataclasses.replace(
+            self, num_heads=self.num_heads // tp_size,
+            mlp_dim=self.mlp_dim // tp_size,
+            head_dim=self.head_dim or self.embed_dim // self.num_heads)
 
 
 def _rotary(x, positions):
@@ -56,7 +77,7 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.cfg
-        head_dim = cfg.embed_dim // cfg.num_heads
+        head_dim = cfg.head_dim or cfg.embed_dim // cfg.num_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
             (cfg.num_heads, head_dim), dtype=cfg.dtype,
             param_dtype=jnp.float32, use_bias=False, name=name)
@@ -80,9 +101,14 @@ class Attention(nn.Module):
             s = jnp.where(mask[None, None], s, -jnp.inf)
             p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
             o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
-        return nn.DenseGeneral(cfg.embed_dim, axis=(-2, -1), dtype=cfg.dtype,
-                               param_dtype=jnp.float32, use_bias=False,
-                               name="out")(o)
+        out = nn.DenseGeneral(cfg.embed_dim, axis=(-2, -1), dtype=cfg.dtype,
+                              param_dtype=jnp.float32, use_bias=False,
+                              name="out")(o)
+        if cfg.tp_axis is not None:
+            # Each tp shard projected only its local heads: the row-
+            # parallel output is a partial sum (Megatron-style).
+            out = lax.psum(out, cfg.tp_axis)
+        return out
 
 
 class Block(nn.Module):
@@ -100,6 +126,10 @@ class Block(nn.Module):
         h = nn.silu(h)
         h = nn.Dense(cfg.embed_dim, dtype=cfg.dtype, param_dtype=jnp.float32,
                      use_bias=False, name="mlp_out")(h)
+        if cfg.tp_axis is not None:
+            # Column-parallel mlp_in -> row-parallel mlp_out: the out
+            # product over the local hidden slice is a partial sum.
+            h = lax.psum(h, cfg.tp_axis)
         return x + h
 
 

@@ -24,4 +24,6 @@ from .mesh import (  # noqa: F401
     topology_summary,
 )
 from .ring import ring_attention, ulysses_attention  # noqa: F401
+from .tensor_parallel import (  # noqa: F401
+    tp_grad_sync, tp_param_specs)
 from .train import make_train_step  # noqa: F401
