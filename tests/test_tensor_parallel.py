@@ -98,6 +98,33 @@ def test_tp_gradients_match_full_model():
                                    err_msg=jax.tree_util.keystr(path))
 
 
+def test_tp_with_flash_attention_path():
+    """TP composes with the flash-attention config (each shard runs
+    flash over its local heads; the blockwise fallback covers non-TPU
+    backends) — values still match the full dense model."""
+    tp = 2
+    base = dict(BASE, attention="flash")
+    cfg = TransformerConfig(**base)
+    model = Transformer(TransformerConfig(**dict(BASE)))
+    rng = np.random.RandomState(5)
+    tokens = jnp.asarray(rng.randint(0, 97, (2, 128)))  # flash-aligned L
+    params = model.init(jax.random.PRNGKey(7), tokens)["params"]
+    expected = model.apply({"params": params}, tokens)
+
+    local = Transformer(TransformerConfig(tp_axis="tp", **base).local(tp))
+    mesh = _mesh(tp, "tp")
+    specs = tp_param_specs(params, "tp")
+    params_p = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+    out = jax.jit(jax.shard_map(
+        lambda p, t: local.apply({"params": p}, t),
+        mesh=mesh, in_specs=(specs, P()), out_specs=P(),
+        check_vma=False))(params_p, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_tp_local_config_validation():
     cfg = TransformerConfig(**BASE)
     with pytest.raises(ValueError):
