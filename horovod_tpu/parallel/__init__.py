@@ -23,6 +23,7 @@ from .mesh import (  # noqa: F401
     mesh_axis_size,
     topology_summary,
 )
+from .pipeline import pipeline_apply, stack_block_params  # noqa: F401
 from .ring import ring_attention, ulysses_attention  # noqa: F401
 from .tensor_parallel import (  # noqa: F401
     tp_grad_sync, tp_param_specs)

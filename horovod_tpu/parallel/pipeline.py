@@ -1,0 +1,72 @@
+"""Pipeline parallelism (GPipe-style) over homogeneous stages.
+
+No reference analogue (the reference only moves gradients). Stages are
+groups of identical transformer blocks whose stacked parameters are
+sharded over a ``pp`` mesh axis; activations flow stage-to-stage via
+``lax.ppermute`` while a microbatch schedule keeps every stage busy:
+at schedule step t, stage d processes microbatch t - d (devices run
+the same ``lax.scan``; out-of-range steps compute on don't-care data
+and are masked at collection). Forward-only latency is
+(M + P - 1) stage-times for M microbatches on P stages — the standard
+GPipe fill/drain. Autodiff flows through the scan + ppermute, so the
+same schedule trains (activations for the backward are scan
+residuals; wrap `stage_fn` in ``jax.checkpoint`` for O(stages)
+memory).
+
+Usage (see tests/test_pipeline.py): embed on every device, pipeline
+the blocks, then norm/head on every device — stages must be
+structurally identical, so the embedding/head live OUTSIDE the
+pipelined region.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn, stage_params, x_microbatches, pp_axis):
+    """Runs sequence-of-stages over microbatches inside shard_map.
+
+    Args:
+      stage_fn: ``stage_fn(local_stage_params, x) -> y`` with x and y
+        the SAME shape (one pipeline stage; typically a scan over the
+        stage's transformer blocks).
+      stage_params: the calling shard's stage parameters (placed with
+        a leading stage dim sharded over `pp_axis`, squeezed by the
+        caller or consumed as-is by stage_fn).
+      x_microbatches: [M, ...] microbatched input, replicated across
+        the pp axis (only stage 0 reads it).
+      pp_axis: mesh axis name the stages are sharded over.
+
+    Returns [M, ...] outputs of the LAST stage, replicated across the
+    pp axis.
+    """
+    n_stages = lax.psum(1, pp_axis)
+    d = lax.axis_index(pp_axis)
+    M = x_microbatches.shape[0]
+    steps = M + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    zero = jnp.zeros_like(x_microbatches[0])
+
+    def step(buf, t):
+        # Stage 0 feeds microbatch t (clamped: past-M steps are drain
+        # steps whose stage-0 compute is discarded); later stages
+        # consume what the previous stage sent last step.
+        feed = x_microbatches[jnp.minimum(t, M - 1)]
+        inp = jnp.where(d == 0, feed, buf)
+        out = stage_fn(stage_params, inp)
+        return lax.ppermute(out, pp_axis, perm), out
+
+    _, outs = lax.scan(step, zero, jnp.arange(steps))
+    # The last stage's real outputs sit at schedule steps
+    # [n_stages-1, n_stages-1+M); every device slices there (static
+    # bounds) and a masked psum replicates the last stage's values.
+    tail = lax.dynamic_slice_in_dim(outs, n_stages - 1, M, axis=0)
+    return lax.psum(jnp.where(d == n_stages - 1, tail, 0.0), pp_axis)
+
+
+def stack_block_params(params, num_layers, prefix="block_%d"):
+    """Stacks per-layer block param trees ([num_layers, ...] leaves)
+    for stage sharding; layers must be structurally identical."""
+    blocks = [params[prefix % i] for i in range(num_layers)]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
