@@ -125,6 +125,40 @@ def test_tp_with_flash_attention_path():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_tp_with_ring_attention_sp_mesh():
+    """tp and sp compose on one mesh: heads sharded over tp, sequence
+    sharded over sp with ring attention inside each tp group — output
+    still matches the full dense model."""
+    tp, sp = 2, 2
+    base = dict(BASE, attention="ring", sp_axis="sp")
+    model = Transformer(TransformerConfig(**dict(BASE)))
+    rng = np.random.RandomState(9)
+    tokens = jnp.asarray(rng.randint(0, 97, (2, 32)))
+    params = model.init(jax.random.PRNGKey(11), tokens)["params"]
+    expected = model.apply({"params": params}, tokens)
+
+    local = Transformer(TransformerConfig(tp_axis="tp", **base).local(tp))
+    mesh = Mesh(np.array(jax.devices("cpu")[:tp * sp]).reshape(tp, sp),
+                ("tp", "sp"))
+    specs = tp_param_specs(params, "tp")
+    params_p = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+
+    def run(p, tokens):
+        L = tokens.shape[1]
+        positions = jnp.broadcast_to(
+            jax.lax.axis_index("sp") * L +
+            jnp.arange(L, dtype=jnp.int32)[None], tokens.shape)
+        return local.apply({"params": p}, tokens, positions)
+
+    out = jax.jit(jax.shard_map(
+        run, mesh=mesh, in_specs=(specs, P(None, "sp")),
+        out_specs=P(None, "sp"), check_vma=False))(params_p, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_tp_local_config_validation():
     cfg = TransformerConfig(**BASE)
     with pytest.raises(ValueError):
