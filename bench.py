@@ -255,10 +255,43 @@ _ZOO = [
 ]
 
 
+def _tpu_probe_or_report(timeout=240):
+    """True when `import jax` + device enumeration completes (probed
+    in a killable subprocess — with the tunnel plugin's relay dead it
+    hangs forever in-process); on failure prints the diagnostic JSON
+    line and returns False. Skipped when HVD_TPU_SKIP_TPU_PROBE=1 or
+    no pool pointer is present."""
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return True
+    if os.environ.get("HVD_TPU_SKIP_TPU_PROBE") == "1":
+        return True
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            capture_output=True, text=True, timeout=timeout)
+        ok = probe.returncode == 0 and "ok" in probe.stdout
+        err = (probe.stderr or probe.stdout)[-300:]
+    except subprocess.TimeoutExpired:
+        ok, err = False, "import jax timed out (tunnel relay down)"
+    if not ok:
+        print(json.dumps({
+            "metric": "bench_unavailable", "value": 0.0,
+            "unit": "error", "vs_baseline": 0.0,
+            "baseline": "TPU backend unreachable; see PERF.md / "
+                        "BENCH_ZOO_r03.json for the last good "
+                        "captures", "error": err.strip()}))
+    return ok
+
+
 def all_models_main(args):
     """bench.py --all-models: runs every zoo config in a subprocess
     (clean device state per model) and prints one JSON line with all
     results, so the PERF.md model-zoo numbers are reproducible."""
+    if not _tpu_probe_or_report():
+        return 1
+    # Children inherit a verified backend; don't re-pay the probe 7x.
+    os.environ["HVD_TPU_SKIP_TPU_PROBE"] = "1"
     results = []
     for model, extra in _ZOO:
         cmd = [sys.executable, os.path.abspath(__file__),
@@ -399,6 +432,15 @@ def main():
         return scaling_main(args)
     if args.all_models:
         return all_models_main(args)
+
+    # Accelerator-plugin outage guard: with this environment's tunnel
+    # plugin dead, `import jax` hangs FOREVER in any process holding
+    # the pool pointer. Probe in a killable subprocess so the bench
+    # fails loudly (one diagnostic JSON line, exit 1) instead of
+    # hanging the caller. --all-models probes once and tells its
+    # children to skip.
+    if not _tpu_probe_or_report():
+        return 1
 
     import jax
     import jax.numpy as jnp
@@ -597,4 +639,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
