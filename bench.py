@@ -612,7 +612,7 @@ def main():
                 analytic_attention_flops
             attn = cfg.num_layers * analytic_attention_flops(
                 args.tokens_batch, cfg.num_heads, L,
-                cfg.embed_dim // cfg.num_heads, causal=True, backward=True)
+                cfg.embed_dim // cfg.num_heads, causal=True, training=True)
             total_tflops = (flops + attn) / (step_time_ms / 1000.0) / 1e12
             out["attn_tflops_uncounted_by_xla"] = round(
                 attn / (step_time_ms / 1000.0) / 1e12, 1)

@@ -658,18 +658,20 @@ def _flash_bwd(scale, causal, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def analytic_attention_flops(B, H, L, D, causal=True, backward=False):
+def analytic_attention_flops(B, H, L, D, causal=True, training=False):
     """FLOPs the Pallas attention kernels execute per call — XLA's
     compiled-cost analysis reports custom calls as ZERO flops, so
     benchmarks add this analytic count to keep MFU honest. Forward runs
     2 matmuls per (q,k) block pair (QK^T, PV); the backward kernels run
     7 matmul-equivalents (s and dp are recomputed in both the dQ and
-    dK/dV kernels, plus the dQ/dK/dV products). Causal halves the
-    visited block pairs."""
+    dK/dV kernels, plus the dQ/dK/dV products). ``training=True``
+    therefore returns the FULL forward+backward step count (2 + 7 = 9
+    per block pair) — callers must NOT add a separate forward term.
+    Causal halves the visited block pairs."""
     per_matmul = 2.0 * B * H * L * L * D
     if causal:
         per_matmul /= 2.0
-    return (9.0 if backward else 2.0) * per_matmul
+    return (9.0 if training else 2.0) * per_matmul
 
 
 def flash_attention(q, k, v, causal=True, scale=None):

@@ -300,7 +300,7 @@ def candidate_ips(peer_host=None, peer_port=80):
             ip = socket.inet_ntoa(packed[20:24])
             if ip not in cands and not ip.startswith("127."):
                 cands.append(ip)
-    except OSError:
+    except (OSError, ImportError):  # ImportError: no fcntl off-Linux
         pass
     return cands or ["127.0.0.1"]
 

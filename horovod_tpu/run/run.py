@@ -134,6 +134,21 @@ def build_env(slot, addrs, base_env=None):
     return env
 
 
+def _ssh_base_cmd(extra_opts=(), ssh_port=None):
+    """The remote-shell argv prefix. HVD_TPU_SSH_CMD overrides the
+    program (bastion wrappers, agents — and it lets tests drive the
+    remote branch with a fake ssh that execs locally); the standard
+    non-interactive options are only added for real ssh."""
+    override = os.environ.get("HVD_TPU_SSH_CMD")
+    if override:
+        cmd = shlex.split(override)
+    else:
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no"] + list(extra_opts)
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    return cmd
+
+
 def ssh_preflight(hostnames, ssh_port=None, timeout=5):
     """Verifies every remote host is reachable over non-interactive ssh
     before launching anything (reference: run/run.py:53-106). Raises with
@@ -141,10 +156,9 @@ def ssh_preflight(hostnames, ssh_port=None, timeout=5):
     import concurrent.futures
 
     def probe(host):
-        cmd = ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
-               "-o", "ConnectTimeout=%d" % timeout]
-        if ssh_port:
-            cmd += ["-p", str(ssh_port)]
+        cmd = _ssh_base_cmd(
+            ["-o", "BatchMode=yes", "-o", "ConnectTimeout=%d" % timeout],
+            ssh_port=ssh_port)
         cmd += [host, "true"]
         try:
             r = subprocess.run(cmd, capture_output=True, text=True,
@@ -177,10 +191,9 @@ def rendezvous_preflight(remote_host, addr, port, ssh_port=None,
     with an actionable message naming the override knob when it can't
     (reference analogue: the driver/task service reachability probes,
     run/run.py:189-259)."""
-    cmd = ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
-           "-o", "ConnectTimeout=%d" % timeout]
-    if ssh_port:
-        cmd += ["-p", str(ssh_port)]
+    cmd = _ssh_base_cmd(
+        ["-o", "BatchMode=yes", "-o", "ConnectTimeout=%d" % timeout],
+        ssh_port=ssh_port)
     probe = "timeout %d bash -c 'exec 3<>/dev/tcp/%s/%d' 2>&1" % (
         timeout, addr, port)
     cmd += [remote_host, probe]
@@ -229,17 +242,21 @@ def launch(slots, rank_envs, command, ssh_port=None, verbose=False):
                 for k, v in rank_env.items()
                 if (k.startswith("HVD_TPU_") or k in ("PYTHONPATH", "PATH"))
                 and k != rendezvous.KEY_ENV)
-            ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
-            if ssh_port:
-                ssh_cmd += ["-p", str(ssh_port)]
+            ssh_cmd = _ssh_base_cmd(ssh_port=ssh_port)
             # Same middleman wrapping as local slots: the remote
             # worker's descendant tree (incl. setsid'd helpers) dies
             # with the ssh channel, not just its process group.
-            # Requires python3 + horovod_tpu importable remotely —
+            # Requires a python + horovod_tpu importable remotely —
             # both already required to run the worker itself.
-            remote = "cd %s && env %s python3 -m " \
+            # HVD_TPU_REMOTE_PYTHON names the remote interpreter (venv
+            # workers where bare `python3` is the wrong env).
+            remote_py = (rank_env.get("HVD_TPU_REMOTE_PYTHON") or
+                         os.environ.get("HVD_TPU_REMOTE_PYTHON") or
+                         "python3")
+            remote = "cd %s && env %s %s -m " \
                 "horovod_tpu.run.exec_middleman -- %s" % (
                     shlex.quote(os.getcwd()), exports,
+                    shlex.quote(remote_py),
                     " ".join(shlex.quote(c) for c in command))
             if secret is not None:
                 remote = ("IFS= read -r %s && export %s && " %

@@ -45,14 +45,15 @@ def native_ops_available():
     return _mpi_ops.native_ops_available()
 
 
-def _py_collective(fn, tensor, name):
+def _py_collective(fn, tensor, name, out_shape=None):
     """py_function fallback: runs `fn(numpy) -> numpy` on a tf tensor,
     eagerly or via tf.py_function inside tf.function graphs and TF1
-    graph construction."""
+    graph construction. `out_shape` overrides the static output shape
+    when it differs from the input's (allgather grows axis 0)."""
     if tf.inside_function() or not tf.executing_eagerly():
         out = tf.py_function(lambda t: fn(t.numpy()), [tensor],
                              Tout=tensor.dtype, name=name)
-        out.set_shape(tensor.shape)
+        out.set_shape(tensor.shape if out_shape is None else out_shape)
         return out
     import numpy as np
     return tf.convert_to_tensor(fn(np.asarray(tensor)))
@@ -96,14 +97,10 @@ def allgather(tensor, name=None):
     op_name = name or _auto_name("allgather")
     if _mpi_ops.native_ops_available():
         return _mpi_ops.allgather(tf.convert_to_tensor(tensor), op_name)
-    if tf.inside_function():
-        out = tf.py_function(
-            lambda t: _ops.allgather(t.numpy(), op_name), [tensor],
-            Tout=tensor.dtype, name=op_name.replace(".", "_"))
-        out.set_shape([None] + list(tensor.shape[1:]))
-        return out
-    import numpy as np
-    return tf.convert_to_tensor(_ops.allgather(np.asarray(tensor), op_name))
+    return _py_collective(
+        lambda arr: _ops.allgather(arr, op_name), tensor,
+        op_name.replace(".", "_"),
+        out_shape=[None] + list(tensor.shape[1:]))
 
 
 def broadcast(tensor, root_rank=0, name=None):

@@ -1,0 +1,31 @@
+"""Fake ssh for launcher tests: skips ssh-style options, ignores the
+hostname, and executes the remote command string locally under bash —
+so the launcher's REAL remote branch (ssh argv construction, stdin
+secret piping, env-export filtering, middleman wrapping) runs end to
+end without an ssh daemon (reference analogue: the mock-the-shell test
+strategy of test/test_run.py).
+
+Used via HVD_TPU_SSH_CMD="<python> tests/fake_ssh.py".
+"""
+
+import subprocess
+import sys
+
+
+def main():
+    args = sys.argv[1:]
+    # Strip ssh-style options: "-o value", "-p value", bare flags.
+    while args and args[0].startswith("-"):
+        if args[0] in ("-o", "-p", "-i", "-l", "-F", "-E"):
+            args = args[2:]
+        else:
+            args = args[1:]
+    if len(args) < 2:
+        sys.stderr.write("fake_ssh: expected <host> <command>\n")
+        return 2
+    command = " ".join(args[1:])  # args[0] is the ignored hostname
+    return subprocess.call(["bash", "-c", command])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
