@@ -30,13 +30,21 @@ def _shape_array(arr):
     return (ctypes.c_int64 * arr.ndim)(*arr.shape)
 
 
-def allreduce_async(tensor, name, prescale_factor=1.0, postscale_factor=1.0):
-    """Starts an allreduce (sum) on a numpy array; returns a handle."""
+def allreduce_async(tensor, name, prescale_factor=1.0, postscale_factor=1.0,
+                    out=None):
+    """Starts an allreduce (sum) on a numpy array; returns a handle.
+
+    `out`, when given, is a C-contiguous same-dtype/size array the core
+    writes the result into directly — it MAY alias the input (the native
+    ops guard self-copy: cpu_operations.cc `e.output != e.data`). This
+    is the zero-copy path for framework tensors whose memory numpy can
+    view (torch CPU tensors)."""
     basics = get_basics()
     arr = np.ascontiguousarray(tensor)
     # ascontiguousarray promotes 0-d to (1,); the result must round-trip
     # the caller's shape (a reshape view shares the output buffer).
-    out = np.empty_like(arr).reshape(np.shape(tensor))
+    if out is None:
+        out = np.empty_like(arr).reshape(np.shape(tensor))
     handle = basics.lib.horovod_tpu_enqueue_allreduce(
         name.encode("utf-8"), arr.ctypes.data_as(ctypes.c_void_p),
         out.ctypes.data_as(ctypes.c_void_p), arr.ndim, _shape_array(arr),
@@ -59,11 +67,13 @@ def allgather_async(tensor, name):
     return handle
 
 
-def broadcast_async(tensor, root_rank, name):
-    """Starts a broadcast from root_rank; returns a handle."""
+def broadcast_async(tensor, root_rank, name, out=None):
+    """Starts a broadcast from root_rank; returns a handle. `out` as in
+    :func:`allreduce_async` (may alias the input)."""
     basics = get_basics()
     arr = np.ascontiguousarray(tensor)
-    out = np.empty_like(arr).reshape(np.shape(tensor))
+    if out is None:
+        out = np.empty_like(arr).reshape(np.shape(tensor))
     handle = basics.lib.horovod_tpu_enqueue_broadcast(
         name.encode("utf-8"), arr.ctypes.data_as(ctypes.c_void_p),
         out.ctypes.data_as(ctypes.c_void_p), arr.ndim, _shape_array(arr),

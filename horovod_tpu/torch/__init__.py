@@ -10,8 +10,13 @@ per-parameter grad hooks and ``backward_passes_per_step`` accumulation,
 Torch here is the CPU-tensor framework (the environment ships CPU torch);
 tensors ride the native host core — the same path as the reference's
 ``DoAllreduceCudaOnCPU`` staging variant (`torch/mpi_ops_v2.cc:84-117`),
-minus the GPU staging copy. TPU training from torch graphs is out of
-scope; use the jax binding for the XLA/ICI plane.
+minus the GPU staging copy. Contiguous CPU tensors ride ZERO-COPY: the
+enqueue C API receives the tensor's own storage pointer (numpy view via
+the buffer protocol) for both input and output, so ``allreduce_async_``
+/ ``broadcast_async_`` reduce in place with no host copies at all — the
+reference's in-place-on-storage semantics (`torch/mpi_ops_v2.cc:52-76`)
+without C++ glue. TPU training from torch graphs is out of scope; use
+the jax binding for the XLA/ICI plane.
 """
 
 import torch
@@ -28,7 +33,9 @@ from horovod_tpu.common.ops import HorovodInternalError  # noqa: F401
 
 from .compression import Compression  # noqa: F401
 
-# handle -> (input torch tensor, output destination or None)
+# handle -> (input torch tensor, result torch tensor or None, bound).
+# `bound=True` means the core writes the result DIRECTLY into the result
+# tensor's storage (zero-copy path) — synchronize just returns it.
 _torch_handles = {}
 
 _name_counter = [0]
@@ -39,7 +46,28 @@ def _auto_name(prefix):
     return "%s.t%d" % (prefix, _name_counter[0])
 
 
+def _numpy_view(tensor):
+    """Zero-copy numpy view over a contiguous CPU torch tensor, or None
+    when the memory can't be viewed (non-CPU, non-contiguous). This is
+    the reference's in-place-on-tensor-storage design
+    (`torch/mpi_ops_v2.cc:52-76`) done with the buffer protocol instead
+    of C++ glue: the view's .ctypes pointer IS the tensor's storage."""
+    if tensor.device.type != "cpu" or not tensor.is_contiguous():
+        return None
+    t = tensor.detach()
+    if tensor.dtype == torch.bfloat16:
+        # Bit-pattern reinterpret (no value conversion): torch bf16 ->
+        # int16 view -> numpy -> ml_dtypes.bfloat16 view.
+        import ml_dtypes
+        return t.view(torch.int16).numpy().view(ml_dtypes.bfloat16)
+    try:
+        return t.numpy()
+    except (TypeError, RuntimeError):
+        return None
+
+
 def _to_numpy(tensor):
+    """Copying fallback for tensors `_numpy_view` can't handle."""
     if tensor.dtype == torch.bfloat16:
         import ml_dtypes
         return tensor.detach().float().cpu().numpy().astype(
@@ -49,44 +77,85 @@ def _to_numpy(tensor):
 
 # -- async collectives ----------------------------------------------------
 
+def _start_allreduce(tensor, dest, name, prescale, post):
+    """dest=None: allocate a result tensor; dest=tensor: in place."""
+    view = _numpy_view(tensor)
+    if view is not None:
+        result = tensor if dest is tensor else torch.empty_like(tensor)
+        out_view = view if result is tensor else _numpy_view(result)
+        handle = _ops.allreduce_async(view, name,
+                                      prescale_factor=prescale,
+                                      postscale_factor=post, out=out_view)
+        _torch_handles[handle] = (tensor, result, True)
+        return handle
+    handle = _ops.allreduce_async(_to_numpy(tensor), name,
+                                  prescale_factor=prescale,
+                                  postscale_factor=post)
+    _torch_handles[handle] = (tensor, dest, False)
+    return handle
+
+
 def allreduce_async(tensor, average=True, name=None,
                     prescale_factor=1.0, postscale_factor=1.0):
     post = postscale_factor / size() if average else postscale_factor
-    handle = _ops.allreduce_async(_to_numpy(tensor),
-                                  name or _auto_name("allreduce"),
-                                  prescale_factor=prescale_factor,
-                                  postscale_factor=post)
-    _torch_handles[handle] = (tensor, None)
-    return handle
+    return _start_allreduce(tensor, None, name or _auto_name("allreduce"),
+                            prescale_factor, post)
 
 
 def allreduce_async_(tensor, average=True, name=None,
                      prescale_factor=1.0, postscale_factor=1.0):
-    """In-place variant: the result lands back in `tensor`."""
-    handle = allreduce_async(tensor, average, name, prescale_factor,
-                             postscale_factor)
-    _torch_handles[handle] = (tensor, tensor)
-    return handle
+    """In-place variant: the result lands back in `tensor` — zero-copy
+    (the core reduces straight into the tensor's storage) when the
+    tensor is contiguous CPU.
+
+    Failure semantics match the reference's in-place design: if the
+    collective fails (peer crash, shutdown), the tensor's contents are
+    UNDEFINED — fault-tolerant callers must re-broadcast state after
+    catching HorovodInternalError, exactly as with the reference's
+    in-place ops."""
+    post = postscale_factor / size() if average else postscale_factor
+    return _start_allreduce(tensor, tensor,
+                            name or _auto_name("allreduce"),
+                            prescale_factor, post)
 
 
 def allgather_async(tensor, name=None):
-    handle = _ops.allgather_async(_to_numpy(tensor),
-                                  name or _auto_name("allgather"))
-    _torch_handles[handle] = (tensor, None)
+    """The gathered result returned by :func:`synchronize` is a
+    zero-copy view over the core-owned gather buffer (released when the
+    result tensor is garbage-collected). Callers retaining many results
+    long-term should ``.clone()`` them — or set
+    ``HVD_TPU_ALLGATHER_COPY=1`` to make every allgather return an
+    owned copy with deterministic buffer release."""
+    view = _numpy_view(tensor)
+    handle = _ops.allgather_async(
+        view if view is not None else _to_numpy(tensor),
+        name or _auto_name("allgather"))
+    _torch_handles[handle] = (tensor, None, False)
+    return handle
+
+
+def _start_broadcast(tensor, dest, root_rank, name):
+    view = _numpy_view(tensor)
+    if view is not None:
+        result = tensor if dest is tensor else torch.empty_like(tensor)
+        out_view = view if result is tensor else _numpy_view(result)
+        handle = _ops.broadcast_async(view, root_rank, name, out=out_view)
+        _torch_handles[handle] = (tensor, result, True)
+        return handle
+    handle = _ops.broadcast_async(_to_numpy(tensor), root_rank, name)
+    _torch_handles[handle] = (tensor, dest, False)
     return handle
 
 
 def broadcast_async(tensor, root_rank, name=None):
-    handle = _ops.broadcast_async(_to_numpy(tensor), root_rank,
-                                  name or _auto_name("broadcast"))
-    _torch_handles[handle] = (tensor, None)
-    return handle
+    return _start_broadcast(tensor, None, root_rank,
+                            name or _auto_name("broadcast"))
 
 
 def broadcast_async_(tensor, root_rank, name=None):
-    handle = broadcast_async(tensor, root_rank, name)
-    _torch_handles[handle] = (tensor, tensor)
-    return handle
+    """In-place variant — zero-copy for contiguous CPU tensors."""
+    return _start_broadcast(tensor, tensor, root_rank,
+                            name or _auto_name("broadcast"))
 
 
 def poll(handle):
@@ -98,12 +167,20 @@ def synchronize(handle):
     in place when the `_`-variant started it)."""
     if handle not in _torch_handles:
         raise ValueError("unknown handle %d" % handle)
-    tensor, dest = _torch_handles.pop(handle)
+    tensor, dest, bound = _torch_handles.pop(handle)
     out = _ops.synchronize(handle)
+    if bound:
+        # The core already wrote the result into dest's storage.
+        return dest
     try:
-        result = torch.from_numpy(out.copy())
-    except TypeError:  # bfloat16 numpy extension dtype
-        result = torch.from_numpy(out.astype("float32")).to(torch.bfloat16)
+        # No .copy(): allgather results stay views over the core-owned
+        # gather buffer (torch.from_numpy holds the numpy base, whose
+        # finalizer releases the core handle).
+        result = torch.from_numpy(out)
+    except TypeError:  # bfloat16 numpy extension dtype: bit reinterpret
+        import numpy as np
+        result = torch.from_numpy(
+            np.ascontiguousarray(out).view(np.int16)).view(torch.bfloat16)
     if result.dtype != tensor.dtype:
         result = result.to(tensor.dtype)
     if dest is not None:

@@ -42,6 +42,41 @@ def test_allreduce_bf16(r, n):
     assert torch.allclose(out.float(), torch.full((8,), exp)), out
 
 
+def test_zero_copy_inplace(r, n):
+    """In-place collectives on contiguous CPU tensors must keep the
+    SAME storage (the core writes into the tensor's own memory —
+    reference in-place semantics, torch/mpi_ops_v2.cc:52-76)."""
+    x = torch.arange(1024, dtype=torch.float32) + r
+    ptr = x.data_ptr()
+    hvd.allreduce_(x, average=False, name="t_zc_ar")
+    assert x.data_ptr() == ptr
+    exp = n * torch.arange(1024, dtype=torch.float32) + sum(range(n))
+    assert torch.allclose(x, exp), (x[:4], exp[:4])
+
+    b = torch.full((64,), float(r))
+    ptr = b.data_ptr()
+    hvd.broadcast_(b, 0, name="t_zc_bc")
+    assert b.data_ptr() == ptr
+    assert torch.allclose(b, torch.zeros(64)), b
+
+    # bf16 rides the same zero-copy path via bit-pattern views.
+    xb = torch.ones(256, dtype=torch.bfloat16) * (r + 1)
+    ptr = xb.data_ptr()
+    hvd.allreduce_(xb, average=False, name="t_zc_bf16")
+    assert xb.data_ptr() == ptr
+    exp = float(sum(rr + 1 for rr in range(n)))
+    assert torch.allclose(xb.float(), torch.full((256,), exp)), xb
+
+    # Non-contiguous tensors take the copying fallback but must still
+    # produce correct in-place results.
+    base = torch.zeros(8, 2)
+    col = base[:, 0]
+    col.fill_(float(r + 1))
+    hvd.allreduce_(col, average=False, name="t_zc_noncontig")
+    assert torch.allclose(col, torch.full((8,), exp)), col
+    assert torch.allclose(base[:, 1], torch.zeros(8)), base
+
+
 def test_allgather(r, n):
     x = torch.full((r + 1, 2), float(r))
     out = hvd.allgather(x, name="t_ag")
