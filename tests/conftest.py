@@ -40,6 +40,26 @@ def cpu_devices():
     return jax.devices("cpu")
 
 
+def clean_worker_env(extra_env=None):
+    """Env for spawning worker/launcher subprocesses: repo on
+    PYTHONPATH, TPU plugin disengaged, CPU backend pinned, shared
+    compile cache. The single source of truth for the scrub recipe —
+    don't copy it inline (it has drifted before)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # workers never need the TPU
+    # JAX_PLATFORM_NAME (not JAX_PLATFORMS) overrides the axon TPU
+    # plugin's default-backend priority — N workers must not all grab
+    # the single tunnel chip.
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/hvd_tpu_jax_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    if extra_env:
+        env.update(extra_env)
+    return env
+
+
 @pytest.fixture
 def run_launcher():
     """Runs a worker script under the launcher (`-np N` on localhost) —
@@ -47,21 +67,7 @@ def run_launcher():
     import subprocess
 
     def _run(np_, script, extra_env=None, timeout=300):
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
-        # Workers run plain CPU numpy; don't inherit test JAX flags.
-        env.pop("JAX_PLATFORMS", None)
-        env.pop("PALLAS_AXON_POOL_IPS", None)  # workers never need the TPU
-        # Workers compile identical jit programs; share a persistent
-        # compilation cache so only the first worker pays the compile.
-        env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/hvd_tpu_jax_cache")
-        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
-        # JAX_PLATFORM_NAME (not JAX_PLATFORMS) overrides the axon TPU
-        # plugin's default-backend priority — N workers must not all grab
-        # the single tunnel chip.
-        env["JAX_PLATFORM_NAME"] = "cpu"
-        if extra_env:
-            env.update(extra_env)
+        env = clean_worker_env(extra_env)
         script_path = os.path.join(REPO_ROOT, "tests", script)
         return subprocess.run(
             [sys.executable, "-m", "horovod_tpu.run.run", "-np", str(np_),

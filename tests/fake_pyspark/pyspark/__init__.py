@@ -1,0 +1,136 @@
+"""Minimal pyspark stand-in for driving `horovod_tpu.spark.run` END TO
+END without a Spark cluster (reference analogue: test/test_spark.py's
+mock-the-shell strategy). Implements exactly the four surfaces run()
+touches — SparkSession.builder, sparkContext.parallelize/barrier/
+mapPartitions/collect — plus BarrierTaskContext, with REAL semantics:
+collect() forks one OS process per partition and allGather() is a real
+cross-process barrier, so the barrier tasks perform a genuine
+multi-process horovod rendezvous (`hvd.init()`), not a simulation.
+
+Lives under tests/fake_pyspark/ and is only importable when a test puts
+that directory on sys.path — the production ImportError path stays
+testable.
+"""
+
+import multiprocessing
+
+
+class BarrierTaskContext:
+    """Per-process task context; `get()` returns the instance installed
+    by the fake runtime in each forked partition process."""
+
+    _current = None
+
+    def __init__(self, rank, world, store, barrier):
+        self._rank = rank
+        self._world = world
+        self._store = store
+        self._barrier = barrier
+
+    @classmethod
+    def get(cls):
+        if cls._current is None:
+            raise RuntimeError("BarrierTaskContext.get() outside a "
+                               "barrier task")
+        return cls._current
+
+    def partitionId(self):
+        return self._rank
+
+    def allGather(self, message):
+        self._store[self._rank] = str(message)
+        self._barrier.wait(timeout=60)
+        return [self._store[r] for r in range(self._world)]
+
+
+def _run_partition(fn, elements, rank, world, store, barrier, queue):
+    try:
+        BarrierTaskContext._current = BarrierTaskContext(
+            rank, world, store, barrier)
+        queue.put((rank, list(fn(iter(elements))), None))
+    except BaseException as e:  # surface the child's failure to collect()
+        queue.put((rank, None, "%s: %s" % (type(e).__name__, e)))
+
+
+class _BarrierRDD:
+    def __init__(self, data, num_partitions):
+        data = list(data)
+        # parallelize(range(n), n) -> partition i holds [i], like Spark.
+        self._parts = [data[i::num_partitions]
+                       for i in range(num_partitions)]
+        self._fn = None
+
+    def barrier(self):
+        return self
+
+    def mapPartitions(self, fn):
+        self._fn = fn
+        return self
+
+    def collect(self):
+        n = len(self._parts)
+        ctx = multiprocessing.get_context("fork")
+        manager = ctx.Manager()
+        store = manager.dict()
+        barrier = ctx.Barrier(n)
+        queue = ctx.Queue()
+        procs = [ctx.Process(target=_run_partition,
+                             args=(self._fn, part, r, n, store, barrier,
+                                   queue))
+                 for r, part in enumerate(self._parts)]
+        for p in procs:
+            p.start()
+        results = []
+        errors = []
+        try:
+            import queue as queue_mod
+            import time
+            deadline = time.time() + 120
+            pending = n
+            while pending and time.time() < deadline:
+                try:
+                    rank, out, err = queue.get(timeout=1)
+                except queue_mod.Empty:
+                    # A child that died without reporting (segfault in
+                    # native code) must not stall the full deadline.
+                    dead = [p for p in procs
+                            if p.exitcode not in (None, 0)]
+                    if dead and queue.empty():
+                        errors.append(("?", "child died with exitcode(s) "
+                                       "%s" % [p.exitcode for p in dead]))
+                        break
+                    continue
+                pending -= 1
+                if err is not None:
+                    errors.append((rank, err))
+                else:
+                    results.extend(out)
+            if pending and not errors:
+                errors.append(("?", "timed out waiting for %d barrier "
+                               "task(s)" % pending))
+        finally:
+            for p in procs:
+                p.join(timeout=10)
+                if p.is_alive():
+                    p.terminate()
+            manager.shutdown()
+        if errors:
+            raise RuntimeError("barrier task(s) failed: %s" % errors)
+        return results
+
+
+class _FakeSparkContext:
+    defaultParallelism = 2
+
+    def parallelize(self, data, num_partitions=None):
+        return _BarrierRDD(data, num_partitions or self.defaultParallelism)
+
+
+class _FakeSession:
+    def __init__(self):
+        self.sparkContext = _FakeSparkContext()
+
+
+class _Builder:
+    def getOrCreate(self):
+        return _FakeSession()

@@ -1,0 +1,7 @@
+"""pyspark.sql surface used by horovod_tpu.spark.run: SparkSession."""
+
+from pyspark import _Builder
+
+
+class SparkSession:
+    builder = _Builder()

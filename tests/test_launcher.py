@@ -79,16 +79,15 @@ def test_ssh_remote_branch_e2e():
     import subprocess
     import sys
 
+    from conftest import clean_worker_env
+
     repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
     fake_ssh = os.path.join(repo_root, "tests", "fake_ssh.py")
     worker = os.path.join(repo_root, "tests", "distributed_ops_worker.py")
-    env = dict(os.environ)
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("JAX_PLATFORMS", None)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORM_NAME"] = "cpu"
-    env["HVD_TPU_SSH_CMD"] = "%s %s" % (sys.executable, fake_ssh)
-    env["HVD_TPU_REMOTE_PYTHON"] = sys.executable
+    env = clean_worker_env({
+        "HVD_TPU_SSH_CMD": "%s %s" % (sys.executable, fake_ssh),
+        "HVD_TPU_REMOTE_PYTHON": sys.executable,
+    })
     proc = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.run.run", "-np", "2",
          "-H", "fakehost-a:1,fakehost-b:1", "--",

@@ -95,3 +95,27 @@ def test_barrier_task_end_to_end():
     finally:
         hvd.init, hvd.shutdown = orig_init, orig_shutdown
     assert results == {0: 0, 1: 10}
+
+
+def test_spark_run_e2e_fake_pyspark():
+    """Drives `spark.run()` ITSELF — SparkSession.builder ->
+    parallelize -> barrier -> mapPartitions -> collect — through the
+    fake pyspark package (tests/fake_pyspark), with each barrier task
+    forked as a real OS process doing a genuine hvd.init() rendezvous
+    and allreduce. Runs in a clean interpreter so the forked children
+    hold no pre-initialized native runtime (reference analogue:
+    test/test_spark.py:51-91)."""
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    from conftest import clean_worker_env
+
+    repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo_root, "tests",
+                                      "spark_run_worker.py")],
+        env=clean_worker_env(), timeout=240, capture_output=True, text=True)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "spark run ok" in proc.stdout, proc.stdout
