@@ -197,8 +197,17 @@ class DistributedGradientTape(tf.GradientTape):
 def DistributedOptimizer(optimizer, average=True,
                          compression=Compression.none,
                          sparse_as_dense=False):
-    """Wraps a Keras-3 optimizer so `apply_gradients` first averages
-    gradients across ranks (reference: tensorflow/__init__.py:231-319)."""
+    """Wraps an optimizer so gradients are averaged across ranks before
+    being applied (reference: tensorflow/__init__.py:231-319).
+
+    Keras-3 optimizers get a subclass whose ``apply_gradients``
+    allreduces first. TF1 ``tf.compat.v1.train.Optimizer`` instances
+    (the estimator-era API, reference tensorflow/__init__.py:186-240)
+    get a wrapping v1 optimizer whose ``compute_gradients`` allreduces
+    — so ``minimize()`` inside a session graph trains data-parallel."""
+    if isinstance(optimizer, tf.compat.v1.train.Optimizer):
+        return _DistributedV1Optimizer(optimizer, average, compression,
+                                       sparse_as_dense)
 
     base = optimizer.__class__
 
@@ -220,3 +229,47 @@ def DistributedOptimizer(optimizer, average=True,
     cls = type("Distributed%s" % base.__name__, (_Distributed,), {})
     new_opt = cls.from_config(optimizer.get_config())
     return new_opt
+
+
+class _DistributedV1Optimizer(tf.compat.v1.train.Optimizer):
+    """Composition wrapper around a v1 optimizer: `compute_gradients`
+    allreduces each gradient (graph ops), everything else delegates —
+    the reference's v1 DistributedOptimizer shape."""
+
+    def __init__(self, optimizer, average, compression, sparse_as_dense):
+        self._opt = optimizer
+        self._hvd_average = average
+        self._hvd_compression = compression
+        self._hvd_sparse_as_dense = sparse_as_dense
+        # Collective names are the cross-rank rendezvous keys: scope
+        # them per wrapper instance (two wrapped optimizers in one
+        # graph must not collide) and per VARIABLE, not per position
+        # (var_list ordering must not silently mis-pair gradients).
+        self._hvd_scope = _auto_name("v1opt")
+        super().__init__(use_locking=False,
+                         name="Distributed%s" % type(optimizer).__name__)
+
+    def compute_gradients(self, *args, **kwargs):
+        gvs = self._opt.compute_gradients(*args, **kwargs)
+        out = []
+        for g, v in gvs:
+            if g is not None:
+                g = allreduce(g, average=self._hvd_average,
+                              name="%s.grad.%s" % (self._hvd_scope,
+                                                   v.name.replace(":", "_")),
+                              compression=self._hvd_compression,
+                              sparse_as_dense=self._hvd_sparse_as_dense)
+            out.append((g, v))
+        return out
+
+    def apply_gradients(self, *args, **kwargs):
+        return self._opt.apply_gradients(*args, **kwargs)
+
+    def get_slot(self, *args, **kwargs):
+        return self._opt.get_slot(*args, **kwargs)
+
+    def get_slot_names(self, *args, **kwargs):
+        return self._opt.get_slot_names(*args, **kwargs)
+
+    def variables(self, *args, **kwargs):
+        return self._opt.variables(*args, **kwargs)

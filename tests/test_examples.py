@@ -18,16 +18,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def run_example(np_, script, extra_args=(), timeout=420):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("JAX_PLATFORMS", None)
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # workers never need the TPU
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/hvd_tpu_jax_cache")
-    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
-    # N workers must not all grab the single tunnel TPU; JAX_PLATFORM_NAME
-    # (unlike JAX_PLATFORMS) overrides the axon plugin's default-backend
-    # priority.
-    env["JAX_PLATFORM_NAME"] = "cpu"
+    from conftest import clean_worker_env
+    env = clean_worker_env()
     return subprocess.run(
         [sys.executable, "-m", "horovod_tpu.run.run", "-np", str(np_), "--",
          sys.executable, os.path.join(REPO, "examples", script)]
@@ -108,5 +100,17 @@ def test_keras_spark_rossmann_example():
                     reason="set HVD_TPU_RUN_ALL_EXAMPLES=1 to run")
 def test_keras_mnist_example():
     proc = run_example(2, "keras_mnist.py", ["--epochs", "1"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "done" in proc.stdout
+
+
+def test_keras_mnist_advanced_example():
+    """The reference's only example exercising LearningRateWarmupCallback
+    + MetricAverageCallback in real training
+    (examples/keras_mnist_advanced.py:69-106); this equivalent asserts
+    the warmup ramp and cross-rank metric averaging internally."""
+    proc = run_example(2, "keras_mnist_advanced.py",
+                       ["--epochs", "4", "--warmup-epochs", "2",
+                        "--samples", "256"])
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "done" in proc.stdout

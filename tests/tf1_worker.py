@@ -31,6 +31,51 @@ def main():
         print("HOOK MISMATCH rank %d: %r" % (r, got))
         return 1
 
+    # --- estimator-style TRAINING LOOP: BroadcastGlobalVariablesHook +
+    # v1 DistributedOptimizer.minimize under MonitoredTrainingSession
+    # (reference: examples/tensorflow_mnist_estimator.py:109-115 — the
+    # estimator API itself is gone in TF>=2.16, so the hook runs in the
+    # session-loop form estimators lower to) ---
+    gt = tf.Graph()
+    with gt.as_default():
+        rng = np.random.RandomState(1234)
+        w_true = np.array([[2.0], [-3.0]], np.float32)
+        xs = rng.randn(64, 2).astype(np.float32)
+        ys = xs @ w_true
+        # Rank-disjoint shards: convergence to w_true requires the
+        # gradient allreduce to combine them.
+        xs_r, ys_r = xs[r::hvd.size()], ys[r::hvd.size()]
+
+        x_ph = v1.placeholder(tf.float32, [None, 2])
+        y_ph = v1.placeholder(tf.float32, [None, 1])
+        w = v1.get_variable("w_train",
+                            initializer=tf.constant([[5.0 * r], [1.0 - r]]))
+        loss = tf.reduce_mean((x_ph @ w - y_ph) ** 2)
+        opt = hvd.DistributedOptimizer(
+            v1.train.GradientDescentOptimizer(0.2))
+        train_op = opt.minimize(loss)
+        hook = hvd.BroadcastGlobalVariablesHook(root_rank=0)
+        with v1.train.MonitoredTrainingSession(hooks=[hook]) as sess:
+            first = None
+            for _ in range(60):
+                cur, _ = sess.run([loss, train_op],
+                                  {x_ph: xs_r, y_ph: ys_r})
+                first = cur if first is None else first
+            w_final = sess.run(w)
+    if not cur < first * 1e-2:
+        print("TRAIN LOOP did not converge rank %d: %g -> %g" %
+              (r, first, cur))
+        return 1
+    if not np.allclose(w_final, w_true, atol=0.05):
+        print("TRAIN LOOP wrong weights rank %d: %r" % (r, w_final))
+        return 1
+    # Gradient averaging must have kept every rank's weights identical.
+    from horovod_tpu.common import ops as _ops
+    gathered = _ops.allgather(w_final.reshape(1, -1), "tf1_w_final")
+    if not np.allclose(gathered, gathered[0]):
+        print("TRAIN LOOP ranks diverged: %r" % (gathered,))
+        return 1
+
     # --- direct graph-mode broadcast_global_variables ---
     g2 = tf.Graph()
     with g2.as_default():
