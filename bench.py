@@ -182,8 +182,9 @@ def _reserve_ports(n):
 
 
 def _run_negotiation_bench(n, iters, extra_env=None, timeout=1800):
-    """Launches n local control-plane workers (numpy+ctypes only) and
-    returns rank 0's negotiation latency in us/op."""
+    """Launches n local control-plane workers (numpy+ctypes only);
+    returns (rank-0 negotiation latency us/op, protocol counters by
+    rank for ranks 0 and 1 — bytes/messages/cycle kinds)."""
     socks, ports = _reserve_ports(n)
     addrs = ",".join("127.0.0.1:%d" % p for p in ports)
     procs, outputs = [], []
@@ -218,6 +219,7 @@ def _run_negotiation_bench(n, iters, extra_env=None, timeout=1800):
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True))
     us = None
+    counters = {}
     try:
         for r, p in enumerate(procs):
             out, _ = p.communicate(timeout=timeout)
@@ -227,6 +229,10 @@ def _run_negotiation_bench(n, iters, extra_env=None, timeout=1800):
             m = re.search(r"NEGOTIATION_US_PER_OP ([\d.]+)", out)
             if m:
                 us = float(m.group(1))
+            m = re.search(r"PROTOCOL_COUNTERS (\{.*\})", out)
+            if m:
+                d = json.loads(m.group(1))
+                counters[d["rank"]] = d
     finally:
         for p in procs:
             if p.poll() is None:
@@ -237,7 +243,7 @@ def _run_negotiation_bench(n, iters, extra_env=None, timeout=1800):
         raise RuntimeError(
             "no NEGOTIATION_US_PER_OP line in any worker output; rank 0 "
             "said:\n%s" % (outputs[0] if outputs else "<no output>"))
-    return us
+    return us, counters
 
 
 # Model-zoo sweep configs: the models in the reference's published
@@ -350,8 +356,8 @@ def scaling_main(args):
     for n in rank_counts:
         iters = max(25, 3200 // n)
         try:
-            cached = _run_negotiation_bench(n, iters)
-            uncached = _run_negotiation_bench(
+            cached, c_ctr = _run_negotiation_bench(n, iters)
+            uncached, u_ctr = _run_negotiation_bench(
                 n, max(10, iters // 4), {"HVD_TPU_CACHE_CAPACITY": "0"})
         except (RuntimeError, subprocess.TimeoutExpired) as e:
             # One failing size shouldn't lose the whole evidence run.
@@ -359,10 +365,61 @@ def scaling_main(args):
             print("negotiation n=%d FAILED: %s" % (n, str(e)[:200]),
                   file=sys.stderr)
             continue
-        negotiation.append({"ranks": n, "cached_us_per_op": cached,
-                            "uncached_us_per_op": uncached})
-        print("negotiation n=%d: cached %.0f us/op, uncached %.0f us/op"
-              % (n, cached, uncached), file=sys.stderr)
+
+        def per_step(ctr, rank):
+            d = ctr.get(rank)
+            if not d or not d.get("iters"):
+                return None
+            return round((d["ctrl_bytes_sent"] + d["ctrl_bytes_recv"])
+                         / d["iters"], 1)
+
+        entry = {
+            "ranks": n, "cached_us_per_op": cached,
+            "uncached_us_per_op": uncached,
+            # Protocol-level fast-path evidence, wall-clock-independent:
+            # control bytes (sent+recv, headers included) per op.
+            "cached_bytes_per_op_coord": per_step(c_ctr, 0),
+            "uncached_bytes_per_op_coord": per_step(u_ctr, 0),
+            "cached_bytes_per_op_worker": per_step(c_ctr, 1),
+            "uncached_bytes_per_op_worker": per_step(u_ctr, 1),
+            "cached_cycle_kinds": {
+                "fast": c_ctr.get(0, {}).get("cycles_fast"),
+                "full": c_ctr.get(0, {}).get("cycles_full")},
+            "uncached_cycle_kinds": {
+                "fast": u_ctr.get(0, {}).get("cycles_fast"),
+                "full": u_ctr.get(0, {}).get("cycles_full")},
+        }
+
+        # Gradient-bucket shape: one training step = 32 long-named
+        # async ops negotiated together. Uncached request lists scale
+        # with tensors x name length; the cached bit vector doesn't.
+        bucket_env = {"HVD_TPU_BENCH_TENSORS": "32"}
+        biters = max(10, iters // 4)
+        try:
+            _, cb_ctr = _run_negotiation_bench(n, biters, bucket_env)
+            _, ub_ctr = _run_negotiation_bench(
+                n, max(5, biters // 2),
+                dict(bucket_env, HVD_TPU_CACHE_CAPACITY="0"))
+            entry["bucket32_cached_bytes_per_step_coord"] = \
+                per_step(cb_ctr, 0)
+            entry["bucket32_uncached_bytes_per_step_coord"] = \
+                per_step(ub_ctr, 0)
+            entry["bucket32_cached_bytes_per_step_worker"] = \
+                per_step(cb_ctr, 1)
+            entry["bucket32_uncached_bytes_per_step_worker"] = \
+                per_step(ub_ctr, 1)
+        except (RuntimeError, subprocess.TimeoutExpired) as e:
+            entry["bucket32_error"] = str(e)[:300]
+
+        negotiation.append(entry)
+        print("negotiation n=%d: cached %.0f us/op (%s B/op coord), "
+              "uncached %.0f us/op (%s B/op coord); bucket32 %s vs %s "
+              "B/step coord"
+              % (n, cached, entry["cached_bytes_per_op_coord"],
+                 uncached, entry["uncached_bytes_per_op_coord"],
+                 entry.get("bucket32_cached_bytes_per_step_coord"),
+                 entry.get("bucket32_uncached_bytes_per_step_coord")),
+              file=sys.stderr)
 
     out = {
         "metric": "scaling_evidence",
@@ -390,8 +447,8 @@ def main():
     ap.add_argument("--num-iters", type=int, default=10)
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet50", "resnet50gn", "resnet50nf",
-                             "resnet101", "resnet152", "vgg16",
-                             "inception3", "transformer"],
+                             "resnet50pbn", "resnet101", "resnet152",
+                             "vgg16", "inception3", "transformer"],
                     help="vgg16/inception3 are the other models in the "
                          "reference's published scaling table "
                          "(docs/benchmarks.rst:13-14); use "
@@ -512,6 +569,7 @@ def main():
         model_cls = {"resnet50": models.ResNet50,
                      "resnet50gn": models.ResNet50GN,
                      "resnet50nf": models.ResNet50NF,
+                     "resnet50pbn": models.ResNet50PBN,
                      "resnet101": models.ResNet101,
                      "resnet152": models.ResNet152,
                      "vgg16": models.VGG16,

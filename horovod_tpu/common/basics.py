@@ -134,6 +134,11 @@ class HorovodBasics:
         lib.horovod_tpu_perf_counters.argtypes = [
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
         lib.horovod_tpu_effective_fusion_threshold.restype = ctypes.c_int64
+        lib.horovod_tpu_protocol_counters.restype = None
+        lib.horovod_tpu_protocol_counters.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.horovod_tpu_protocol_counters_reset.restype = None
+        lib.horovod_tpu_protocol_counters_reset.argtypes = []
         lib.horovod_tpu_autotune_params.restype = None
         lib.horovod_tpu_autotune_params.argtypes = [
             ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
@@ -167,6 +172,27 @@ class HorovodBasics:
         """The controller's working fusion threshold in bytes, after
         hierarchical divisibility rounding; -1 before init."""
         return self.lib.horovod_tpu_effective_fusion_threshold()
+
+    def protocol_counters(self):
+        """Control-plane negotiation accounting for THIS rank: dict of
+        ctrl_bytes_sent / ctrl_bytes_recv (12-byte frame headers
+        included, data-plane ring traffic excluded), ctrl_msgs, and
+        cycles_fast / cycles_full — both counting WORK cycles only
+        (idle heartbeat cycles are excluded from cycle counts, but
+        their control bytes DO accrue with wall time; keep cycle
+        pacing at its default when byte-per-op numbers matter)."""
+        out = (ctypes.c_uint64 * 5)()
+        self.lib.horovod_tpu_protocol_counters(out)
+        return {
+            "ctrl_bytes_sent": out[0],
+            "ctrl_bytes_recv": out[1],
+            "ctrl_msgs": out[2],
+            "cycles_fast": out[3],
+            "cycles_full": out[4],
+        }
+
+    def protocol_counters_reset(self):
+        self.lib.horovod_tpu_protocol_counters_reset()
 
     def autotune_params(self):
         """Current synchronized knob values (autotune introspection):

@@ -102,6 +102,14 @@ class ResNet(nn.Module):
         elif self.norm == "group":
             norm = partial(nn.GroupNorm, num_groups=32, epsilon=1e-5,
                            dtype=self.dtype, param_dtype=jnp.float32)
+        elif self.norm == "pallas":
+            # Fused Pallas BN statistics (ops/batch_norm.py): one
+            # bf16-read f32-accumulate kernel per stats pass, attacking
+            # the convert_reduce_fusion HBM share in PERF.md.
+            from horovod_tpu.ops.batch_norm import PallasBatchNorm
+            norm = partial(PallasBatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                           param_dtype=jnp.float32)
         else:
             norm = partial(nn.BatchNorm, use_running_average=not train,
                            momentum=0.9, epsilon=1e-5, dtype=self.dtype,
@@ -134,5 +142,7 @@ ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3],
                     block_cls=BottleneckBlock)
 ResNet50GN = partial(ResNet, stage_sizes=[3, 4, 6, 3],
                      block_cls=BottleneckBlock, norm="group")
+ResNet50PBN = partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                      block_cls=BottleneckBlock, norm="pallas")
 ResNet50NF = partial(ResNet, stage_sizes=[3, 4, 6, 3],
                      block_cls=BottleneckBlock, norm="none")

@@ -222,6 +222,13 @@ void Controller::FuseResponses(std::deque<Response>& responses,
 ResponseList Controller::FinishCycle(std::deque<Response> responses,
                                      std::vector<Request>& non_cached_messages,
                                      bool should_shut_down) {
+  // Counted below only when the cycle carried work: idle empty-queue
+  // cycles also pass through here (the round trip still happens as the
+  // readiness heartbeat), and counting them would make the fast/full
+  // split report pacing, not workload (cycles_fast_ likewise counts
+  // only op-carrying fast cycles).
+  const bool had_local_work = !responses.empty() ||
+                              !non_cached_messages.empty();
   ResponseList response_list;
   if (is_coordinator()) {
     std::vector<std::string> ready_names;
@@ -268,6 +275,12 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
     if (!response_list.ParseFrom(response_blob.data(), response_blob.size())) {
       LOG(FATAL) << "Failed to parse ResponseList from coordinator";
     }
+  }
+  // Work on ANY rank makes this a full work cycle (the final list is
+  // identical everywhere; a worker whose own queue was empty still
+  // executed a real negotiation for the ranks that had work).
+  if (had_local_work || !response_list.responses().empty()) {
+    cycles_full_ += 1;
   }
   return response_list;
 }
@@ -366,6 +379,7 @@ ResponseList Controller::ComputeResponseList(
   if (cache_on && all_cached) {
     // Fast path: everything queued this cycle was globally cached; no
     // coordinator round trip. Every rank builds the identical list locally.
+    if (!cached_responses.empty()) cycles_fast_ += 1;
     ResponseList response_list;
     response_list.set_shutdown(should_shut_down);
     FuseResponses(cached_responses, response_list);

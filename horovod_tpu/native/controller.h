@@ -20,6 +20,7 @@
 #ifndef HVD_TPU_CONTROLLER_H
 #define HVD_TPU_CONTROLLER_H
 
+#include <atomic>
 #include <cstdint>
 #include <deque>
 #include <memory>
@@ -69,6 +70,17 @@ class Controller {
 
   StallInspector& stall_inspector() { return stall_inspector_; }
 
+  // --- negotiation-cycle accounting (fast path vs full round trip) ---
+  // fast  = all-cached cycles that produced work from the bit-vector
+  //         sync alone (no coordinator round trip);
+  // full  = FinishCycle round trips (request gather + response bcast).
+  uint64_t cycles_fast() const { return cycles_fast_.load(); }
+  uint64_t cycles_full() const { return cycles_full_.load(); }
+  void ResetCycleCounters() {
+    cycles_fast_.store(0);
+    cycles_full_.store(0);
+  }
+
   // --- cross-rank primitives, implemented per transport ---
   // Gathers every rank's serialized blob at rank 0 (out: indexed by rank).
   virtual void GatherBlobs(const std::string& mine,
@@ -114,6 +126,9 @@ class Controller {
   Timeline& timeline_;
   ParameterManager& parameter_manager_;
   StallInspector stall_inspector_;
+
+  std::atomic<uint64_t> cycles_fast_{0};
+  std::atomic<uint64_t> cycles_full_{0};
 
   uint32_t cache_capacity_ = 1024;
 };

@@ -463,6 +463,28 @@ int64_t horovod_tpu_effective_fusion_threshold() {
              : -1;
 }
 
+// Protocol-level negotiation accounting: control-star bytes/messages
+// this rank moved (12-byte frame headers included; data-plane ring
+// traffic excluded; idle heartbeat cycles contribute bytes but not
+// cycle counts) and work-cycle counts by kind. Measures the quantity
+// the response cache exists to shrink — negotiation traffic —
+// directly (reference design: response_cache.cc:308-409).
+// out[0]=ctrl_bytes_sent out[1]=ctrl_bytes_recv out[2]=ctrl_msgs
+// out[3]=cycles_fast     out[4]=cycles_full
+void horovod_tpu_protocol_counters(uint64_t* out) {
+  if (!out) return;
+  out[0] = g_state.tcp_context.ctrl_bytes_sent();
+  out[1] = g_state.tcp_context.ctrl_bytes_recv();
+  out[2] = g_state.tcp_context.ctrl_msgs();
+  out[3] = g_state.controller ? g_state.controller->cycles_fast() : 0;
+  out[4] = g_state.controller ? g_state.controller->cycles_full() : 0;
+}
+
+void horovod_tpu_protocol_counters_reset() {
+  g_state.tcp_context.ResetProtocolCounters();
+  if (g_state.controller) g_state.controller->ResetCycleCounters();
+}
+
 // BayesianOptimizer handle API: unit-tests the autotune math from
 // Python (not part of the training path).
 void* horovod_tpu_bo_create(double lo0, double hi0, double lo1, double hi1,

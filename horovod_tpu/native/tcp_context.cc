@@ -428,6 +428,9 @@ bool TcpContext::MultiSendFrames(
   return true;
 }
 
+// Control frames are 12 bytes of header (4 tag + 8 length) + payload.
+static constexpr uint64_t kFrameHeaderBytes = 12;
+
 bool TcpContext::GatherBlobs(const std::string& mine,
                              std::vector<std::string>* all) {
   if (size_ == 1) {
@@ -439,9 +442,17 @@ bool TcpContext::GatherBlobs(const std::string& mine,
   if (rank_ == 0) {
     all->assign(size_, std::string());
     (*all)[0] = mine;
-    return MultiRecvFrames(kTagGather, all);
+    if (!MultiRecvFrames(kTagGather, all)) return false;
+    uint64_t recvd = 0;
+    for (int r = 1; r < size_; ++r) recvd += (*all)[r].size();
+    ctrl_bytes_recv_ += recvd + kFrameHeaderBytes * (size_ - 1);
+    ctrl_msgs_ += size_ - 1;
+    return true;
   }
-  return control_conns_[0].SendFrame(kTagGather, mine);
+  if (!control_conns_[0].SendFrame(kTagGather, mine)) return false;
+  ctrl_bytes_sent_ += mine.size() + kFrameHeaderBytes;
+  ctrl_msgs_ += 1;
+  return true;
 }
 
 bool TcpContext::BroadcastBlob(std::string* blob) {
@@ -450,10 +461,19 @@ bool TcpContext::BroadcastBlob(std::string* blob) {
     std::vector<std::pair<const void*, std::size_t>> payloads(
         static_cast<std::size_t>(size_ - 1),
         {blob->data(), blob->size()});
-    return MultiSendFrames(kTagBcast, payloads);
+    if (!MultiSendFrames(kTagBcast, payloads)) return false;
+    ctrl_bytes_sent_ +=
+        (blob->size() + kFrameHeaderBytes) * uint64_t(size_ - 1);
+    ctrl_msgs_ += size_ - 1;
+    return true;
   }
   uint32_t tag;
-  return control_conns_[0].RecvFrame(&tag, blob) && tag == kTagBcast;
+  if (!(control_conns_[0].RecvFrame(&tag, blob) && tag == kTagBcast)) {
+    return false;
+  }
+  ctrl_bytes_recv_ += blob->size() + kFrameHeaderBytes;
+  ctrl_msgs_ += 1;
+  return true;
 }
 
 bool TcpContext::BitwiseSync(std::vector<uint64_t>& bits, bool is_or) {
@@ -475,12 +495,22 @@ bool TcpContext::BitwiseSync(std::vector<uint64_t>& bits, bool is_or) {
     }
     std::vector<std::pair<const void*, std::size_t>> payloads(
         static_cast<std::size_t>(size_ - 1), {bits.data(), nbytes});
-    return MultiSendFrames(kTagBits, payloads);
+    if (!MultiSendFrames(kTagBits, payloads)) return false;
+    ctrl_bytes_recv_ += (nbytes + kFrameHeaderBytes) * uint64_t(size_ - 1);
+    ctrl_bytes_sent_ += (nbytes + kFrameHeaderBytes) * uint64_t(size_ - 1);
+    ctrl_msgs_ += 2 * uint64_t(size_ - 1);
+    return true;
   }
   uint32_t tag;
-  return control_conns_[0].SendFrame(kTagBits, bits.data(), nbytes) &&
-         control_conns_[0].RecvFrameInto(&tag, bits.data(), nbytes) &&
-         tag == kTagBits;
+  if (!(control_conns_[0].SendFrame(kTagBits, bits.data(), nbytes) &&
+        control_conns_[0].RecvFrameInto(&tag, bits.data(), nbytes) &&
+        tag == kTagBits)) {
+    return false;
+  }
+  ctrl_bytes_sent_ += nbytes + kFrameHeaderBytes;
+  ctrl_bytes_recv_ += nbytes + kFrameHeaderBytes;
+  ctrl_msgs_ += 2;
+  return true;
 }
 
 bool TcpContext::Barrier() {

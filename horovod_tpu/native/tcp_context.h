@@ -84,6 +84,23 @@ class TcpContext {
   // Root passes its source in `buf` too.
   bool RingBroadcast(void* buf, std::size_t len, int root);
 
+  // --- control-plane protocol accounting ---
+  // Bytes/messages THIS rank moved on the control star (12-byte frame
+  // headers included; data-ring traffic is not counted — these isolate
+  // the NEGOTIATION cost, the quantity the response-cache fast path
+  // exists to shrink; reference design goal: response_cache.cc:308-409).
+  // Idle heartbeat cycles also send control frames, so bytes accrue
+  // with wall time when cycle pacing is zeroed.
+  // Written by the background thread, read from the C API.
+  uint64_t ctrl_bytes_sent() const { return ctrl_bytes_sent_.load(); }
+  uint64_t ctrl_bytes_recv() const { return ctrl_bytes_recv_.load(); }
+  uint64_t ctrl_msgs() const { return ctrl_msgs_.load(); }
+  void ResetProtocolCounters() {
+    ctrl_bytes_sent_.store(0);
+    ctrl_bytes_recv_.store(0);
+    ctrl_msgs_.store(0);
+  }
+
  private:
   bool ExchangeTopology();
   bool ConnectSubRings(int timeout_ms);
@@ -102,6 +119,10 @@ class TcpContext {
   int cross_size_ = 1;
   bool is_homogeneous_ = false;
   bool initialized_ = false;
+
+  std::atomic<uint64_t> ctrl_bytes_sent_{0};
+  std::atomic<uint64_t> ctrl_bytes_recv_{0};
+  std::atomic<uint64_t> ctrl_msgs_{0};
 
   // rank_grid_[cross_rank * local_size + local_rank] = global rank.
   std::vector<int> rank_grid_;

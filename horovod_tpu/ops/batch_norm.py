@@ -1,0 +1,221 @@
+"""Fused Pallas BatchNorm statistics for TPU.
+
+Attacks the PERF.md profile's biggest non-conv line
+(`convert_reduce_fusion`, ~29 ms/step on ResNet-50 batch 256): the BN
+statistics passes. Both reductions the op needs —
+
+* forward: per-channel sum and sum-of-squares of the activation, and
+* backward: per-channel sum(dy) and sum(dy * x_hat)
+
+— are computed by ONE Pallas kernel each: a single bf16 read of the
+activation block, f32 accumulation in registers, both reductions of the
+pair emitted together (XLA's lowering builds convert+reduce fusions per
+reduction). The normalize / dx elementwise math stays in XLA on purpose:
+there it fuses into neighboring producers/consumers (residual adds, ReLU
+masks — the `multiply_add_fusion` lines), which a Pallas island cannot.
+
+The reference delegates BN to cuDNN (no analogue source); this is the
+TPU-native equivalent of its fused-BN dependence. Correctness is pinned
+against `flax.linen.BatchNorm` in tests (interpret mode on CPU); v5e
+measurement via `bench.py --model resnet50pbn`.
+
+Layout contract: activations reshaped to (M, C), stats over axis 0.
+M must be divisible by the block size (the caller picks the largest
+power-of-two divisor <= 1024; if that is < 8 the plain XLA path is used
+— tiny inputs don't carry the bottleneck).
+"""
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _pick_bm(M, cap=1024):
+    bm = 1
+    while bm * 2 <= cap and M % (bm * 2) == 0:
+        bm *= 2
+    return bm
+
+
+def _stats_kernel(x_ref, out_ref):
+    i = pl.program_id(0)
+    xb = x_ref[...].astype(jnp.float32)
+    blk = jnp.stack([jnp.sum(xb, axis=0), jnp.sum(xb * xb, axis=0)])
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = blk
+
+    @pl.when(i > 0)
+    def _():
+        out_ref[...] = out_ref[...] + blk
+
+
+def batch_norm_stats(x2d, interpret=False, block_m=None):
+    """Per-channel (sum, sum_of_squares) of a (M, C) array in one
+    bf16-read f32-accumulate pass. Returns two (C,) f32 arrays."""
+    M, C = x2d.shape
+    bm = block_m or _pick_bm(M)
+    out = pl.pallas_call(
+        _stats_kernel,
+        grid=(M // bm,),
+        in_specs=[pl.BlockSpec((bm, C), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((2, C), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, C), jnp.float32),
+        interpret=interpret,
+    )(x2d)
+    return out[0], out[1]
+
+
+def _grad_stats_kernel(dy_ref, x_ref, mean_ref, rstd_ref, out_ref):
+    i = pl.program_id(0)
+    dy = dy_ref[...].astype(jnp.float32)
+    xb = x_ref[...].astype(jnp.float32)
+    xhat = (xb - mean_ref[...]) * rstd_ref[...]
+    blk = jnp.stack([jnp.sum(dy, axis=0), jnp.sum(dy * xhat, axis=0)])
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = blk
+
+    @pl.when(i > 0)
+    def _():
+        out_ref[...] = out_ref[...] + blk
+
+
+def batch_norm_grad_stats(dy2d, x2d, mean, rstd, interpret=False,
+                          block_m=None):
+    """Per-channel (sum(dy), sum(dy * x_hat)) — i.e. (d_beta, d_gamma)
+    — in one fused read of dy and x. mean/rstd are (C,) f32."""
+    M, C = x2d.shape
+    bm = block_m or _pick_bm(M)
+    out = pl.pallas_call(
+        _grad_stats_kernel,
+        grid=(M // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, C), lambda i: (i, 0)),
+            pl.BlockSpec((bm, C), lambda i: (i, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, C), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, C), jnp.float32),
+        interpret=interpret,
+    )(dy2d, x2d, mean.reshape(1, C), rstd.reshape(1, C))
+    return out[0], out[1]
+
+
+def _use_kernel(M):
+    return _pick_bm(M) >= 8
+
+
+def _stats(x2d, interpret):
+    M, _ = x2d.shape
+    if interpret is not None and _use_kernel(M):
+        s, ss = batch_norm_stats(x2d, interpret)
+    else:
+        xf = x2d.astype(jnp.float32)
+        s, ss = jnp.sum(xf, axis=0), jnp.sum(xf * xf, axis=0)
+    return s, ss
+
+
+def _bn_train_fwd(x2d, gamma, beta, eps, interpret):
+    M, C = x2d.shape
+    s, ss = _stats(x2d, interpret)
+    mean = s / M
+    var = jnp.maximum(ss / M - mean * mean, 0.0)
+    rstd = jax.lax.rsqrt(var + eps)
+    a = gamma * rstd
+    b = beta - mean * a
+    # Normalize stays in XLA: it fuses with neighbors (residual/ReLU).
+    y = (x2d.astype(jnp.float32) * a + b).astype(x2d.dtype)
+    return (y, mean, var), (x2d, gamma, mean, rstd)
+
+
+def _bn_train_bwd(eps, interpret, res, cotangents):
+    gy, gmean, gvar = cotangents
+    x2d, gamma, mean, rstd = res
+    M, C = x2d.shape
+    gyf = gy.astype(jnp.float32) if gy.dtype != jnp.float32 else gy
+    xf = x2d.astype(jnp.float32)
+    xhat = (xf - mean) * rstd
+    if interpret is not None and _use_kernel(M):
+        dbeta, dgamma = batch_norm_grad_stats(gy, x2d, mean, rstd,
+                                              interpret)
+    else:
+        dbeta = jnp.sum(gyf, axis=0)
+        dgamma = jnp.sum(gyf * xhat, axis=0)
+    dx = (gamma * rstd) * (gyf - dbeta / M - xhat * (dgamma / M))
+    # Direct mean/var cotangent terms (zero in training use — running
+    # stats aren't differentiated — and XLA folds the add-zeros away;
+    # kept exact so jax.grad through mean/var is still correct).
+    dx = dx + gmean / M + gvar * (2.0 / M) * (xf - mean)
+    return dx.astype(x2d.dtype), dgamma, dbeta
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_batch_norm_train(x2d, gamma, beta, eps=1e-5, interpret=False):
+    """Training-mode BN over (M, C): returns (y, mean, var) with the
+    Pallas stats kernels on both the forward and the VJP path. mean /
+    var are f32 batch statistics for the caller's running-stats
+    update."""
+    return _bn_train_fwd(x2d, gamma, beta, eps, interpret)[0]
+
+
+def _bn_train_vjp_fwd(x2d, gamma, beta, eps, interpret):
+    return _bn_train_fwd(x2d, gamma, beta, eps, interpret)
+
+
+fused_batch_norm_train.defvjp(_bn_train_vjp_fwd, _bn_train_bwd)
+
+
+try:
+    import flax.linen as nn
+
+    class PallasBatchNorm(nn.Module):
+        """Drop-in for `nn.BatchNorm` (the subset ResNet uses) with the
+        fused Pallas statistics path in training mode. Eval mode (
+        `use_running_average=True`) is pure elementwise math and stays
+        in XLA entirely."""
+        use_running_average: bool = False
+        momentum: float = 0.9
+        epsilon: float = 1e-5
+        dtype: Any = None
+        param_dtype: Any = jnp.float32
+        scale_init: Callable = nn.initializers.ones
+        bias_init: Callable = nn.initializers.zeros
+        axis_name: str = None  # API parity; cross-replica BN unsupported
+        interpret: bool = False
+
+        @nn.compact
+        def __call__(self, x):
+            C = x.shape[-1]
+            scale = self.param("scale", self.scale_init, (C,),
+                               self.param_dtype)
+            bias = self.param("bias", self.bias_init, (C,),
+                              self.param_dtype)
+            ra_mean = self.variable("batch_stats", "mean",
+                                    lambda: jnp.zeros(C, jnp.float32))
+            ra_var = self.variable("batch_stats", "var",
+                                   lambda: jnp.ones(C, jnp.float32))
+            if self.use_running_average:
+                a = scale * jax.lax.rsqrt(ra_var.value + self.epsilon)
+                b = bias - ra_mean.value * a
+                return (x.astype(jnp.float32) * a + b).astype(
+                    self.dtype or x.dtype)
+            x2d = x.reshape(-1, C)
+            interpret = self.interpret
+            if jax.default_backend() != "tpu" and not interpret:
+                interpret = None  # plain-XLA fallback off-TPU
+            y, mean, var = fused_batch_norm_train(
+                x2d, scale, bias, self.epsilon, interpret)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+            return y.reshape(x.shape).astype(self.dtype or x.dtype)
+except ImportError:  # pragma: no cover - flax is baked into this env
+    PallasBatchNorm = None

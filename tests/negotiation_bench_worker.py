@@ -4,6 +4,7 @@ allreduces, whose cost is dominated by the per-cycle coordinator negotiation
 HVD_TPU_CYCLE_TIME=0 so the cycle pacing sleep doesn't mask the control
 plane. Prints `NEGOTIATION_US_PER_OP <us>` on rank 0."""
 
+import json
 import os
 import sys
 import time
@@ -11,24 +12,51 @@ import time
 import numpy as np
 
 import horovod_tpu as hvd
+from horovod_tpu.common.basics import get_basics
 
 
 def main():
     hvd.init()
     r = hvd.rank()
+    basics = get_basics()
     # Zero-element tensor: the negotiation/cycle machinery runs in full but
     # the ring data phase is skipped, isolating control-plane latency (a
     # payload allreduce would add the ring's inherent Theta(n) hop latency).
     x = np.zeros(0, dtype=np.float32)
     iters = int(os.environ.get("HVD_TPU_BENCH_ITERS", "200"))
+    # HVD_TPU_BENCH_TENSORS > 1 simulates one training step's gradient
+    # bucket: k async ops with realistic long names negotiated together.
+    # Uncached negotiation traffic scales with k x name length; the
+    # cached bit vector doesn't — the fast path's actual win.
+    k = int(os.environ.get("HVD_TPU_BENCH_TENSORS", "1"))
+    if k > 1:
+        names = ["nb.layer%03d.weight_gradient_accumulator" % i
+                 for i in range(k)]
+    else:
+        names = ["nb"]
+    from horovod_tpu.common import ops
+
+    def step():
+        handles = [ops.allreduce_async(x, nm) for nm in names]
+        for h in handles:
+            ops.synchronize(h)
+
     for i in range(20):  # warmup; also populates the response cache
-        hvd.allreduce(x, "nb")
+        step()
+    basics.protocol_counters_reset()
     t0 = time.perf_counter()
     for i in range(iters):
-        hvd.allreduce(x, "nb")
+        step()
     dt = time.perf_counter() - t0
+    counters = basics.protocol_counters()
+    counters.update(rank=r, iters=iters, tensors_per_step=k)
+    # Ranks 0 (coordinator, O(n) traffic) and 1 (representative worker,
+    # O(1) traffic) carry the protocol-cost evidence.
+    if r <= 1:
+        print("PROTOCOL_COUNTERS %s" % json.dumps(counters))
     if r == 0:
-        print("NEGOTIATION_US_PER_OP %.1f" % (dt / iters * 1e6))
+        # Per OP also in bucket mode (k ops ride each step).
+        print("NEGOTIATION_US_PER_OP %.1f" % (dt / (iters * k) * 1e6))
     print("rank %d done" % r)
     return 0
 

@@ -1,0 +1,144 @@
+"""Fused Pallas BatchNorm correctness, pinned against flax BatchNorm
+(interpret mode on CPU; the kernels themselves run on v5e via
+`bench.py --model resnet50pbn`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.batch_norm import (PallasBatchNorm, batch_norm_stats,
+                                        batch_norm_grad_stats,
+                                        fused_batch_norm_train)
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def test_stats_kernel_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 192).astype(np.float32)
+    s, ss = batch_norm_stats(jnp.asarray(x), interpret=True)
+    np.testing.assert_allclose(np.asarray(s), x.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ss), (x * x).sum(0), rtol=1e-5)
+
+
+def test_stats_kernel_bf16_read_f32_accumulate():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2048, 128).astype(np.float32)
+    xb = jnp.asarray(x).astype(jnp.bfloat16)
+    s, ss = batch_norm_stats(xb, interpret=True)
+    assert s.dtype == jnp.float32
+    # Accumulation error must be f32-like (bf16 inputs, not bf16 sums).
+    ref = np.asarray(xb.astype(jnp.float32)).sum(0)
+    np.testing.assert_allclose(np.asarray(s), ref, rtol=1e-5, atol=1e-3)
+
+
+def test_grad_stats_kernel_matches_numpy():
+    rng = np.random.RandomState(2)
+    x = rng.randn(256, 64).astype(np.float32)
+    dy = rng.randn(256, 64).astype(np.float32)
+    mean = x.mean(0)
+    rstd = 1.0 / np.sqrt(x.var(0) + 1e-5)
+    dbeta, dgamma = batch_norm_grad_stats(
+        jnp.asarray(dy), jnp.asarray(x), jnp.asarray(mean),
+        jnp.asarray(rstd), interpret=True)
+    xhat = (x - mean) * rstd
+    np.testing.assert_allclose(np.asarray(dbeta), dy.sum(0), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(dgamma), (dy * xhat).sum(0),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("M,C", [(512, 128), (392, 64)])
+def test_fused_bn_train_matches_flax(M, C):
+    """Forward outputs, batch stats, AND gradients (x, gamma, beta)
+    must match flax.linen.BatchNorm in training mode. M=392 = 8*49
+    exercises the small-power-of-two block path."""
+    import flax.linen as nn
+
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(M, C).astype(np.float32)) * 2.0 + 0.5
+    gamma = jnp.asarray(rng.rand(C).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(C).astype(np.float32))
+
+    bn = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                      epsilon=1e-5)
+    variables = {"params": {"scale": gamma, "bias": beta},
+                 "batch_stats": {"mean": jnp.zeros(C),
+                                 "var": jnp.ones(C)}}
+
+    def flax_loss(x, gamma, beta):
+        v = {"params": {"scale": gamma, "bias": beta},
+             "batch_stats": variables["batch_stats"]}
+        y, _ = bn.apply(v, x, mutable=["batch_stats"])
+        return jnp.sum(y ** 2), y
+
+    def fused_loss(x, gamma, beta):
+        y, mean, var = fused_batch_norm_train(x, gamma, beta, 1e-5, True)
+        return jnp.sum(y.astype(jnp.float32) ** 2), (y, mean, var)
+
+    (l1, y1), g1 = jax.value_and_grad(flax_loss, argnums=(0, 1, 2),
+                                      has_aux=True)(x, gamma, beta)
+    (l2, (y2, mean, var)), g2 = jax.value_and_grad(
+        fused_loss, argnums=(0, 1, 2), has_aux=True)(x, gamma, beta)
+
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y1),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(x).mean(0),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var),
+                               np.asarray(x).var(0), rtol=1e-4, atol=1e-4)
+    for a, b, nm in zip(g2, g1, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3, err_msg=nm)
+
+
+def test_pallas_bn_module_train_eval_roundtrip():
+    """The flax module: training updates running stats like
+    nn.BatchNorm; eval mode uses them identically."""
+    import flax.linen as nn
+
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(4, 8, 8, 32).astype(np.float32))
+
+    ours_t = PallasBatchNorm(use_running_average=False, momentum=0.9,
+                             epsilon=1e-5, interpret=True)
+    flax_t = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                          epsilon=1e-5)
+    v0 = flax_t.init(jax.random.PRNGKey(0), x)
+    y_f, upd_f = flax_t.apply(v0, x, mutable=["batch_stats"])
+    y_o, upd_o = ours_t.apply(v0, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y_o), np.asarray(y_f),
+                               rtol=2e-4, atol=2e-4)
+    for k in ("mean", "var"):
+        np.testing.assert_allclose(
+            np.asarray(upd_o["batch_stats"][k]),
+            np.asarray(upd_f["batch_stats"][k]), rtol=1e-4, atol=1e-5)
+
+    ours_e = PallasBatchNorm(use_running_average=True, epsilon=1e-5)
+    flax_e = nn.BatchNorm(use_running_average=True, epsilon=1e-5)
+    v1 = {"params": v0["params"], "batch_stats": upd_f["batch_stats"]}
+    np.testing.assert_allclose(
+        np.asarray(ours_e.apply(v1, x)),
+        np.asarray(flax_e.apply(v1, x)), rtol=2e-4, atol=2e-4)
+
+
+def test_resnet_pallas_variant_one_step():
+    """ResNet50PBN: one train step runs, loss finite, batch_stats
+    update present (CPU falls back to the plain-XLA stats path via the
+    same fused_batch_norm_train custom-VJP)."""
+    from horovod_tpu.models import ResNet50PBN
+
+    model = ResNet50PBN(num_classes=10, dtype=jnp.float32)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+
+    def loss_fn(params):
+        logits, upd = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"])
+        return jnp.mean(logits ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(variables["params"])
+    assert np.isfinite(float(loss))
+    flat = jax.tree_util.tree_leaves(grads)
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)

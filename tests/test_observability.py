@@ -41,3 +41,51 @@ def test_stall_detection_and_shutdown(run_launcher):
     assert "rank 1 exited cleanly" in out, out
     # Coordinator must have warned about the missing rank.
     assert "missing ranks: 1" in out, out
+
+
+def test_protocol_counters_cache_fast_path(run_launcher):
+    """The response cache's PROTOCOL-LEVEL win (SURVEY 7.3 / reference
+    response_cache.cc:308-409): with the cache on, steady-state cycles
+    are bit-vector-only (cycles_fast dominates, bytes/op small and
+    name-independent); with it off, every cycle is a full coordinator
+    round trip carrying serialized request lists."""
+    import json
+
+    def counters_from(proc):
+        out = {}
+        for line in proc.stdout.splitlines():
+            if line.startswith("COUNTERS "):
+                d = json.loads(line[len("COUNTERS "):])
+                out[d["rank"]] = d
+        return out
+
+    cached = run_launcher(2, "protocol_counters_worker.py")
+    assert cached.returncode == 0, cached.stdout + cached.stderr
+    uncached = run_launcher(2, "protocol_counters_worker.py",
+                            extra_env={"HVD_TPU_CACHE_CAPACITY": "0"})
+    assert uncached.returncode == 0, uncached.stdout + uncached.stderr
+    c = counters_from(cached)
+    u = counters_from(uncached)
+    assert set(c) == {0, 1} and set(u) == {0, 1}, (c, u)
+
+    # Cached steady state: every op-carrying cycle rode the fast path
+    # (cycles_full counts only WORK cycles — idle heartbeat round
+    # trips are excluded by the controller — so any full work cycle
+    # here would mean the cache regressed).
+    for r in (0, 1):
+        assert c[r]["cycles_fast"] > 0, c
+        assert c[r]["cycles_full"] == 0, c
+        # Uncached: zero fast cycles, every work cycle a round trip.
+        assert u[r]["cycles_fast"] == 0, u
+        assert u[r]["cycles_full"] >= 1, u
+
+    # The protocol claim: per-op control bytes with the cache are a
+    # small fraction of without (bit vector vs serialized RequestList
+    # with a long tensor name + frame headers both directions).
+    for r in (0, 1):
+        per_op_cached = (c[r]["ctrl_bytes_sent"] +
+                         c[r]["ctrl_bytes_recv"]) / c[r]["ops"]
+        per_op_uncached = (u[r]["ctrl_bytes_sent"] +
+                           u[r]["ctrl_bytes_recv"]) / u[r]["ops"]
+        assert per_op_cached < per_op_uncached / 2, \
+            (r, per_op_cached, per_op_uncached)
