@@ -132,7 +132,10 @@ def _run_weak_scaling(batch, iters):
     for n in (1, 2, 4, 8):
         res = {}
         for single in (False, True):
-            env = dict(os.environ)
+            from horovod_tpu.run.util import cpu_worker_env
+            env = cpu_worker_env()
+            # Hard platform pin (not just NAME-priority): the mesh MUST
+            # be the virtual CPU devices.
             env["JAX_PLATFORMS"] = "cpu"
             # Appended last: XLA's flag parsing takes the last
             # occurrence, so an inherited device-count flag can't

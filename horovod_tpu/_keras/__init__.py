@@ -12,11 +12,20 @@ import numpy as np
 import horovod_tpu as hvd
 
 
-def create_distributed_optimizer(keras, optimizer, name=None,
-                                 compression=None, average=True):
-    """Dynamically subclasses `optimizer` so apply_gradients first
-    allreduces gradients (reference: _keras/__init__.py:20-80)."""
-    base = optimizer.__class__
+_distributed_class_cache = {}
+
+
+def distributed_optimizer_class(base, compression=None, average=True):
+    """The dynamic `Distributed<Base>` optimizer CLASS — split from
+    instance creation so load_model can hand these to keras
+    deserialization as custom_objects (reference:
+    _keras/__init__.py:107-123 load_model's custom-object wrapping).
+    Cached per (base, compression, average) so repeated load_model
+    calls reuse identical classes."""
+    key = (base, compression, average)
+    cached = _distributed_class_cache.get(key)
+    if cached is not None:
+        return cached
 
     class _DistributedOptimizer(base):
         _HVD_WRAPPED = True
@@ -39,8 +48,18 @@ def create_distributed_optimizer(keras, optimizer, name=None,
 
     cls = type("Distributed%s" % base.__name__, (_DistributedOptimizer,),
                {})
-    opt = cls.from_config(optimizer.get_config())
-    return opt
+    _distributed_class_cache[key] = cls
+    return cls
+
+
+def create_distributed_optimizer(keras, optimizer, name=None,
+                                 compression=None, average=True):
+    """Dynamically subclasses `optimizer` so apply_gradients first
+    allreduces gradients (reference: _keras/__init__.py:20-80)."""
+    cls = distributed_optimizer_class(optimizer.__class__,
+                                      compression=compression,
+                                      average=average)
+    return cls.from_config(optimizer.get_config())
 
 
 def broadcast_model_weights(model, root_rank=0):

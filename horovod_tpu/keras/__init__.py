@@ -32,12 +32,32 @@ def broadcast_model_weights(model, root_rank=0):
     return _impl.broadcast_model_weights(model, root_rank)
 
 
-def load_model(filepath, custom_optimizers=None, custom_objects=None):
-    """Loads a model saved with a wrapped optimizer, re-wrapping it
-    (reference: keras/__init__.py:117, _keras/__init__.py:107-123)."""
-    model = keras.models.load_model(filepath,
-                                    custom_objects=custom_objects or {})
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None):
+    """Loads a model saved with a wrapped optimizer, re-wrapping it —
+    with the given gradient `compression`, matching the save-time
+    configuration (reference: keras/__init__.py:117 `load_model(...,
+    compression)`, _keras/__init__.py:107-123).
+
+    A model saved after `DistributedOptimizer` wrapping serializes its
+    optimizer as the dynamic `Distributed<Base>` class; this supplies
+    those classes to keras deserialization as custom_objects (for every
+    stock keras optimizer plus any `custom_optimizers` bases)."""
+    co = dict(custom_objects or {})
+    bases = list(custom_optimizers or [])
+    for nm in dir(keras.optimizers):
+        cls = getattr(keras.optimizers, nm)
+        if isinstance(cls, type) and \
+                issubclass(cls, keras.optimizers.Optimizer) and \
+                cls is not keras.optimizers.Optimizer:
+            bases.append(cls)
+    for base in bases:
+        co.setdefault("Distributed%s" % base.__name__,
+                      _impl.distributed_optimizer_class(
+                          base, compression=compression))
+    model = keras.models.load_model(filepath, custom_objects=co)
     if hasattr(model, "optimizer") and model.optimizer is not None and \
             not getattr(model.optimizer, "_HVD_WRAPPED", False):
-        model.optimizer = DistributedOptimizer(model.optimizer)
+        model.optimizer = DistributedOptimizer(model.optimizer,
+                                               compression=compression)
     return model

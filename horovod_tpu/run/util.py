@@ -120,3 +120,35 @@ def topology_env(rank, host_ports):
 
 def is_local_host(hostname):
     return hostname in ("localhost", "127.0.0.1", socket.gethostname())
+
+
+def cpu_worker_env(base_env=None, extra_env=None, repo_root=None):
+    """Env for spawning CPU-only worker subprocesses: TPU plugin
+    disengaged, CPU backend pinned, shared jit compile cache. The
+    SINGLE source of truth for this scrub (tests/bench previously
+    carried drifting inline copies):
+
+    * pop ``PALLAS_AXON_POOL_IPS`` — the tunnel TPU plugin registers at
+      interpreter boot whenever it is set and dials its relay in an
+      unbounded retry loop; a dead relay hangs the worker before main()
+      runs (JAX_PLATFORMS=cpu alone does NOT prevent the boot dial);
+    * pop ``JAX_PLATFORMS`` and pin ``JAX_PLATFORM_NAME=cpu`` — the
+      NAME form demotes an (alive) accelerator plugin's default-backend
+      priority without forbidding it, so N workers can't fight over one
+      tunnel chip;
+    * default a persistent compile cache so identical worker jit
+      programs compile once across the fleet.
+    """
+    import os as _os
+    env = dict(base_env if base_env is not None else _os.environ)
+    if repo_root:
+        env["PYTHONPATH"] = repo_root + _os.pathsep + \
+            env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/hvd_tpu_jax_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    if extra_env:
+        env.update(extra_env)
+    return env

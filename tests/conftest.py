@@ -41,23 +41,11 @@ def cpu_devices():
 
 
 def clean_worker_env(extra_env=None):
-    """Env for spawning worker/launcher subprocesses: repo on
-    PYTHONPATH, TPU plugin disengaged, CPU backend pinned, shared
-    compile cache. The single source of truth for the scrub recipe —
-    don't copy it inline (it has drifted before)."""
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("JAX_PLATFORMS", None)
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # workers never need the TPU
-    # JAX_PLATFORM_NAME (not JAX_PLATFORMS) overrides the axon TPU
-    # plugin's default-backend priority — N workers must not all grab
-    # the single tunnel chip.
-    env["JAX_PLATFORM_NAME"] = "cpu"
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/hvd_tpu_jax_cache")
-    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
-    if extra_env:
-        env.update(extra_env)
-    return env
+    """Worker-subprocess env: delegates to the framework's single
+    source of truth (horovod_tpu.run.util.cpu_worker_env), adding the
+    repo root to PYTHONPATH."""
+    from horovod_tpu.run.util import cpu_worker_env
+    return cpu_worker_env(extra_env=extra_env, repo_root=REPO_ROOT)
 
 
 @pytest.fixture

@@ -114,3 +114,23 @@ def test_keras_mnist_advanced_example():
                         "--samples", "256"])
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "done" in proc.stdout
+
+
+def test_tensorflow_mnist_eager_example():
+    """Pure-eager loop (no tf.function): DistributedGradientTape op-by-op
+    + post-first-step variable broadcast (reference
+    examples/tensorflow_mnist_eager.py)."""
+    proc = run_example(2, "tensorflow_mnist_eager.py", ["--steps", "40"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "done" in proc.stdout
+
+
+def test_keras_imagenet_resnet50_example():
+    """The real keras.applications.ResNet50 graph trained data-parallel
+    with warmup+schedule callbacks, fp16 compression, rank-0
+    checkpointing and an hvd.load_model re-wrap assert (reference
+    examples/keras_imagenet_resnet50.py)."""
+    proc = run_example(2, "keras_imagenet_resnet50.py",
+                       ["--fp16-allreduce"], timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "done" in proc.stdout

@@ -36,11 +36,8 @@ def run_hierarchical_workers(script, extra_env=None, timeout=300):
     addrs = ",".join("127.0.0.1:%d" % p for p in ports)
     procs = []
     for r in range(4):
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env.pop("JAX_PLATFORMS", None)
-        env.pop("PALLAS_AXON_POOL_IPS", None)  # workers never need the TPU
-        env["JAX_PLATFORM_NAME"] = "cpu"
+        from horovod_tpu.run.util import cpu_worker_env
+        env = cpu_worker_env(repo_root=REPO)
         env.update({
             "HVD_TPU_RANK": str(r),
             "HVD_TPU_SIZE": "4",
