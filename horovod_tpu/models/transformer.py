@@ -43,7 +43,27 @@ class TransformerConfig:
     # Per-head width; defaults to embed_dim // num_heads. Set
     # explicitly when num_heads is a LOCAL (tp-sharded) count.
     head_dim: Optional[int] = None
+    # Switch-style mixture-of-experts: when moe_experts is set, every
+    # `moe_every`-th block swaps its dense MLP for a MoeMlp
+    # (parallel/expert.py); ep_axis/ep_size shard the expert dim inside
+    # shard_map (tokens should then shard over (dp, ep)). Initialize
+    # with ep_axis=None/ep_size=1 (full shapes), apply with the
+    # ep-sized config — the tp `local()` pattern.
+    moe_experts: Optional[int] = None
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    ep_axis: Optional[str] = None
+    ep_size: int = 1
     dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.moe_experts is not None and self.tp_axis is not None:
+            # The MoE branch neither psums like the dense row-parallel
+            # mlp_out nor shards experts by tp — combining them would
+            # silently diverge activations across tp shards.
+            raise ValueError("moe_experts cannot be combined with "
+                             "tp_axis (MoE blocks are ep-parallel, "
+                             "not tensor-parallel)")
 
     def local(self, tp_size):
         """The per-shard config for `tp_size`-way tensor parallelism."""
@@ -113,6 +133,7 @@ class Attention(nn.Module):
 
 class Block(nn.Module):
     cfg: TransformerConfig
+    moe: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -121,6 +142,13 @@ class Block(nn.Module):
             dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
         x = x + Attention(cfg, name="attn")(norm("norm1")(x), positions)
         h = norm("norm2")(x)
+        if self.moe:
+            from horovod_tpu.parallel.expert import MoeMlp
+            h = MoeMlp(num_experts=cfg.moe_experts, mlp_dim=cfg.mlp_dim,
+                       capacity_factor=cfg.moe_capacity_factor,
+                       ep_axis=cfg.ep_axis, ep_size=cfg.ep_size,
+                       dtype=cfg.dtype, name="moe_mlp")(h)
+            return x + h
         h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, param_dtype=jnp.float32,
                      use_bias=False, name="mlp_in")(h)
         h = nn.silu(h)
@@ -154,7 +182,9 @@ class Transformer(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, param_dtype=jnp.float32,
                      dtype=cfg.dtype, name="embed")(tokens)
         for i in range(cfg.num_layers):
-            x = Block(cfg, name="block_%d" % i)(x, positions)
+            moe = (cfg.moe_experts is not None and
+                   i % cfg.moe_every == cfg.moe_every - 1)
+            x = Block(cfg, moe=moe, name="block_%d" % i)(x, positions)
         x = nn.RMSNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                        name="norm_f")(x)
         if return_hidden:

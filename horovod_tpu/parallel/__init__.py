@@ -23,6 +23,8 @@ from .mesh import (  # noqa: F401
     mesh_axis_size,
     topology_summary,
 )
+from .expert import (  # noqa: F401
+    MoeMlp, ep_grad_sync, ep_param_specs, moe_ffn, switch_dispatch)
 from .pipeline import pipeline_apply, stack_block_params  # noqa: F401
 from .ring import ring_attention, ulysses_attention  # noqa: F401
 from .tensor_parallel import (  # noqa: F401

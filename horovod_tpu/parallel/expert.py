@@ -1,0 +1,203 @@
+"""Expert parallelism: Switch-style top-1 mixture-of-experts with the
+expert dimension sharded over an ``ep`` mesh axis.
+
+The reference framework has no MoE (it is a gradient-reduction library);
+this is the TPU-first ``ep`` member of the parallelism family
+(dp/sp/tp/pp/ep), built the way GShard/Switch map onto XLA:
+
+* **static shapes everywhere** — each expert has a fixed capacity
+  ``C = ceil(T/E * capacity_factor)``; overflow tokens are dropped
+  (their residual path passes through untouched), so the program never
+  depends on routing decisions at compile time;
+* **dispatch/combine as einsums** — routing is a [T, E, C] one-hot
+  tensor contraction (MXU work), not gather/scatter;
+* **all_to_all over ICI** — with ``ep_axis`` set (inside shard_map),
+  expert inputs [E, C, D] are exchanged so each rank runs only its
+  E/ep local experts on every rank's tokens, then exchanged back:
+  ``lax.all_to_all`` split on the expert dim, concat on capacity —
+  the MoE analogue of Ulysses' sequence all-to-all.
+
+Router weights are replicated (every rank routes over all E experts);
+expert FFN weights are sharded [E/ep, ...] along the expert dim
+(PartitionSpec("ep") on axis 0 — see tests/test_expert.py and
+__graft_entry__.dryrun_multichip phase 4).
+"""
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def switch_dispatch(router_logits, capacity):
+    """Top-1 (Switch) routing with a static per-expert capacity.
+
+    router_logits: [T, E] (any float dtype; softmax in f32).
+    Returns (dispatch [T, E, C] f32 one-hot, combine [T, E, C] f32
+    gate-weighted, aux_loss scalar — the Switch load-balancing loss
+    E * sum(frac_tokens_e * mean_prob_e)).
+    """
+    T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                    # [T]
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, E]
+    gate = jnp.sum(probs * onehot, axis=-1)                    # [T]
+    # 0-indexed arrival position of each token in its expert's queue;
+    # one_hot of an index >= capacity (or negative) is all-zero, which
+    # implements the capacity drop with no branching.
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) \
+        .astype(jnp.int32) - 1                                 # [T]
+    dispatch = onehot[:, :, None] * \
+        jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
+    combine = dispatch * gate[:, None, None]
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_capacity(tokens, num_experts, capacity_factor):
+    """Static per-expert capacity (python int)."""
+    return max(1, int(math.ceil(tokens / num_experts * capacity_factor)))
+
+
+def moe_ffn(x, router_w, w_in, w_out, capacity_factor=1.25,
+            ep_axis=None, act=nn.silu):
+    """Switch MoE feed-forward over flattened tokens.
+
+    x: [T, D]; router_w: [D, E] (replicated); w_in: [E_local, D, F],
+    w_out: [E_local, F, D] — E_local = E with ``ep_axis=None``, E/ep
+    inside shard_map with the expert dim sharded.
+
+    Returns (y [T, D] in x.dtype, aux_loss scalar f32).
+    """
+    T, D = x.shape
+    E = router_w.shape[1]
+    ep = 1 if ep_axis is None else lax.axis_size(ep_axis)
+    if w_in.shape[0] * ep != E:
+        raise ValueError(
+            "expert shards (%d local x ep=%d) != num_experts %d" %
+            (w_in.shape[0], ep, E))
+    capacity = moe_capacity(T, E, capacity_factor)
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    dispatch, combine, aux = switch_dispatch(logits, capacity)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    if ep_axis is not None:
+        # [E, C, D] -> [E/ep, ep*C, D]: each rank keeps its local
+        # experts' slots from EVERY rank's tokens.
+        expert_in = lax.all_to_all(expert_in, ep_axis, split_axis=0,
+                                   concat_axis=1, tiled=True)
+    h = act(jnp.einsum("ecd,edf->ecf", expert_in, w_in))
+    out = jnp.einsum("ecf,efd->ecd", h, w_out)
+    if ep_axis is not None:
+        # Reverse exchange: [E/ep, ep*C, D] -> [E, C, D].
+        out = lax.all_to_all(out, ep_axis, split_axis=1,
+                             concat_axis=0, tiled=True)
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), out)
+    return y.astype(x.dtype), aux
+
+
+class MoeMlp(nn.Module):
+    """Drop-in MoE replacement for a transformer MLP: [B, L, D] ->
+    [B, L, D] plus a sown ``intermediates/moe_aux_loss``.
+
+    ``num_experts`` is GLOBAL; ``ep_size`` is the expert-parallel
+    degree the module will be APPLIED under — inside shard_map each
+    rank holds [num_experts/ep_size, ...] expert weights, so the
+    declared param shapes divide by it (the tp path's `cfg.local()`
+    trick). Initialize with ``ep_size=1`` (full shapes), place with
+    `ep_param_specs`, apply with the ep-sized module."""
+    num_experts: int
+    mlp_dim: int
+    capacity_factor: float = 1.25
+    ep_axis: Optional[str] = None
+    ep_size: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        B, L, D = x.shape
+        if self.num_experts % self.ep_size:
+            raise ValueError("ep_size=%d must divide num_experts=%d" %
+                             (self.ep_size, self.num_experts))
+        e_local = self.num_experts // self.ep_size
+        router_w = self.param("router", nn.initializers.normal(0.02),
+                              (D, self.num_experts), jnp.float32)
+        w_in = self.param("w_in", nn.initializers.normal(0.02),
+                          (e_local, D, self.mlp_dim),
+                          jnp.float32)
+        w_out = self.param("w_out", nn.initializers.normal(0.02),
+                           (e_local, self.mlp_dim, D),
+                           jnp.float32)
+        y, aux = moe_ffn(x.reshape(-1, D), router_w,
+                         w_in.astype(self.dtype), w_out.astype(self.dtype),
+                         capacity_factor=self.capacity_factor,
+                         ep_axis=self.ep_axis)
+        self.sow("intermediates", "moe_aux_loss", aux)
+        return y.reshape(B, L, D)
+
+
+def ep_grad_sync(grads, ep_axis="ep", dp_axis=None, average=False):
+    """Synchronizes a raw per-shard gradient tree inside shard_map
+    under expert parallelism.
+
+    Contract: differentiate a LOCAL (un-psummed) loss per rank, then
+    call this. With tokens sharded over (dp x ep), raw gradients are:
+
+    * expert-sharded leaves (param name ``w_in``/``w_out``): already
+      summed along ep (the all_to_all transpose routes every ep peer's
+      cotangents back to the owning rank) — psum over the dp axes only;
+    * replicated leaves (router, norms, ...): this rank's token shard
+      only — psum over dp AND ep.
+
+    ``average=False`` (default) yields the gradient of the SUM of
+    per-rank local losses; ``average=True`` divides by the total shard
+    count (dp x ep), yielding the gradient of their MEAN — use this to
+    match `tensor_parallel.tp_grad_sync`'s dp-averaging convention.
+    `dp_axis` may be a name or tuple of names.
+    """
+    dp_axes = ()
+    if dp_axis is not None:
+        dp_axes = (dp_axis,) if isinstance(dp_axis, str) else tuple(dp_axis)
+    total = 1.0
+    if average:
+        for ax in dp_axes + (ep_axis,):
+            total = total * lax.axis_size(ax)
+
+    def sync(path, g):
+        names = [getattr(k, "key", None) for k in path]
+        axes = list(dp_axes)
+        # Same final-key rule as ep_param_specs — the two halves of
+        # the placement/sync contract must classify leaves identically.
+        if not (names and names[-1] in ("w_in", "w_out")):
+            axes.append(ep_axis)
+        for ax in axes:
+            g = lax.psum(g, ax)
+        if average:
+            g = g / total
+        return g
+
+    return jax.tree_util.tree_map_with_path(sync, grads)
+
+
+def ep_param_specs(params, ep_axis, replicated_spec=None):
+    """PartitionSpecs for a params tree containing MoeMlp leaves:
+    expert-dim sharding for w_in/w_out, replication elsewhere.
+
+    Walks the tree by key name (the MoeMlp param names are the
+    contract), mirroring `tensor_parallel.tp_param_specs`."""
+    from jax.sharding import PartitionSpec as P
+
+    rep = replicated_spec if replicated_spec is not None else P()
+
+    def spec_for(path, leaf):
+        names = [getattr(k, "key", None) for k in path]
+        if names and names[-1] in ("w_in", "w_out"):
+            return P(ep_axis)
+        return rep
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
