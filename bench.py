@@ -261,6 +261,7 @@ _ZOO = [
     ("vgg16", ["--batch-size", "64"]),
     ("inception3", ["--batch-size", "128", "--image-size", "299"]),
     ("transformer", []),
+    ("transformer", ["--moe-experts", "8", "--fused-xent"]),
 ]
 
 
@@ -461,6 +462,10 @@ def main():
                     help="sequence length (transformer model)")
     ap.add_argument("--tokens-batch", type=int, default=8,
                     help="per-chip sequences per step (transformer model)")
+    ap.add_argument("--moe-experts", type=int, default=0,
+                    help="transformer only: >0 swaps every other "
+                         "block's MLP for a Switch-MoE layer with this "
+                         "many experts (parallel/expert.py)")
     ap.add_argument("--fused-xent", action="store_true",
                     help="use the streaming chunked LM cross entropy "
                          "(ops/losses.py) instead of the dense "
@@ -521,10 +526,17 @@ def main():
         # GPT-2-small-shaped causal LM with the Pallas flash-attention
         # kernel — the long-context extension's on-chip evidence (the
         # unit per "image" below is one sequence).
+        moe = {}
+        if args.moe_experts:
+            # Switch-MoE variant (single chip: all experts local, the
+            # dispatch/combine einsums + capacity machinery on the MXU;
+            # the ep all_to_all engages only on multi-chip meshes).
+            moe = dict(moe_experts=args.moe_experts, moe_every=2,
+                       moe_capacity_factor=1.25)
         cfg = models.TransformerConfig(
             vocab_size=32000, num_layers=12, num_heads=12, embed_dim=768,
             mlp_dim=3072, attention="flash", dtype=jnp.bfloat16,
-            max_seq_len=max(8192, args.seq_len))
+            max_seq_len=max(8192, args.seq_len), **moe)
         model = models.Transformer(cfg)
         L = args.seq_len
         global_batch = args.tokens_batch * n
@@ -653,9 +665,12 @@ def main():
             mfu = tflops_per_chip * 1e12 / peak
 
     if args.model == "transformer":
+        label = "transformer"
+        if args.moe_experts:
+            label = "transformer_moe%d" % args.moe_experts
         out = {
-            "metric": "transformer_flash_L%d_sequences_per_sec_per_chip"
-                      % args.seq_len,
+            "metric": "%s_flash_L%d_sequences_per_sec_per_chip"
+                      % (label, args.seq_len),
             "value": round(per_chip, 2),
             "unit": unit,
             "vs_baseline": 0.0,
