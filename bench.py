@@ -22,6 +22,7 @@ import os
 import re
 import socket
 import subprocess
+from functools import partial
 import sys
 import time
 
@@ -452,7 +453,7 @@ def main():
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet50", "resnet50gn", "resnet50nf",
                              "resnet50pbn", "resnet101", "resnet152",
-                             "vgg16", "inception3", "transformer"],
+                             "vgg16", "inception3", "inception3pbn", "transformer"],
                     help="vgg16/inception3 are the other models in the "
                          "reference's published scaling table "
                          "(docs/benchmarks.rst:13-14); use "
@@ -588,7 +589,9 @@ def main():
                      "resnet101": models.ResNet101,
                      "resnet152": models.ResNet152,
                      "vgg16": models.VGG16,
-                     "inception3": models.InceptionV3}[args.model]
+                     "inception3": models.InceptionV3,
+                     "inception3pbn": partial(models.InceptionV3,
+                                              norm="pallas")}[args.model]
         model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
 
         s = args.image_size

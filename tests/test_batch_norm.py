@@ -142,3 +142,25 @@ def test_resnet_pallas_variant_one_step():
     assert np.isfinite(float(loss))
     flat = jax.tree_util.tree_leaves(grads)
     assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+
+
+def test_inception_pallas_variant_one_step():
+    """InceptionV3 with norm='pallas' (the zoo's most BN-bound model):
+    one train step, finite loss and grads."""
+    from horovod_tpu.models import InceptionV3
+
+    model = InceptionV3(norm="pallas", num_classes=10, dtype=jnp.float32)
+    x = jnp.ones((2, 96, 96, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+
+    def loss_fn(params):
+        logits, _ = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"],
+            rngs={"dropout": jax.random.PRNGKey(1)})
+        return jnp.mean(logits ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(variables["params"])
+    assert np.isfinite(float(loss))
+    assert all(np.all(np.isfinite(np.asarray(g)))
+               for g in jax.tree_util.tree_leaves(grads))
