@@ -251,3 +251,49 @@ def test_moe_transformer_train_step_dp_ep():
         np.asarray(new_params["block_1"]["moe_mlp"]["w_in"]) -
         np.asarray(params["block_1"]["moe_mlp"]["w_in"])).max()
     assert moved > 0
+
+
+def test_moe_with_ring_attention_sp_ep_mesh():
+    """ep and sp compose on one mesh: batch sharded over ep (MoE
+    all_to_all dispatch inside each sp group), sequence sharded over
+    sp (ring attention inside each ep group) — output still matches
+    the full unsharded MoE model (capacity high enough that routing
+    grouping is irrelevant)."""
+    import dataclasses
+
+    from horovod_tpu.models import Transformer, TransformerConfig
+
+    ep, sp = 2, 2
+    base = TransformerConfig(vocab_size=97, num_layers=2, num_heads=4,
+                             embed_dim=32, mlp_dim=64, moe_experts=4,
+                             moe_every=2, moe_capacity_factor=4.0,
+                             dtype=jnp.float32)
+    full = Transformer(base)
+    rng = np.random.RandomState(13)
+    tokens = jnp.asarray(rng.randint(0, 97, (2, 32)))
+    params = full.init(jax.random.PRNGKey(17), tokens)["params"]
+    expected = full.apply({"params": params}, tokens)
+
+    sharded_cfg = dataclasses.replace(base, attention="ring",
+                                      sp_axis="sp", ep_axis="ep",
+                                      ep_size=ep)
+    local = Transformer(sharded_cfg)
+    mesh = Mesh(np.array(jax.devices("cpu")[:ep * sp]).reshape(ep, sp),
+                ("ep", "sp"))
+    specs = ep_param_specs(params, "ep")
+    params_p = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+
+    def run(p, tokens):
+        L = tokens.shape[1]
+        positions = jnp.broadcast_to(
+            jax.lax.axis_index("sp") * L +
+            jnp.arange(L, dtype=jnp.int32)[None], tokens.shape)
+        return local.apply({"params": p}, tokens, positions)
+
+    out = jax.jit(jax.shard_map(
+        run, mesh=mesh, in_specs=(specs, P("ep", "sp")),
+        out_specs=P("ep", "sp"), check_vma=False))(params_p, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
