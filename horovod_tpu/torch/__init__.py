@@ -10,13 +10,17 @@ per-parameter grad hooks and ``backward_passes_per_step`` accumulation,
 Torch here is the CPU-tensor framework (the environment ships CPU torch);
 tensors ride the native host core — the same path as the reference's
 ``DoAllreduceCudaOnCPU`` staging variant (`torch/mpi_ops_v2.cc:84-117`),
-minus the GPU staging copy. Contiguous CPU tensors ride ZERO-COPY: the
-enqueue C API receives the tensor's own storage pointer (numpy view via
-the buffer protocol) for both input and output, so ``allreduce_async_``
-/ ``broadcast_async_`` reduce in place with no host copies at all — the
-reference's in-place-on-storage semantics (`torch/mpi_ops_v2.cc:52-76`)
-without C++ glue. TPU training from torch graphs is out of scope; use
-the jax binding for the XLA/ICI plane.
+minus the GPU staging copy. Contiguous CPU tensors ride ZERO-COPY
+through compiled C glue (`torch_cext.c`, built lazily): the tensor's
+own storage pointer enters the core enqueue API from C for both input
+and output, so ``allreduce_async_`` / ``broadcast_async_`` reduce in
+place with no host copies and no per-call interpreter marshalling —
+the reference's binding architecture (`torch/mpi_ops_v2.cc:52-76`)
+with the CPython C API instead of pybind11. The ctypes + buffer-
+protocol path remains as the portable fallback (and carries
+allgather / non-contiguous / unsupported-dtype cases). TPU training
+from torch graphs is out of scope; use the jax binding for the
+XLA/ICI plane.
 """
 
 import torch
@@ -37,8 +41,24 @@ from .compression import Compression  # noqa: F401
 # `bound=True` means the core writes the result DIRECTLY into the result
 # tensor's storage (zero-copy path) — synchronize just returns it.
 _torch_handles = {}
+# Handles started through the C-extension glue (they bypass the ctypes
+# handle map; poll/synchronize must use the extension's calls).
+_cext_handles = set()
 
 _name_counter = [0]
+
+# torch dtype -> native DataType enum (native/message.h; same table as
+# common.basics._NUMPY_TO_DTYPE).
+_TORCH_TO_HVD_DTYPE = {
+    torch.uint8: 0, torch.int8: 1, torch.int16: 3, torch.int32: 4,
+    torch.int64: 5, torch.float16: 6, torch.float32: 7,
+    torch.float64: 8, torch.bool: 9, torch.bfloat16: 10,
+}
+
+
+def _cext_mod():
+    from . import _cext
+    return _cext.load()
 
 
 def _auto_name(prefix):
@@ -77,8 +97,34 @@ def _to_numpy(tensor):
 
 # -- async collectives ----------------------------------------------------
 
+def _cext_eligible(tensor):
+    return (tensor.device.type == "cpu" and tensor.is_contiguous() and
+            tensor.dtype in _TORCH_TO_HVD_DTYPE and
+            tensor.dim() <= 16)  # torch_cext.c MAX_DIMS
+
+
+def _start_cext(tensor, dest, enqueue):
+    """Shared C-extension bookkeeping: allocate/alias the result tensor,
+    enqueue via `enqueue(data_ptr, out_ptr, shape, dtype)`, register the
+    handle in both maps. The tensor's own storage pointer enters the
+    core from C (reference mpi_ops_v2.cc architecture)."""
+    result = tensor if dest is tensor else torch.empty_like(tensor)
+    shape = tuple(tensor.shape) or (1,)
+    handle = enqueue(tensor.data_ptr(), result.data_ptr(), shape,
+                     _TORCH_TO_HVD_DTYPE[tensor.dtype])
+    _torch_handles[handle] = (tensor, result, True)
+    _cext_handles.add(handle)
+    return handle
+
+
 def _start_allreduce(tensor, dest, name, prescale, post):
     """dest=None: allocate a result tensor; dest=tensor: in place."""
+    ext = _cext_mod()
+    if ext is not None and _cext_eligible(tensor):
+        return _start_cext(
+            tensor, dest,
+            lambda dp, op, sh, dt: ext.enqueue_allreduce(
+                name, dp, op, sh, dt, prescale, post))
     view = _numpy_view(tensor)
     if view is not None:
         result = tensor if dest is tensor else torch.empty_like(tensor)
@@ -135,6 +181,12 @@ def allgather_async(tensor, name=None):
 
 
 def _start_broadcast(tensor, dest, root_rank, name):
+    ext = _cext_mod()
+    if ext is not None and _cext_eligible(tensor):
+        return _start_cext(
+            tensor, dest,
+            lambda dp, op, sh, dt: ext.enqueue_broadcast(
+                name, dp, op, sh, dt, int(root_rank)))
     view = _numpy_view(tensor)
     if view is not None:
         result = tensor if dest is tensor else torch.empty_like(tensor)
@@ -159,6 +211,8 @@ def broadcast_async_(tensor, root_rank, name=None):
 
 
 def poll(handle):
+    if handle in _cext_handles:
+        return bool(_cext_mod().poll(handle))
     return _ops.poll(handle)
 
 
@@ -168,6 +222,13 @@ def synchronize(handle):
     if handle not in _torch_handles:
         raise ValueError("unknown handle %d" % handle)
     tensor, dest, bound = _torch_handles.pop(handle)
+    if handle in _cext_handles:
+        _cext_handles.discard(handle)
+        try:
+            _cext_mod().wait(handle)
+        except RuntimeError as e:
+            raise HorovodInternalError(str(e)) from e
+        return dest
     out = _ops.synchronize(handle)
     if bound:
         # The core already wrote the result into dest's storage.

@@ -1,0 +1,97 @@
+"""Lazy builder/loader for the torch binding's C-extension glue
+(`torch_cext.c`) — the native analogue of the reference's
+torch/mpi_ops_v2.cc binding layer, built with the plain CPython C API
+(pybind11 is not available in this environment).
+
+Build happens once per interpreter ABI into the package directory,
+linked against the already-built libhorovod_tpu.so (whose build the
+ctypes loader owns). Failure to build degrades silently to the ctypes
+path — set HVD_TPU_REQUIRE_CEXT=1 to make a missing extension fatal.
+"""
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_NATIVE = os.path.abspath(os.path.join(_HERE, "..", "native"))
+_SO = os.path.join(
+    _HERE, "_hvd_torch_cext%s" % sysconfig.get_config_var("EXT_SUFFIX"))
+
+_mod = None
+_tried = False
+
+
+def _build():
+    import fcntl
+
+    from horovod_tpu.common.basics import get_basics
+    get_basics()  # ensures libhorovod_tpu.so exists (ctypes loader builds)
+
+    src = os.path.join(_HERE, "torch_cext.c")
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(src):
+        return
+    include = sysconfig.get_path("include")
+    lock_path = os.path.join(_HERE, ".cext_build_lock")
+    with open(lock_path, "w") as lock_file:
+        fcntl.flock(lock_file, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(_SO) and \
+                    os.path.getmtime(_SO) >= os.path.getmtime(src):
+                return
+            # Link to a temp name and rename into place: the lock-free
+            # fast path above (and any process with the old .so mapped)
+            # must never observe a partially written file.
+            tmp = _SO + ".tmp.%d" % os.getpid()
+            cmd = ["g++", "-O2", "-shared", "-fPIC",
+                   "-I%s" % include, "-x", "c", src,
+                   "-L%s" % _NATIVE, "-lhorovod_tpu",
+                   "-Wl,-rpath,%s" % _NATIVE,
+                   "-o", tmp]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    "g++ failed building the torch C extension:\n%s" %
+                    (proc.stderr or proc.stdout))
+            os.replace(tmp, _SO)
+        finally:
+            fcntl.flock(lock_file, fcntl.LOCK_UN)
+
+
+_load_error = None
+
+
+def load():
+    """The extension module, or None when unavailable. With
+    HVD_TPU_REQUIRE_CEXT=1 a build/load failure is fatal on EVERY call
+    (not just the first), so collectives can never silently fall back."""
+    global _mod, _tried, _load_error
+    if _mod is not None:
+        return _mod
+    if _tried:
+        if _load_error is not None and \
+                os.environ.get("HVD_TPU_REQUIRE_CEXT") == "1":
+            raise RuntimeError(
+                "torch C-extension glue unavailable: %s" % _load_error)
+        return None
+    _tried = True
+    if os.environ.get("HVD_TPU_DISABLE_CEXT") == "1":
+        return None
+    try:
+        _build()
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "_hvd_torch_cext", _SO)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _mod = mod
+    except Exception as e:
+        _load_error = e
+        if os.environ.get("HVD_TPU_REQUIRE_CEXT") == "1":
+            raise RuntimeError(
+                "torch C-extension glue unavailable: %s" % e) from e
+        print("horovod_tpu: torch C extension unavailable (%s); "
+              "using the ctypes path" % e, file=sys.stderr)
+    return _mod

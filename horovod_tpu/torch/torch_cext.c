@@ -1,0 +1,137 @@
+/* CPython C-extension glue for the torch binding — the native analogue
+ * of the reference's torch/mpi_ops_v2.cc: tensors enter the core
+ * enqueue API from C with their own storage pointers (zero-copy, in
+ * place), no ctypes marshalling on the hot path.
+ *
+ * Built lazily (see _cext.py) against libhorovod_tpu.so, pybind11-free
+ * (plain Python C API, per the environment's constraints). The Python
+ * side resolves tensors to (data_ptr, out_ptr, shape, dtype) — this
+ * module performs the foreign calls and handle management.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdint.h>
+
+/* Core C API (linked against libhorovod_tpu.so). */
+extern int horovod_tpu_enqueue_allreduce(const char* name, const void* data,
+                                         void* output, int ndim,
+                                         const int64_t* shape, int dtype,
+                                         double prescale, double postscale);
+extern int horovod_tpu_enqueue_broadcast(const char* name, const void* data,
+                                         void* output, int ndim,
+                                         const int64_t* shape, int dtype,
+                                         int root_rank);
+extern int horovod_tpu_poll(int handle);
+extern int horovod_tpu_wait(int handle);
+extern const char* horovod_tpu_error_string(int handle);
+extern void horovod_tpu_release(int handle);
+
+#define MAX_DIMS 16
+
+static int parse_shape(PyObject* shape_obj, int64_t* shape, int* ndim) {
+  Py_ssize_t n = PySequence_Length(shape_obj);
+  if (n < 0 || n > MAX_DIMS) {
+    PyErr_SetString(PyExc_ValueError, "bad tensor rank");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PySequence_GetItem(shape_obj, i);
+    if (item == NULL) return -1;
+    shape[i] = PyLong_AsLongLong(item);
+    Py_DECREF(item);
+    if (PyErr_Occurred()) return -1;
+  }
+  *ndim = (int)n;
+  return 0;
+}
+
+/* enqueue_allreduce(name, data_ptr, out_ptr, shape, dtype, pre, post) */
+static PyObject* cext_enqueue_allreduce(PyObject* self, PyObject* args) {
+  const char* name;
+  unsigned long long data_ptr, out_ptr;
+  PyObject* shape_obj;
+  int dtype;
+  double pre, post;
+  if (!PyArg_ParseTuple(args, "sKKOidd", &name, &data_ptr, &out_ptr,
+                        &shape_obj, &dtype, &pre, &post)) {
+    return NULL;
+  }
+  int64_t shape[MAX_DIMS];
+  int ndim;
+  if (parse_shape(shape_obj, shape, &ndim) != 0) return NULL;
+  int handle;
+  Py_BEGIN_ALLOW_THREADS
+  handle = horovod_tpu_enqueue_allreduce(
+      name, (const void*)(uintptr_t)data_ptr, (void*)(uintptr_t)out_ptr,
+      ndim, shape, dtype, pre, post);
+  Py_END_ALLOW_THREADS
+  return PyLong_FromLong(handle);
+}
+
+/* enqueue_broadcast(name, data_ptr, out_ptr, shape, dtype, root) */
+static PyObject* cext_enqueue_broadcast(PyObject* self, PyObject* args) {
+  const char* name;
+  unsigned long long data_ptr, out_ptr;
+  PyObject* shape_obj;
+  int dtype, root;
+  if (!PyArg_ParseTuple(args, "sKKOii", &name, &data_ptr, &out_ptr,
+                        &shape_obj, &dtype, &root)) {
+    return NULL;
+  }
+  int64_t shape[MAX_DIMS];
+  int ndim;
+  if (parse_shape(shape_obj, shape, &ndim) != 0) return NULL;
+  int handle;
+  Py_BEGIN_ALLOW_THREADS
+  handle = horovod_tpu_enqueue_broadcast(
+      name, (const void*)(uintptr_t)data_ptr, (void*)(uintptr_t)out_ptr,
+      ndim, shape, dtype, root);
+  Py_END_ALLOW_THREADS
+  return PyLong_FromLong(handle);
+}
+
+static PyObject* cext_poll(PyObject* self, PyObject* args) {
+  int handle;
+  if (!PyArg_ParseTuple(args, "i", &handle)) return NULL;
+  return PyBool_FromLong(horovod_tpu_poll(handle));
+}
+
+/* wait(handle) -> None on success; raises RuntimeError on failure.
+ * Releases the handle either way (the caller owns the output buffer). */
+static PyObject* cext_wait(PyObject* self, PyObject* args) {
+  int handle;
+  if (!PyArg_ParseTuple(args, "i", &handle)) return NULL;
+  int status;
+  Py_BEGIN_ALLOW_THREADS
+  status = horovod_tpu_wait(handle);
+  Py_END_ALLOW_THREADS
+  if (status != 0) {  /* StatusType::OK == 0 */
+    const char* msg = horovod_tpu_error_string(handle);
+    PyErr_SetString(PyExc_RuntimeError,
+                    msg ? msg : "collective failed");
+    horovod_tpu_release(handle);
+    return NULL;
+  }
+  horovod_tpu_release(handle);
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef cext_methods[] = {
+    {"enqueue_allreduce", cext_enqueue_allreduce, METH_VARARGS,
+     "enqueue_allreduce(name, data_ptr, out_ptr, shape, dtype, pre, post)"},
+    {"enqueue_broadcast", cext_enqueue_broadcast, METH_VARARGS,
+     "enqueue_broadcast(name, data_ptr, out_ptr, shape, dtype, root)"},
+    {"poll", cext_poll, METH_VARARGS, "poll(handle) -> bool"},
+    {"wait", cext_wait, METH_VARARGS,
+     "wait(handle); raises RuntimeError on collective failure"},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef cext_module = {
+    PyModuleDef_HEAD_INIT, "_hvd_torch_cext",
+    "Native torch-binding glue over the horovod_tpu core C API.", -1,
+    cext_methods};
+
+PyMODINIT_FUNC PyInit__hvd_torch_cext(void) {
+  return PyModule_Create(&cext_module);
+}

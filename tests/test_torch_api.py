@@ -104,3 +104,36 @@ def test_zero_copy_speedup_100mb():
         if fast * 2 <= legacy:
             break
     assert fast * 2 <= legacy, (fast, legacy)
+
+
+def test_cext_glue_loaded_and_used():
+    """The C-extension binding glue must build and carry the collectives
+    (reference-architecture parity: torch/mpi_ops_v2.cc is compiled
+    glue, not interpreter marshalling). HVD_TPU_REQUIRE_CEXT makes a
+    silent fallback a failure here."""
+    import horovod_tpu.torch as hvd
+    from horovod_tpu.torch import _cext
+    hvd.init()
+    assert _cext.load() is not None, "C extension failed to build"
+    x = torch.randn(256)
+    ptr = x.data_ptr()
+    h = hvd.allreduce_async_(x, average=False, name="cext_route")
+    assert h in hvd._cext_handles  # actually routed through the glue
+    hvd.synchronize(h)
+    assert x.data_ptr() == ptr
+
+
+def test_cext_error_surface():
+    """Handle lifecycle through the C extension: synchronize consumes
+    the handle (second call is the same ValueError as the ctypes
+    path)."""
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    if hvd.size() != 1:
+        pytest.skip("single-process check")
+    x = torch.ones(4)
+    h = hvd.allreduce_async_(x, average=False, name="cext_err")
+    out = hvd.synchronize(h)
+    assert out is x
+    with pytest.raises(ValueError):
+        hvd.synchronize(h)  # handle already consumed
