@@ -16,7 +16,10 @@ import threading
 import numpy as np
 
 _MOD_DIR = os.path.dirname(os.path.abspath(__file__))
-_NATIVE_DIR = os.path.join(_MOD_DIR, "..", "native")
+# HVD_TPU_NATIVE_DIR points at an alternate build of the core (e.g. a
+# `make SANITIZE=thread` TSAN build, or a system-installed location).
+_NATIVE_DIR = os.environ.get(
+    "HVD_TPU_NATIVE_DIR", os.path.join(_MOD_DIR, "..", "native"))
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libhorovod_tpu.so")
 _build_lock = threading.Lock()
 

@@ -7,6 +7,13 @@ Build happens once per interpreter ABI into the package directory,
 linked against the already-built libhorovod_tpu.so (whose build the
 ctypes loader owns). Failure to build degrades silently to the ctypes
 path — set HVD_TPU_REQUIRE_CEXT=1 to make a missing extension fatal.
+
+Symbol-resolution contract: the ctypes loader maps the core with
+RTLD_GLOBAL *before* this extension imports, so the extension's
+horovod_tpu_* references bind to that already-loaded (initialized)
+instance via interposition — even if HVD_TPU_NATIVE_DIR pointed the
+ctypes load at a different build than this extension's rpath (the
+tf_ops.cc kernels rely on the same contract).
 """
 
 import os
