@@ -26,3 +26,20 @@ def test_worker_crash_tears_down_job(run_launcher):
     assert "rank 1 crashing now" in result.stdout
     assert elapsed < 115, "teardown took %.0fs - failure fan-out broken" \
         % elapsed
+
+
+def test_torch_cext_crash_surfaces_error(run_launcher):
+    """Peer failure through the C-extension zero-copy path: the
+    surviving rank's in-flight allreduce raises HorovodInternalError
+    via cext wait (or launcher teardown) — no hang, no silent
+    success."""
+    t0 = time.monotonic()
+    result = run_launcher(3, "torch_crash_worker.py", extra_env={
+        "HVD_TPU_REQUIRE_CEXT": "1",
+        "HVD_TPU_STALL_CHECK_TIME_SECONDS": "30",
+        "HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS": "240",
+    }, timeout=120)
+    elapsed = time.monotonic() - t0
+    assert result.returncode != 0, "job must fail when a rank dies"
+    assert "rank 1 crashing now" in result.stdout
+    assert elapsed < 115, "teardown took %.0fs" % elapsed

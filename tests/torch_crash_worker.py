@@ -1,0 +1,40 @@
+"""Fault-injection worker for the torch C-extension path: rank 1 dies
+mid-job; a surviving rank's in-flight zero-copy allreduce must surface
+HorovodInternalError through the cext wait (or be torn down by the
+launcher) — never hang, never return silently-wrong data as success.
+"""
+
+import os
+import sys
+import time
+
+import torch
+
+import horovod_tpu.torch as hvd
+
+
+def main():
+    os.environ.setdefault("HVD_TPU_REQUIRE_CEXT", "1")
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    x = torch.ones(4)
+    hvd.allreduce_(x, average=False, name="pre_crash")
+    assert float(x[0]) == n, x
+    from horovod_tpu.torch import _cext
+    assert _cext.load() is not None
+    if r == 1:
+        print("rank 1 crashing now", flush=True)
+        os._exit(17)
+    try:
+        y = torch.ones(4)
+        hvd.allreduce_(y, average=False, name="post_crash")
+    except hvd.HorovodInternalError as e:
+        print("rank %d: cext collective failed after crash: %s" % (r, e),
+              flush=True)
+        return 1
+    time.sleep(300)  # launcher teardown covers the no-error case
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
