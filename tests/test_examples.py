@@ -134,3 +134,24 @@ def test_keras_imagenet_resnet50_example():
                        ["--fp16-allreduce"], timeout=600)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "done" in proc.stdout
+
+
+def test_jax_moe_lm_example():
+    """Expert-parallel Switch-MoE LM on a (dp x ep) mesh — the ep
+    member of the parallelism family as a user writes it (sharded
+    experts, all_to_all dispatch, aux loss in the objective, loss
+    decreasing)."""
+    import subprocess
+
+    from conftest import clean_worker_env
+
+    env = clean_worker_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "jax_moe_lm.py"),
+         "--steps", "6"],
+        env=env, timeout=420, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "done" in proc.stdout
