@@ -24,7 +24,7 @@ from .common import (  # noqa: F401
     synchronize,
 )
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 _initialized_here = False
 _world_env = None  # launcher-injected env saved before a rank-subset remap

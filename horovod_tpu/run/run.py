@@ -53,6 +53,11 @@ Available data planes:
     [X] CPU (TCP ring + hierarchical)
     [%s] XLA/ICI (in-jit)
     [%s] TF graph kernels
+    [%s] Torch C-extension glue (zero-copy)
+
+Available kernels (Pallas):
+    [%s] flash attention / ring attention
+    [%s] fused BatchNorm statistics
 """ % (hvd.__version__,
        binding("jax", "horovod_tpu.jax"),
        binding("torch", "horovod_tpu.torch"),
@@ -61,7 +66,20 @@ Available data planes:
             and _importable("horovod_tpu.keras")),
        binding("mxnet", "horovod_tpu.mxnet"),
        flag(_importable("jax")),
-       flag(_tf_native_kernels())))
+       flag(_tf_native_kernels()),
+       flag(_torch_cext()),
+       flag(_importable("jax")),
+       flag(_importable("jax"))))
+
+
+def _torch_cext():
+    if not _importable("torch"):
+        return False
+    try:
+        from horovod_tpu.torch import _cext
+        return _cext.load() is not None
+    except Exception:
+        return False
 
 
 def _tf_native_kernels():
