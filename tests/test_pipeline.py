@@ -122,3 +122,59 @@ def test_pipeline_gradients_flow():
         np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
                                    rtol=5e-5, atol=5e-5,
                                    err_msg=jax.tree_util.keystr(path))
+
+
+def test_pipeline_composes_with_dp():
+    """(dp=2 x pp=2): batch sharded over dp, stages over pp — each dp
+    row runs the GPipe schedule on its shard; output matches the full
+    model. Completes the composition matrix (tp x sp, sp x ep, dp x pp
+    all pinned)."""
+    import flax.linen as nn
+
+    model, params, tokens = _setup()
+    expected = model.apply({"params": params}, tokens)
+    dp = 2
+    mesh = Mesh(np.array(jax.devices("cpu")[:dp * PP]).reshape(dp, PP),
+                ("dp", "pp"))
+
+    block = Block(CFG)
+    stacked = stack_block_params(params, CFG.num_layers)
+    layers_per_stage = CFG.num_layers // PP
+    staged = jax.tree_util.tree_map(
+        lambda x: x.reshape((PP, layers_per_stage) + x.shape[1:]),
+        stacked)
+    specs = jax.tree_util.tree_map(lambda _: P("pp"), staged)
+    staged = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        staged, specs)
+
+    B_local = B // dp
+    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None],
+                                 (B_local // MB, L))
+
+    def stage_fn(stage_params, x):
+        def layer(x, p):
+            return block.apply({"params": p}, x, positions), None
+        return lax.scan(layer, x, stage_params)[0]
+
+    def run(staged_local, embed_p, norm_p, head_p, tokens):
+        local = jax.tree_util.tree_map(lambda x: x[0], staged_local)
+        emb = nn.Embed(CFG.vocab_size, CFG.embed_dim,
+                       param_dtype=jnp.float32, dtype=CFG.dtype)
+        x = emb.apply({"params": embed_p}, tokens)
+        x_mb = x.reshape((MB, B_local // MB) + x.shape[1:])
+        y_mb = pipeline_apply(stage_fn, local, x_mb, "pp")
+        y = y_mb.reshape((B_local,) + y_mb.shape[2:])
+        norm = nn.RMSNorm(dtype=CFG.dtype, param_dtype=jnp.float32)
+        y = norm.apply({"params": norm_p}, y)
+        logits = y @ head_p["kernel"].astype(y.dtype)
+        return logits.astype(jnp.float32)
+
+    out = jax.jit(jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(specs, P(), P(), P(), P("dp")),
+        out_specs=P("dp"), check_vma=False))(
+            staged, params["embed"], params["norm_f"],
+            params["lm_head"], tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
