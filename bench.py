@@ -256,13 +256,22 @@ def _run_negotiation_bench(n, iters, extra_env=None, timeout=1800):
 # are each model's measured-fastest from PERF.md.
 _ZOO = [
     ("resnet50", ["--batch-size", "256"]),
+    # Fused Pallas BN statistics vs the XLA lowering — the round-4
+    # kernel's primary and secondary measurement targets.
+    ("resnet50pbn", ["--batch-size", "256"]),
     ("resnet50gn", ["--batch-size", "256"]),
     ("resnet50nf", ["--batch-size", "256"]),
     ("resnet101", ["--batch-size", "128"]),
     ("vgg16", ["--batch-size", "64"]),
     ("inception3", ["--batch-size", "128", "--image-size", "299"]),
+    ("inception3pbn", ["--batch-size", "128", "--image-size", "299"]),
     ("transformer", []),
     ("transformer", ["--moe-experts", "8", "--fused-xent"]),
+    # Long-context row (VERDICT r3 item 8): L=8192 MUST use the fused
+    # streaming xent (dense f32 logits at this length exceed v5e HBM
+    # and have killed the tunnel before) and a reduced batch.
+    ("transformer", ["--seq-len", "8192", "--fused-xent",
+                     "--tokens-batch", "2"]),
 ]
 
 
