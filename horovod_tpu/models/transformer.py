@@ -52,6 +52,7 @@ class TransformerConfig:
     moe_experts: Optional[int] = None
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1            # 1 = Switch; 2 = GShard-style
     ep_axis: Optional[str] = None
     ep_size: int = 1
     dtype: Any = jnp.bfloat16
@@ -147,7 +148,8 @@ class Block(nn.Module):
             h = MoeMlp(num_experts=cfg.moe_experts, mlp_dim=cfg.mlp_dim,
                        capacity_factor=cfg.moe_capacity_factor,
                        ep_axis=cfg.ep_axis, ep_size=cfg.ep_size,
-                       dtype=cfg.dtype, name="moe_mlp")(h)
+                       top_k=cfg.moe_top_k, dtype=cfg.dtype,
+                       name="moe_mlp")(h)
             return x + h
         h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, param_dtype=jnp.float32,
                      use_bias=False, name="mlp_in")(h)

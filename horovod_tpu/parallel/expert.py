@@ -40,20 +40,52 @@ def switch_dispatch(router_logits, capacity):
     gate-weighted, aux_loss scalar — the Switch load-balancing loss
     E * sum(frac_tokens_e * mean_prob_e)).
     """
+    return topk_dispatch(router_logits, capacity, k=1)
+
+
+def topk_dispatch(router_logits, capacity, k=2):
+    """Top-k (GShard-style for k=2) routing with a static per-expert
+    capacity; gates of the chosen experts renormalized to sum to 1 per
+    token. Each choice occupies one capacity slot; queue positions
+    count both choices (first choices of all tokens enqueue before
+    second choices, GShard's ordering). Returns (dispatch [T, E, C],
+    combine [T, E, C], aux_loss) like :func:`switch_dispatch` — the
+    aux loss uses first-choice fractions (Switch eq. 4 / GShard's
+    l_aux)."""
     T, E = router_logits.shape
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)                    # [T]
-    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, E]
-    gate = jnp.sum(probs * onehot, axis=-1)                    # [T]
-    # 0-indexed arrival position of each token in its expert's queue;
-    # one_hot of an index >= capacity (or negative) is all-zero, which
-    # implements the capacity drop with no branching.
-    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) \
-        .astype(jnp.int32) - 1                                 # [T]
-    dispatch = onehot[:, :, None] * \
-        jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
-    combine = dispatch * gate[:, None, None]
-    frac = jnp.mean(onehot, axis=0)
+
+    onehots = []
+    gates = []
+    masked = probs
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)
+        oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        onehots.append(oh)
+        gates.append(jnp.sum(probs * oh, axis=-1))
+        masked = masked * (1.0 - oh)
+    if k > 1:
+        # GShard renormalizes the chosen gates; Switch (k=1) keeps the
+        # raw top-1 probability (that term is what trains the router).
+        denom = sum(gates)
+        gates = [g / jnp.maximum(denom, 1e-9) for g in gates]
+
+    # Queue positions: choice rounds enqueue in order — round r's
+    # tokens arrive after ALL of round r-1's (prior_counts offsets).
+    prior = jnp.zeros((E,), jnp.float32)
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    for oh, gate in zip(onehots, gates):
+        pos = jnp.sum((jnp.cumsum(oh, axis=0) + prior) * oh, axis=-1) \
+            .astype(jnp.int32) - 1                             # [T]
+        # one_hot of >= capacity (or negative) is all-zero: the drop.
+        d = oh[:, :, None] * \
+            jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        prior = prior + jnp.sum(oh, axis=0)
+
+    frac = jnp.mean(onehots[0], axis=0)
     mean_prob = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(frac * mean_prob)
     return dispatch, combine, aux
@@ -65,12 +97,15 @@ def moe_capacity(tokens, num_experts, capacity_factor):
 
 
 def moe_ffn(x, router_w, w_in, w_out, capacity_factor=1.25,
-            ep_axis=None, act=nn.silu):
-    """Switch MoE feed-forward over flattened tokens.
+            ep_axis=None, act=nn.silu, top_k=1):
+    """Switch (top_k=1) / GShard-style (top_k=2) MoE feed-forward over
+    flattened tokens.
 
     x: [T, D]; router_w: [D, E] (replicated); w_in: [E_local, D, F],
     w_out: [E_local, F, D] — E_local = E with ``ep_axis=None``, E/ep
-    inside shard_map with the expert dim sharded.
+    inside shard_map with the expert dim sharded. With top_k>1 each
+    token consumes top_k capacity slots — size capacity_factor
+    accordingly (>= top_k for comparable drop rates).
 
     Returns (y [T, D] in x.dtype, aux_loss scalar f32).
     """
@@ -83,7 +118,7 @@ def moe_ffn(x, router_w, w_in, w_out, capacity_factor=1.25,
             (w_in.shape[0], ep, E))
     capacity = moe_capacity(T, E, capacity_factor)
     logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    dispatch, combine, aux = switch_dispatch(logits, capacity)
+    dispatch, combine, aux = topk_dispatch(logits, capacity, k=top_k)
 
     expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
     if ep_axis is not None:
@@ -116,6 +151,7 @@ class MoeMlp(nn.Module):
     capacity_factor: float = 1.25
     ep_axis: Optional[str] = None
     ep_size: int = 1
+    top_k: int = 1
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -136,7 +172,7 @@ class MoeMlp(nn.Module):
         y, aux = moe_ffn(x.reshape(-1, D), router_w,
                          w_in.astype(self.dtype), w_out.astype(self.dtype),
                          capacity_factor=self.capacity_factor,
-                         ep_axis=self.ep_axis)
+                         ep_axis=self.ep_axis, top_k=self.top_k)
         self.sow("intermediates", "moe_aux_loss", aux)
         return y.reshape(B, L, D)
 

@@ -297,3 +297,82 @@ def test_moe_with_ring_attention_sp_ep_mesh():
         out_specs=P("ep", "sp"), check_vma=False))(params_p, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_top2_dispatch_routing():
+    """Top-2: both chosen experts get slots, gates renormalize to 1,
+    second choices queue after ALL first choices (GShard ordering)."""
+    from horovod_tpu.parallel.expert import topk_dispatch
+
+    logits = jnp.asarray([[3.0, 2.0, -5.0],
+                          [2.5, 3.5, -5.0]], jnp.float32)
+    dispatch, combine, aux = topk_dispatch(logits, capacity=4, k=2)
+    d = np.asarray(dispatch)
+    c = np.asarray(combine)
+    # First choices: t0 -> e0 slot0, t1 -> e1 slot0.
+    assert d[0, 0, 0] == 1 and d[1, 1, 0] == 1
+    # Second choices enqueue after first-round counts: t0 -> e1 gets
+    # slot 1 (e1 already has t1's first choice), t1 -> e0 slot 1.
+    assert d[0, 1, 1] == 1 and d[1, 0, 1] == 1
+    # Gates renormalized per token: the two combine weights sum to 1.
+    np.testing.assert_allclose(c[0].sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(c[1].sum(), 1.0, rtol=1e-6)
+    assert float(aux) > 0
+
+
+def test_top2_moe_ffn_matches_per_token():
+    """Top-2 with ample capacity == per-token sum of the two chosen
+    experts weighted by renormalized gates."""
+    import flax.linen as nn
+
+    rng = np.random.RandomState(5)
+    T, D, F, E = 16, 8, 12, 4
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+    router = jnp.asarray(rng.randn(D, E).astype(np.float32) * 0.5)
+    w_in = jnp.asarray(rng.randn(E, D, F).astype(np.float32) * 0.2)
+    w_out = jnp.asarray(rng.randn(E, F, D).astype(np.float32) * 0.2)
+
+    y, _ = moe_ffn(x, router, w_in, w_out, capacity_factor=2.0 * E,
+                   top_k=2)
+    probs = np.asarray(jax.nn.softmax(x @ router, -1))
+    expect = np.zeros((T, D), np.float32)
+    for t in range(T):
+        order = np.argsort(-probs[t])
+        g = probs[t, order[:2]]
+        g = g / g.sum()
+        for e, gate in zip(order[:2], g):
+            h = np.asarray(nn.silu(x[t] @ w_in[e]))
+            expect[t] += gate * np.asarray(h @ w_out[e])
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_top2_ep_sharded_matches_unsharded():
+    """Top-2 routing through the ep all_to_all: sharded == per-shard
+    unsharded."""
+    rng = np.random.RandomState(6)
+    T, D, F, E = 32, 8, 12, 4
+    x = rng.randn(T, D).astype(np.float32)
+    router = rng.randn(D, E).astype(np.float32) * 0.4
+    w_in = rng.randn(E, D, F).astype(np.float32) * 0.2
+    w_out = rng.randn(E, F, D).astype(np.float32) * 0.2
+    cf = 2.0 * E
+    mesh = _mesh_dp_ep(2, 2)
+
+    def sharded(x, router, w_in, w_out):
+        y, _ = moe_ffn(x, router, w_in, w_out, capacity_factor=cf,
+                       ep_axis="ep", top_k=2)
+        return y
+
+    y_sh = jax.jit(jax.shard_map(
+        sharded, mesh=mesh,
+        in_specs=(P(("dp", "ep")), P(), P("ep"), P("ep")),
+        out_specs=P(("dp", "ep")), check_vma=False))(x, router, w_in,
+                                                     w_out)
+    y_ref = np.concatenate([
+        np.asarray(moe_ffn(jnp.asarray(s), jnp.asarray(router),
+                           jnp.asarray(w_in), jnp.asarray(w_out),
+                           capacity_factor=cf, top_k=2)[0])
+        for s in x.reshape(4, T // 4, D)])
+    np.testing.assert_allclose(np.asarray(y_sh), y_ref, rtol=2e-4,
+                               atol=2e-4)
