@@ -12,47 +12,3 @@ def test_checkpoint_restore_via_broadcast(run_launcher):
     assert result.returncode == 0, result.stdout + result.stderr
     assert result.stdout.count("checkpoint tests passed") == 2
 
-
-def test_sharded_params_roundtrip(tmp_path):
-    """Multi-chip checkpoint shape: a params tree PLACED on an
-    (dp x ep) mesh (expert weights sharded over ep) must save and
-    restore losslessly and re-place onto the same shardings — the
-    orbax path a pod checkpoint takes."""
-    import numpy as np
-
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-    import horovod_tpu as hvd
-    from horovod_tpu.jax import checkpoint
-    from horovod_tpu.parallel.expert import ep_param_specs
-
-    hvd.init()
-    devs = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
-    mesh = Mesh(devs, ("dp", "ep"))
-    rng = np.random.RandomState(11)
-    params = {
-        "router": jnp.asarray(rng.randn(16, 8).astype(np.float32)),
-        "w_in": jnp.asarray(rng.randn(8, 16, 32).astype(np.float32)),
-        "w_out": jnp.asarray(rng.randn(8, 32, 16).astype(np.float32)),
-    }
-    specs = ep_param_specs(params, "ep")
-    placed = jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, specs)
-
-    path = str(tmp_path / "sharded_ckpt")
-    checkpoint.save(path, placed, step=3)
-    template = jax.tree_util.tree_map(jnp.zeros_like, params)
-    restored = checkpoint.restore(path, template, step=3)
-    for k in params:
-        np.testing.assert_array_equal(np.asarray(restored[k]),
-                                      np.asarray(params[k]))
-    # Re-place on the mesh: the pod-resume step.
-    replaced = jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        restored, specs)
-    assert replaced["w_in"].sharding.spec == specs["w_in"]
-    np.testing.assert_array_equal(np.asarray(replaced["w_out"]),
-                                  np.asarray(params["w_out"]))
