@@ -17,6 +17,15 @@ Usage (see tests/test_pipeline.py): embed on every device, pipeline
 the blocks, then norm/head on every device — stages must be
 structurally identical, so the embedding/head live OUTSIDE the
 pipelined region.
+
+Training INSIDE shard_map (a local loss differentiated per rank): the
+output collection below is a psum whose transpose SUMS the pp ranks'
+identical loss cotangents, so pipeline-internal cotangents arrive
+pp-fold. The gradient contract (pinned by
+tests/test_pipeline.py::test_pipeline_inprocess_grad_sync_contract):
+scale the local loss by ``1/psum(1, pp_axis)``; then staged block
+grads are complete as-is and every NON-staged param (embed before the
+pipeline, norm/head after) needs a ``psum`` over the pp axis.
 """
 
 import jax

@@ -178,3 +178,94 @@ def test_pipeline_composes_with_dp():
             params["lm_head"], tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_inprocess_grad_sync_contract():
+    """Training INSIDE shard_map (local loss per rank): the output
+    collection is a psum, whose transpose SUMS every rank's identical
+    loss cotangent — pipeline-internal cotangents arrive pp-fold. The
+    clean contract: scale the local loss by 1/pp; then staged block
+    grads are complete as-is and every non-staged param (embed before
+    the pipeline, norm/head after) needs a psum over pp. This test
+    pins that rule against the full model's gradients."""
+    import flax.linen as nn
+
+    model, params, tokens = _setup()
+
+    def full_loss(p):
+        return jnp.mean(model.apply({"params": p}, tokens) ** 2)
+
+    g_full = jax.grad(full_loss)(params)
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:PP]), ("pp",))
+    block = Block(CFG)
+    stacked = stack_block_params(params, CFG.num_layers)
+    layers_per_stage = CFG.num_layers // PP
+    staged = jax.tree_util.tree_map(
+        lambda x: x.reshape((PP, layers_per_stage) + x.shape[1:]),
+        stacked)
+    specs = jax.tree_util.tree_map(lambda _: P("pp"), staged)
+    staged = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        staged, specs)
+    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None],
+                                 (B // MB, L))
+
+    def stage_fn(stage_params, x):
+        def layer(x, p):
+            return block.apply({"params": p}, x, positions), None
+        return lax.scan(layer, x, stage_params)[0]
+
+    def grads_fn(staged_local, embed_p, norm_p, head_p, tokens):
+        def local_loss(staged_local, embed_p, norm_p, head_p):
+            local = jax.tree_util.tree_map(lambda x: x[0], staged_local)
+            emb = nn.Embed(CFG.vocab_size, CFG.embed_dim,
+                           param_dtype=jnp.float32, dtype=CFG.dtype)
+            x = emb.apply({"params": embed_p}, tokens)
+            x_mb = x.reshape((MB, B // MB) + x.shape[1:])
+            y_mb = pipeline_apply(stage_fn, local, x_mb, "pp")
+            y = y_mb.reshape((B,) + y_mb.shape[2:])
+            norm = nn.RMSNorm(dtype=CFG.dtype, param_dtype=jnp.float32)
+            y = norm.apply({"params": norm_p}, y)
+            logits = (y @ head_p["kernel"].astype(y.dtype)) \
+                .astype(jnp.float32)
+            # THE CONTRACT part 1: scale the local loss by 1/pp (the
+            # collection psum's transpose sums pp identical cotangents).
+            return jnp.mean(logits ** 2) / lax.psum(1, "pp")
+
+        g_staged, g_embed, g_norm, g_head = jax.grad(
+            local_loss, argnums=(0, 1, 2, 3))(
+                staged_local, embed_p, norm_p, head_p)
+        # THE CONTRACT part 2: staged grads complete; every non-staged
+        # param psums over pp.
+        g_embed, g_norm, g_head = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, "pp"), (g_embed, g_norm, g_head))
+        return g_staged, g_embed, g_norm, g_head
+
+    g_staged, g_embed, g_norm, g_head = jax.jit(jax.shard_map(
+        grads_fn, mesh=mesh,
+        in_specs=(specs, P(), P(), P(), P()),
+        out_specs=(specs, P(), P(), P()),
+        check_vma=False))(staged, params["embed"], params["norm_f"],
+                          params["lm_head"], tokens)
+
+    np.testing.assert_allclose(
+        np.asarray(g_embed["embedding"]),
+        np.asarray(g_full["embed"]["embedding"]), rtol=5e-5, atol=5e-5)
+    np.testing.assert_allclose(
+        np.asarray(g_head["kernel"]),
+        np.asarray(g_full["lm_head"]["kernel"]), rtol=5e-5, atol=5e-5)
+    np.testing.assert_allclose(
+        np.asarray(g_norm["scale"]),
+        np.asarray(g_full["norm_f"]["scale"]), rtol=5e-5, atol=5e-5)
+    # Staged block grads match the full model's, stage-stacked.
+    g_full_stacked = stack_block_params(g_full, CFG.num_layers)
+    e_flat = {jax.tree_util.keystr(k): v for k, v in
+              jax.tree_util.tree_flatten_with_path(g_full_stacked)[0]}
+    for path, got in jax.tree_util.tree_flatten_with_path(g_staged)[0]:
+        exp = e_flat[jax.tree_util.keystr(path)].reshape(
+            (PP, layers_per_stage) +
+            e_flat[jax.tree_util.keystr(path)].shape[1:])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                                   rtol=5e-5, atol=5e-5,
+                                   err_msg=jax.tree_util.keystr(path))
