@@ -1,0 +1,156 @@
+"""Pipeline-parallel language model on a (dp x pp) device mesh.
+
+The pp member of the parallelism family end to end, as a user writes
+it: transformer blocks stage-stacked and sharded over `pp`
+(`stack_block_params` + `pipeline_apply`'s GPipe schedule), embedding
+and norm/head replicated outside the pipelined region, and the
+pipeline gradient contract applied exactly as pinned by
+tests/test_pipeline.py: local loss scaled by 1/pp, non-staged param
+grads psum'd over pp (plus the usual pmean over dp).
+
+Runs on whatever devices exist; for a CPU demo set
+XLA_FLAGS=--xla_force_host_platform_device_count=8.
+
+Run: python examples/jax_pp_lm.py --steps 8
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=16,
+                    help="global batch (sequences)")
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--pp", type=int, default=2, help="pipeline stages")
+    ap.add_argument("--microbatches", type=int, default=2)
+    args = ap.parse_args()
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.models import Transformer, TransformerConfig
+    from horovod_tpu.models.transformer import Block
+    from horovod_tpu.parallel import (hybrid_mesh, pipeline_apply,
+                                      stack_block_params)
+
+    devices = jax.devices()
+    n = len(devices)
+    pp = args.pp
+    dp = n // pp
+    if dp * pp != n or args.layers % pp:
+        raise SystemExit("need dp*pp == %d devices and pp | layers" % n)
+    mesh = hybrid_mesh((dp, pp), ("dp", "pp"), devices=devices)
+    print("mesh: dp=%d x pp=%d over %d devices" % (dp, pp, n))
+
+    cfg = TransformerConfig(vocab_size=256, num_layers=args.layers,
+                            num_heads=4, embed_dim=64, mlp_dim=128,
+                            dtype=jnp.float32)
+    block = Block(cfg)
+    mb = args.microbatches
+    B_local, L = args.batch // dp, args.seq_len
+
+    rng = np.random.RandomState(0)
+    tokens_all = rng.randint(0, 256,
+                             size=(args.steps, args.batch, L))
+
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.asarray(tokens_all[0][:1]))["params"]
+    staged = jax.tree_util.tree_map(
+        lambda x: x.reshape((pp, args.layers // pp) + x.shape[1:]),
+        stack_block_params(params, cfg.num_layers))
+    staged_specs = jax.tree_util.tree_map(lambda _: P("pp"), staged)
+    rest = {k: params[k] for k in ("embed", "norm_f", "lm_head")}
+    rest_specs = jax.tree_util.tree_map(lambda _: P(), rest)
+
+    opt = optax.adam(3e-3)
+    opt_state = (opt.init(staged), opt.init(rest))
+    opt_specs = (
+        (optax.ScaleByAdamState(count=P(), mu=staged_specs,
+                                nu=staged_specs), optax.EmptyState()),
+        (optax.ScaleByAdamState(count=P(), mu=rest_specs,
+                                nu=rest_specs), optax.EmptyState()),
+    )
+    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None],
+                                 (B_local // mb, L))
+
+    def stage_fn(stage_params, x):
+        def layer(x, p):
+            return block.apply({"params": p}, x, positions), None
+        return lax.scan(layer, x, stage_params)[0]
+
+    def forward(staged_local, rest, tokens):
+        local = jax.tree_util.tree_map(lambda x: x[0], staged_local)
+        emb = nn.Embed(cfg.vocab_size, cfg.embed_dim,
+                       param_dtype=jnp.float32, dtype=cfg.dtype)
+        x = emb.apply({"params": rest["embed"]}, tokens)
+        x_mb = x.reshape((mb, B_local // mb) + x.shape[1:])
+        y = pipeline_apply(stage_fn, local, x_mb, "pp")
+        y = y.reshape((B_local,) + y.shape[2:])
+        y = nn.RMSNorm(dtype=cfg.dtype, param_dtype=jnp.float32).apply(
+            {"params": rest["norm_f"]}, y)
+        return (y @ rest["lm_head"]["kernel"].astype(y.dtype)) \
+            .astype(jnp.float32)
+
+    def step(staged_local, rest, opt_state, tokens):
+        def loss_fn(staged_local, rest):
+            logits = forward(staged_local, rest, tokens)
+            tgt = jnp.roll(tokens, -1, axis=1)
+            logp = jax.nn.log_softmax(logits)
+            xent = -jnp.mean(
+                jnp.take_along_axis(logp, tgt[..., None], axis=-1))
+            # Pipeline gradient contract part 1: local loss / pp.
+            return xent / lax.psum(1, "pp")
+
+        loss, (g_staged, g_rest) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(staged_local, rest)
+        # Contract part 2: non-staged grads psum over pp; then the
+        # usual data-parallel mean over dp for everything.
+        g_rest = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, "pp"), g_rest)
+        g_staged, g_rest = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, "dp"), (g_staged, g_rest))
+        us, os0 = opt.update(g_staged, opt_state[0], staged_local)
+        ur, os1 = opt.update(g_rest, opt_state[1], rest)
+        staged_local = optax.apply_updates(staged_local, us)
+        rest = optax.apply_updates(rest, ur)
+        # Report the UN-scaled loss (psum undoes the 1/pp).
+        loss = lax.pmean(lax.psum(loss, "pp"), "dp")
+        return staged_local, rest, (os0, os1), loss
+
+    mapped = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(staged_specs, rest_specs, opt_specs, P("dp")),
+        out_specs=(staged_specs, rest_specs, opt_specs, P()),
+        check_vma=False))
+
+    put = lambda tree, specs: jax.tree_util.tree_map(  # noqa: E731
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree, specs)
+    staged = put(staged, staged_specs)
+    rest = put(rest, rest_specs)
+    opt_state = put(opt_state, opt_specs)
+
+    first = last = None
+    for i in range(args.steps):
+        staged, rest, opt_state, loss = mapped(
+            staged, rest, opt_state, jnp.asarray(tokens_all[i]))
+        last = float(loss)
+        first = first if first is not None else last
+        print("step %d loss %.4f" % (i, last))
+    assert np.isfinite(last) and last < first, (first, last)
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -155,3 +155,22 @@ def test_jax_moe_lm_example():
         env=env, timeout=420, capture_output=True, text=True)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "done" in proc.stdout
+
+
+def test_jax_pp_lm_example():
+    """Pipeline-parallel LM on a (dp x pp) mesh — the pp member as a
+    user writes it, with the pinned pipeline gradient contract."""
+    import subprocess
+
+    from conftest import clean_worker_env
+
+    env = clean_worker_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "jax_pp_lm.py"),
+         "--steps", "6"],
+        env=env, timeout=420, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "done" in proc.stdout
