@@ -20,7 +20,7 @@ import horovod_tpu.jax as hvd_jax
 
 
 def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
-                    compression=None, donate=True):
+                    compression=None, donate=True, zero1=False):
     """Builds a jitted data-parallel train step over `mesh`.
 
     Args:
@@ -28,42 +28,143 @@ def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
       optimizer: an optax GradientTransformation (unwrapped — the
         allreduce wrapping happens here).
       mesh: a 1-D `jax.sharding.Mesh` over `axis_name`.
-      compression: optional `hvd_jax.Compression` codec for gradients.
+      compression: optional `hvd_jax.Compression` codec for gradients
+        (plain path only; incompatible with zero1).
       donate: donate params/opt_state buffers (in-place update on TPU).
+      zero1: ZeRO-stage-1 optimizer-state sharding. Gradients are
+        reduce_scattered over the mesh (each device averages 1/n of
+        every flattened gradient), the optimizer updates only its
+        1/n shard — optimizer STATE per device shrinks n-fold (Adam:
+        2x params -> 2x params/n) — and updated parameter shards are
+        all_gathered back. reduce_scatter + all_gather move the same
+        bytes as the ring allreduce they replace, so step cost is
+        unchanged. Numerically identical to the plain path for
+        ELEMENTWISE optax transforms (sgd/momentum/adam/adamw...);
+        transforms that mix elements across a parameter (e.g.
+        global-norm clipping) would see flattened shards instead of
+        whole tensors. ``place()`` builds the sharded optimizer state
+        itself (pass ``opt_state=None`` or the plain init — it is
+        replaced).
 
     Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``
-    where params/opt_state are replicated and batch is sharded on dim 0.
+    where params are replicated, batch is sharded on dim 0, and
+    opt_state is replicated (plain) or dim-0-sharded (zero1).
     """
+    if zero1 and compression not in (None, hvd_jax.Compression.none):
+        raise ValueError("zero1 and gradient compression are mutually "
+                         "exclusive (the scatter path is uncompressed)")
     compression = compression or hvd_jax.Compression.none
     dist_opt = hvd_jax.DistributedOptimizer(
         optimizer, compression=compression, axis_name=axis_name)
+    n_shards = int(mesh.shape[axis_name])
+
+    def _flat_pad(x):
+        # Dtype preserved: the shard-local update must apply the same
+        # arithmetic the plain path would (f32 master copies are the
+        # caller's choice via param dtype, not imposed here).
+        v = jnp.ravel(x)
+        pad = (-v.size) % n_shards
+        return jnp.pad(v, (0, pad)) if pad else v
 
     def shard_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        updates, opt_state = dist_opt.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(
-            lambda p, u: (p + u).astype(p.dtype), params, updates)
+        if zero1:
+            idx = jax.lax.axis_index(axis_name)
+
+            def scatter(g):
+                v = jax.lax.psum_scatter(_flat_pad(g), axis_name,
+                                         scatter_dimension=0, tiled=True)
+                return v / n_shards
+
+            def my_slice(p):
+                v = _flat_pad(p)
+                chunk = v.shape[0] // n_shards
+                return jax.lax.dynamic_slice_in_dim(v, idx * chunk, chunk)
+
+            g_shards = jax.tree_util.tree_map(scatter, grads)
+            p_shards = jax.tree_util.tree_map(my_slice, params)
+            updates, opt_state = optimizer.update(g_shards, opt_state,
+                                                 p_shards)
+            new_shards = jax.tree_util.tree_map(lambda p, u: p + u,
+                                                p_shards, updates)
+            params = jax.tree_util.tree_map(
+                lambda ns, p: jax.lax.all_gather(
+                    ns, axis_name, tiled=True)[:p.size]
+                .reshape(p.shape).astype(p.dtype),
+                new_shards, params)
+        else:
+            updates, opt_state = dist_opt.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda p, u: (p + u).astype(p.dtype), params, updates)
         loss = jax.lax.pmean(loss, axis_name)
         return params, opt_state, loss
 
     replicated = P()
     sharded = P(axis_name)
-    mapped = jax.shard_map(
-        shard_step, mesh=mesh,
-        in_specs=(replicated, replicated, sharded),
-        out_specs=(replicated, replicated, replicated),
-        check_vma=False)
-
     donate_argnums = (0, 1) if donate else ()
-    step = jax.jit(mapped, donate_argnums=donate_argnums)
+
+    if not zero1:
+        # Plain path: P() is a valid pytree-PREFIX spec for the whole
+        # optimizer state, so the step IS the jitted callable (C++
+        # fast-path dispatch — no per-step Python wrapper).
+        step = jax.jit(jax.shard_map(
+            shard_step, mesh=mesh,
+            in_specs=(replicated, replicated, sharded),
+            out_specs=(replicated, replicated, replicated),
+            check_vma=False), donate_argnums=donate_argnums)
+    else:
+        # zero1: the opt-state spec tree depends on the state's
+        # STRUCTURE (1-D array leaves sharded, scalars like Adam's
+        # count replicated), so the shard_map is built from the live
+        # tree once per structure.
+        cache = {}
+
+        def _opt_spec(opt_state_tree):
+            return jax.tree_util.tree_map(
+                lambda x: sharded if getattr(x, "ndim", 0) >= 1
+                else replicated, opt_state_tree)
+
+        def _compiled_for(opt_state):
+            key = jax.tree_util.tree_structure(opt_state)
+            if key not in cache:
+                spec = _opt_spec(opt_state)
+                cache[key] = jax.jit(jax.shard_map(
+                    shard_step, mesh=mesh,
+                    in_specs=(replicated, spec, sharded),
+                    out_specs=(replicated, spec, replicated),
+                    check_vma=False), donate_argnums=donate_argnums)
+            return cache[key]
+
+        def step(params, opt_state, batch):
+            return _compiled_for(opt_state)(params, opt_state, batch)
+
+        # bench.py reads XLA's cost analysis through .lower().
+        step.lower = lambda params, opt_state, batch: \
+            _compiled_for(opt_state).lower(params, opt_state, batch)
 
     def place(params, opt_state, batch=None):
-        """Places params/opt_state (replicated) and batch (dim-0 sharded)
-        onto the mesh."""
+        """Places params (replicated), optimizer state (replicated, or
+        built flat-padded and dim-0 sharded under zero1 — the passed
+        opt_state is ignored then), and batch (dim-0 sharded)."""
         rep = NamedSharding(mesh, replicated)
         dat = NamedSharding(mesh, sharded)
         params = jax.device_put(params, rep)
-        opt_state = jax.device_put(opt_state, rep)
+        if zero1:
+            # Build the state WITH sharded out_shardings so the full
+            # moments are never materialized per device (the whole
+            # point of zero1 is that they don't fit).
+            def init_flat(p):
+                return optimizer.init(
+                    jax.tree_util.tree_map(_flat_pad, p))
+
+            template = jax.eval_shape(init_flat, params)
+            out_shardings = jax.tree_util.tree_map(
+                lambda x: NamedSharding(mesh, sharded)
+                if getattr(x, "ndim", 0) >= 1 else rep, template)
+            opt_state = jax.jit(
+                init_flat, out_shardings=out_shardings)(params)
+        else:
+            opt_state = jax.device_put(opt_state, rep)
         if batch is None:
             return params, opt_state
         batch = jax.tree_util.tree_map(
