@@ -472,6 +472,10 @@ def main():
                     help="sequence length (transformer model)")
     ap.add_argument("--tokens-batch", type=int, default=8,
                     help="per-chip sequences per step (transformer model)")
+    ap.add_argument("--zero1", action="store_true",
+                    help="ZeRO-1 optimizer-state sharding in the train "
+                         "step (parallel/train.py) - state memory/n, "
+                         "same wire bytes")
     ap.add_argument("--moe-experts", type=int, default=0,
                     help="transformer only: >0 swaps every other "
                          "block's MLP for a Switch-MoE layer with this "
@@ -584,7 +588,8 @@ def main():
                     logp, tgt[..., None], axis=-1))
 
         opt = optax.adam(1e-4)
-        step = make_train_step(loss_fn, opt, mesh, donate=True)
+        step = make_train_step(loss_fn, opt, mesh, donate=True,
+                               zero1=args.zero1)
         params_p, opt_state, batch = step.place(
             params, opt.init(params),
             {"x": tokens, "pos": positions})
@@ -623,7 +628,8 @@ def main():
             return cross_entropy_loss(logits, batch["y"])
 
         opt = optax.sgd(0.01, momentum=0.9)
-        step = make_train_step(loss_fn, opt, mesh, donate=True)
+        step = make_train_step(loss_fn, opt, mesh, donate=True,
+                               zero1=args.zero1)
 
         global_batch = args.batch_size * n
         x = jax.random.normal(rng, (global_batch, s, s, 3), jnp.float32)
