@@ -29,4 +29,4 @@ from .pipeline import pipeline_apply, stack_block_params  # noqa: F401
 from .ring import ring_attention, ulysses_attention  # noqa: F401
 from .tensor_parallel import (  # noqa: F401
     tp_grad_sync, tp_param_specs)
-from .train import make_train_step  # noqa: F401
+from .train import make_fsdp_train_step, make_train_step  # noqa: F401

@@ -19,6 +19,36 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import horovod_tpu.jax as hvd_jax
 
 
+def _aval_cache_key(*trees):
+    """Cache key for per-structure compiled steps: tree structure PLUS
+    leaf shapes/dtypes (sharding specs depend on shapes — same
+    structure with different shapes must not reuse a compiled step)."""
+    leaves, treedef = jax.tree_util.tree_flatten(trees)
+    return (treedef, tuple(
+        (tuple(x.shape), str(x.dtype)) if hasattr(x, "shape") else x
+        for x in leaves))
+
+
+def _structure_cached_step(build):
+    """step(params, opt_state, batch) dispatching through a cache of
+    compiled callables keyed on (structure, shapes, dtypes); exposes
+    .lower for XLA cost analysis (bench.py's contract)."""
+    cache = {}
+
+    def compiled(params, opt_state):
+        key = _aval_cache_key(params, opt_state)
+        if key not in cache:
+            cache[key] = build(params, opt_state)
+        return cache[key]
+
+    def step(params, opt_state, batch):
+        return compiled(params, opt_state)(params, opt_state, batch)
+
+    step.lower = lambda params, opt_state, batch: \
+        compiled(params, opt_state).lower(params, opt_state, batch)
+    return step
+
+
 def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
                     compression=None, donate=True, zero1=False):
     """Builds a jitted data-parallel train step over `mesh`.
@@ -116,31 +146,18 @@ def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
         # zero1: the opt-state spec tree depends on the state's
         # STRUCTURE (1-D array leaves sharded, scalars like Adam's
         # count replicated), so the shard_map is built from the live
-        # tree once per structure.
-        cache = {}
-
-        def _opt_spec(opt_state_tree):
-            return jax.tree_util.tree_map(
+        # tree, cached per (structure, shapes).
+        def _build(_params, opt_state):
+            spec = jax.tree_util.tree_map(
                 lambda x: sharded if getattr(x, "ndim", 0) >= 1
-                else replicated, opt_state_tree)
+                else replicated, opt_state)
+            return jax.jit(jax.shard_map(
+                shard_step, mesh=mesh,
+                in_specs=(replicated, spec, sharded),
+                out_specs=(replicated, spec, replicated),
+                check_vma=False), donate_argnums=donate_argnums)
 
-        def _compiled_for(opt_state):
-            key = jax.tree_util.tree_structure(opt_state)
-            if key not in cache:
-                spec = _opt_spec(opt_state)
-                cache[key] = jax.jit(jax.shard_map(
-                    shard_step, mesh=mesh,
-                    in_specs=(replicated, spec, sharded),
-                    out_specs=(replicated, spec, replicated),
-                    check_vma=False), donate_argnums=donate_argnums)
-            return cache[key]
-
-        def step(params, opt_state, batch):
-            return _compiled_for(opt_state)(params, opt_state, batch)
-
-        # bench.py reads XLA's cost analysis through .lower().
-        step.lower = lambda params, opt_state, batch: \
-            _compiled_for(opt_state).lower(params, opt_state, batch)
+        step = _structure_cached_step(_build)
 
     def place(params, opt_state, batch=None):
         """Places params (replicated), optimizer state (replicated, or
@@ -169,6 +186,81 @@ def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
             return params, opt_state
         batch = jax.tree_util.tree_map(
             partial(jax.device_put, device=dat), batch)
+        return params, opt_state, batch
+
+    step.place = place
+    return step
+
+
+def make_fsdp_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
+                         donate=True, min_size=1024):
+    """Fully-sharded data parallelism (ZeRO-3-style) the XLA-native
+    way: parameters, gradients AND optimizer state live sharded over
+    the dp axis; the step is a plain ``jax.jit`` whose in/out
+    shardings constrain the layout and GSPMD inserts the collectives —
+    all_gather for each parameter right before use, reduce_scatter for
+    its gradient — exactly the scaling-book recipe (pick a mesh,
+    annotate shardings, let XLA insert collectives).
+
+    Contrast with ``make_train_step``: that one is shard_map'd SPMD
+    with explicit psums (Horovod semantics, replicated state);
+    ``zero1=True`` shards only optimizer state. Here per-device memory
+    for params+grads+state all drop ~n-fold; XLA overlaps the gathers
+    with compute. Leaves whose dim 0 is not divisible by the mesh (or
+    smaller than ``min_size`` elements) stay replicated.
+
+    loss_fn sees GLOBAL arrays (plain jit semantics): write it exactly
+    as the single-device loss — no pmean, no axis names.
+
+    Returns ``step(params, opt_state, batch)`` plus ``step.place``.
+    """
+    n = int(mesh.shape[axis_name])
+
+    def _spec(p):
+        if getattr(p, "ndim", 0) >= 1 and p.size >= min_size \
+                and p.shape[0] % n == 0:
+            return P(axis_name)
+        return P()
+
+    def train(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: (p + u).astype(p.dtype), params, updates)
+        return params, opt_state, loss
+
+    def _build(params, opt_state):
+        pspec = jax.tree_util.tree_map(_spec, params)
+        ospec = jax.tree_util.tree_map(_spec, opt_state)
+        to_sh = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda s: NamedSharding(mesh, s), t)
+        in_sh = (to_sh(pspec), to_sh(ospec),
+                 NamedSharding(mesh, P(axis_name)))
+        out_sh = (to_sh(pspec), to_sh(ospec),
+                  NamedSharding(mesh, P()))
+        return jax.jit(train, in_shardings=in_sh, out_shardings=out_sh,
+                       donate_argnums=(0, 1) if donate else ())
+
+    step = _structure_cached_step(_build)
+
+    def place(params, opt_state=None, batch=None):
+        """Shards params per the FSDP rule, BUILDS the optimizer state
+        under jit with sharded out_shardings (the full state is never
+        materialized on one device — any passed opt_state is ignored,
+        like the zero1 path), and shards the batch on dim 0."""
+        params = jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, NamedSharding(mesh, _spec(x))), params)
+        template = jax.eval_shape(optimizer.init, params)
+        out_shardings = jax.tree_util.tree_map(
+            lambda x: NamedSharding(mesh, _spec(x)), template)
+        opt_state = jax.jit(optimizer.init,
+                            out_shardings=out_shardings)(params)
+        if batch is None:
+            return params, opt_state
+        batch = jax.tree_util.tree_map(
+            partial(jax.device_put,
+                    device=NamedSharding(mesh, P(axis_name))), batch)
         return params, opt_state, batch
 
     step.place = place
