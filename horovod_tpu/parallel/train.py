@@ -50,7 +50,8 @@ def _structure_cached_step(build):
 
 
 def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
-                    compression=None, donate=True, zero1=False):
+                    compression=None, donate=True, zero1=False,
+                    accum_steps=1):
     """Builds a jitted data-parallel train step over `mesh`.
 
     Args:
@@ -75,6 +76,14 @@ def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
         whole tensors. ``place()`` builds the sharded optimizer state
         itself (pass ``opt_state=None`` or the plain init — it is
         replaced).
+      accum_steps: gradient accumulation — the flagship analogue of
+        the torch binding's ``backward_passes_per_step`` (reference
+        torch/__init__.py). The per-shard batch is split into
+        ``accum_steps`` microbatches along dim 0 (must divide the
+        shard size); a ``lax.scan`` accumulates the mean of their
+        gradients, then ONE optimizer update (and, in the plain path,
+        one allreduce of the already-accumulated gradients — the same
+        deferred-allreduce semantics as the reference).
 
     Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``
     where params are replicated, batch is sharded on dim 0, and
@@ -96,8 +105,29 @@ def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
         pad = (-v.size) % n_shards
         return jnp.pad(v, (0, pad)) if pad else v
 
+    def _local_loss_and_grads(params, batch):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(params, batch)
+        # Microbatch scan: mean of microbatch losses/grads == the
+        # full-shard value for mean-reduction losses.
+        micro = jax.tree_util.tree_map(
+            lambda x: x.reshape((accum_steps, x.shape[0] // accum_steps)
+                                + x.shape[1:]), batch)
+
+        def body(carry, mb):
+            loss_acc, grads_acc = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            grads_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g / accum_steps, grads_acc, grads)
+            return (loss_acc + loss / accum_steps, grads_acc), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, p.dtype), params)
+        (loss, grads), _ = jax.lax.scan(body, (0.0, zeros), micro)
+        return loss, grads
+
     def shard_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss, grads = _local_loss_and_grads(params, batch)
         if zero1:
             idx = jax.lax.axis_index(axis_name)
 
