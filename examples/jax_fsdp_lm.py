@@ -1,0 +1,72 @@
+"""Fully-sharded data-parallel (ZeRO-3-style) LM training via GSPMD.
+
+The scaling-book recipe as a user writes it: the UNMODIFIED
+single-device transformer, `make_fsdp_train_step` sharding params /
+gradients / optimizer state over the dp mesh through jit shardings —
+XLA inserts the all-gather-before-use and reduce-scatter collectives
+and overlaps them with compute. No shard_map, no axis names, no
+collective calls in user code.
+
+Run: python examples/jax_fsdp_lm.py --steps 8
+(CPU demo: XLA_FLAGS=--xla_force_host_platform_device_count=8)
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=32)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models import Transformer, TransformerConfig
+    from horovod_tpu.parallel import (data_parallel_mesh,
+                                      make_fsdp_train_step)
+
+    mesh = data_parallel_mesh()
+    print("fsdp over %d devices" % len(mesh.devices.ravel()))
+
+    cfg = TransformerConfig(vocab_size=512, num_layers=4, num_heads=4,
+                            embed_dim=128, mlp_dim=256,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    rng = np.random.RandomState(0)
+    tokens_all = rng.randint(
+        0, 512, size=(args.steps, args.batch, args.seq_len))
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(tokens_all[0][:1]))["params"]
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["tokens"])
+        tgt = jnp.roll(batch["tokens"], -1, axis=1)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None], -1))
+
+    opt = optax.adam(3e-3)
+    step = make_fsdp_train_step(loss_fn, opt, mesh, donate=False)
+    p, s, b = step.place(params,
+                         batch={"tokens": jnp.asarray(tokens_all[0])})
+
+    first = last = None
+    for i in range(args.steps):
+        # jit's in_shardings lay out fresh host batches automatically.
+        p, s, loss = step(p, s, {"tokens": jnp.asarray(tokens_all[i])})
+        last = float(loss)
+        first = first if first is not None else last
+        print("step %d loss %.4f" % (i, last))
+    assert np.isfinite(last) and last < first, (first, last)
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

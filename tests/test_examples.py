@@ -174,3 +174,22 @@ def test_jax_pp_lm_example():
         env=env, timeout=420, capture_output=True, text=True)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "done" in proc.stdout
+
+
+def test_jax_fsdp_lm_example():
+    """GSPMD FSDP LM — unmodified model code, sharded params/state,
+    XLA-inserted collectives, loss decreasing."""
+    import subprocess
+
+    from conftest import clean_worker_env
+
+    env = clean_worker_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "jax_fsdp_lm.py"),
+         "--steps", "6"],
+        env=env, timeout=420, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "done" in proc.stdout
