@@ -33,7 +33,8 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def pipeline_apply(stage_fn, stage_params, x_microbatches, pp_axis):
+def pipeline_apply(stage_fn, stage_params, x_microbatches, pp_axis,
+                   remat=False):
     """Runs sequence-of-stages over microbatches inside shard_map.
 
     Args:
@@ -46,10 +47,22 @@ def pipeline_apply(stage_fn, stage_params, x_microbatches, pp_axis):
       x_microbatches: [M, ...] microbatched input, replicated across
         the pp axis (only stage 0 reads it).
       pp_axis: mesh axis name the stages are sharded over.
+      remat: wrap the stage in ``jax.checkpoint`` — the backward then
+        stores only each schedule step's stage INPUT (one activation
+        per in-flight microbatch) and recomputes the stage internals,
+        which is exactly the per-device activation footprint a
+        hand-scheduled 1F1B would give. This is the deliberate design:
+        under jax, autodiff through the scan + ppermute already yields
+        a valid reverse pipeline schedule, and remat controls the
+        memory — hand-interleaving forward/backward steps would fight
+        the compiler instead of letting XLA overlap the reverse
+        ppermutes with recompute.
 
     Returns [M, ...] outputs of the LAST stage, replicated across the
     pp axis.
     """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     n_stages = lax.psum(1, pp_axis)
     d = lax.axis_index(pp_axis)
     M = x_microbatches.shape[0]

@@ -269,3 +269,55 @@ def test_pipeline_inprocess_grad_sync_contract():
         np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
                                    rtol=5e-5, atol=5e-5,
                                    err_msg=jax.tree_util.keystr(path))
+
+
+def test_pipeline_remat_matches():
+    """remat=True (checkpointed stages — the 1F1B-class activation
+    footprint) must not change forward values or gradients."""
+    import flax.linen as nn
+
+    model, params, tokens = _setup()
+    mesh = Mesh(np.array(jax.devices("cpu")[:PP]), ("pp",))
+    block = Block(CFG)
+    stacked = stack_block_params(params, CFG.num_layers)
+    staged = jax.tree_util.tree_map(
+        lambda x: x.reshape((PP, CFG.num_layers // PP) + x.shape[1:]),
+        stacked)
+    specs = jax.tree_util.tree_map(lambda _: P("pp"), staged)
+    staged = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        staged, specs)
+    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None],
+                                 (B // MB, L))
+
+    def stage_fn(stage_params, x):
+        def layer(x, p):
+            return block.apply({"params": p}, x, positions), None
+        return lax.scan(layer, x, stage_params)[0]
+
+    def run(remat):
+        def fwd(staged_local, embed_p, tokens):
+            local = jax.tree_util.tree_map(lambda x: x[0], staged_local)
+            emb = nn.Embed(CFG.vocab_size, CFG.embed_dim,
+                           param_dtype=jnp.float32, dtype=CFG.dtype)
+            x = emb.apply({"params": embed_p}, tokens)
+            x_mb = x.reshape((MB, B // MB) + x.shape[1:])
+            y = pipeline_apply(stage_fn, local, x_mb, "pp", remat=remat)
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        f = jax.jit(jax.shard_map(
+            fwd, mesh=mesh, in_specs=(specs, P(), P()), out_specs=P(),
+            check_vma=False))
+        val = f(staged, params["embed"], tokens)
+        g = jax.grad(lambda s: f(s, params["embed"], tokens))(staged)
+        return val, g
+
+    v0, g0 = run(False)
+    v1, g1 = run(True)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=1e-6)
+    for (p0, a), (p1, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g0)[0],
+            jax.tree_util.tree_flatten_with_path(g1)[0]):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=jax.tree_util.keystr(p0))
