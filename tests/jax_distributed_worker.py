@@ -95,6 +95,35 @@ def main():
     if r == 0:
         print("PASS cross_process_train_step", flush=True)
 
+    # FSDP over the same multi-process global mesh: params/state
+    # sharded across PROCESS boundaries, GSPMD's gathers riding the
+    # distributed runtime.
+    from horovod_tpu.parallel import make_fsdp_train_step
+
+    fparams = {"w": w0, "w2": jnp.asarray(
+        rngs.randn(8, 16).astype(np.float32) * 0.1)}
+
+    def floss(params, b):
+        h = jnp.tanh(b["x"] @ params["w"])
+        logits = h @ params["w2"]
+        return cross_entropy_loss(logits, b["y"] % 16)
+
+    fstep = make_fsdp_train_step(floss, opt, gmesh, donate=False,
+                                 min_size=32)
+    fp, fs, fb = fstep.place(fparams, batch=batch)
+    flosses = []
+    for _ in range(3):
+        fp, fs, floss_v = fstep(fp, fs, fb)
+        flosses.append(float(floss_v))
+    assert flosses[-1] < flosses[0], flosses
+    from jax.sharding import PartitionSpec as PS
+    assert fp["w"].sharding.spec == PS("hvd"), fp["w"].sharding
+    gathered_f = hvd.allgather(np.asarray([flosses[-1]], np.float64),
+                               name="jd_fsdp_loss")
+    assert np.allclose(np.asarray(gathered_f), flosses[-1], atol=1e-9)
+    if r == 0:
+        print("PASS cross_process_fsdp_step", flush=True)
+
     jax.distributed.shutdown()
     print("rank %d: jax.distributed bootstrap tests passed" % r,
           flush=True)

@@ -15,3 +15,4 @@ def test_jax_distributed_bootstrap(run_launcher):
     assert result.returncode == 0, result.stdout + result.stderr
     assert "PASS cross_process_sum" in result.stdout
     assert "PASS cross_process_train_step" in result.stdout
+    assert "PASS cross_process_fsdp_step" in result.stdout

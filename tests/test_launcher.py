@@ -94,3 +94,28 @@ def test_ssh_remote_branch_e2e():
          sys.executable, worker],
         env=env, timeout=300, capture_output=True, text=True)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
+
+
+def test_check_build_matrix():
+    """`horovodrun_tpu --check-build` prints the capability matrix with
+    every data plane and kernel row this build provides (reference:
+    run.py:262-298)."""
+    import subprocess
+    import sys
+
+    from conftest import clean_worker_env
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run.run", "--check-build"],
+        env=clean_worker_env(), timeout=240, capture_output=True,
+        text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout
+    for row in ("[X] JAX", "[X] PyTorch", "[X] TensorFlow",
+                "[X] TCP (dynamic rendezvous)",
+                "[X] CPU (TCP ring + hierarchical)",
+                "[X] XLA/ICI (in-jit)",
+                "[X] Torch C-extension glue (zero-copy)",
+                "[X] flash attention / ring attention",
+                "[X] fused BatchNorm statistics"):
+        assert row in out, (row, out)
