@@ -25,8 +25,10 @@ def main():
         jobs.append((i, kind))
 
     # Same job set, rank-specific enqueue order.
+    import os
+    seed = int(os.environ.get("HVD_TPU_FUZZ_SEED", "1234"))
     order = list(range(num_tensors))
-    random.Random(1234 + r).shuffle(order)
+    random.Random(seed + r).shuffle(order)
 
     handles = {}
     for i in order:
@@ -48,7 +50,7 @@ def main():
 
     # Synchronize in a different rank-specific order.
     sync_order = list(range(num_tensors))
-    random.Random(4321 + r).shuffle(sync_order)
+    random.Random(seed * 3 + 7 + r).shuffle(sync_order)
     for idx in sync_order:
         kind, handle = handles[idx]
         out = ops.synchronize(handle)
