@@ -376,3 +376,47 @@ def test_top2_ep_sharded_matches_unsharded():
         for s in x.reshape(4, T // 4, D)])
     np.testing.assert_allclose(np.asarray(y_sh), y_ref, rtol=2e-4,
                                atol=2e-4)
+
+
+def test_moe_with_ulysses_attention_sp_ep_mesh():
+    """Same composition as the ring variant but with Ulysses attention:
+    TWO different all_to_alls (sequence<->heads over sp, tokens<->
+    experts over ep) in one compiled program, matching the unsharded
+    model."""
+    import dataclasses
+
+    from horovod_tpu.models import Transformer, TransformerConfig
+
+    ep, sp = 2, 2
+    base = TransformerConfig(vocab_size=97, num_layers=2, num_heads=4,
+                             embed_dim=32, mlp_dim=64, moe_experts=4,
+                             moe_every=2, moe_capacity_factor=4.0,
+                             dtype=jnp.float32)
+    full = Transformer(base)
+    rng = np.random.RandomState(21)
+    tokens = jnp.asarray(rng.randint(0, 97, (2, 32)))
+    params = full.init(jax.random.PRNGKey(23), tokens)["params"]
+    expected = full.apply({"params": params}, tokens)
+
+    local = Transformer(dataclasses.replace(
+        base, attention="ulysses", sp_axis="sp", ep_axis="ep",
+        ep_size=ep))
+    mesh = Mesh(np.array(jax.devices("cpu")[:ep * sp]).reshape(ep, sp),
+                ("ep", "sp"))
+    specs = ep_param_specs(params, "ep")
+    params_p = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+
+    def run(p, tokens):
+        L = tokens.shape[1]
+        positions = jnp.broadcast_to(
+            jax.lax.axis_index("sp") * L +
+            jnp.arange(L, dtype=jnp.int32)[None], tokens.shape)
+        return local.apply({"params": p}, tokens, positions)
+
+    out = jax.jit(jax.shard_map(
+        run, mesh=mesh, in_specs=(specs, P("ep", "sp")),
+        out_specs=P("ep", "sp"), check_vma=False))(params_p, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
