@@ -11,10 +11,27 @@ extension layered on the same mesh machinery.
   local/cross communicator split, `common/mpi/mpi_context.cc:133-165`).
 * :mod:`.train` — jitted, shard_map'd data-parallel train-step builder
   (the in-XLA equivalent of `_DistributedOptimizer.apply_gradients`,
-  reference `horovod/tensorflow/__init__.py:231-258`).
+  reference `horovod/tensorflow/__init__.py:231-258`), with
+  ``accum_steps`` gradient accumulation (the flagship
+  backward_passes_per_step), ``zero1`` optimizer-state sharding, and
+  :func:`make_fsdp_train_step` — FSDP/ZeRO-3 through pure GSPMD
+  shardings.
 * :mod:`.ring`  — ring attention (blockwise flash attention with k/v
   blocks rotated over the ICI ring via ``ppermute``) and Ulysses-style
-  all-to-all sequence parallelism.
+  all-to-all sequence parallelism (sp).
+* :mod:`.tensor_parallel` — Megatron-style tp: full-size init,
+  `tp_param_specs` placement, per-shard `cfg.local()` modules,
+  `tp_grad_sync`.
+* :mod:`.pipeline` — GPipe pp over stage-stacked blocks, with the
+  pinned in-shard_map gradient contract and a ``remat`` option
+  (1F1B-class activation memory).
+* :mod:`.expert` — Switch/GShard MoE ep: top-1/top-2 routing with
+  static capacity, expert-dim all_to_all, `ep_param_specs` /
+  `ep_grad_sync`.
+
+Pairwise compositions are test-pinned: tp x sp, sp x ep (ring AND
+Ulysses), dp x pp, fsdp x tp, plus the dryrun's dp x {sp,tp,ep}
+train steps.
 """
 
 from .mesh import (  # noqa: F401
