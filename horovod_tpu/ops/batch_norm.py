@@ -21,8 +21,12 @@ measurement via `bench.py --model resnet50pbn`.
 
 Layout contract: activations reshaped to (M, C), stats over axis 0.
 M must be divisible by the block size (the caller picks the largest
-power-of-two divisor <= 1024; if that is < 8 the plain XLA path is used
-— tiny inputs don't carry the bottleneck).
+power-of-two divisor within a VMEM byte budget; if that is < 8 rows the
+plain XLA path is used — tiny inputs don't carry the bottleneck).
+Narrow-channel layers (C <= 64, i.e. k*C stays within the 128-lane
+register) are lane-packed: k rows fold into the lane dimension so every
+VPU lane is live, with a (k, C) sum after the kernel. 64 < C < 128
+cannot pack a whole row and keeps C lanes live.
 """
 
 import functools
@@ -33,11 +37,57 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _pick_bm(M, cap=1024):
+# ~16 MB VMEM/core; blocks are double-buffered (and the grad kernel
+# reads two operands), so stay well under: 4 MB for the one-input
+# stats pass, 2 MB per input for the two-input grad pass.
+_STATS_BLOCK_BYTES = 4 * 1024 * 1024
+_GRAD_BLOCK_BYTES = 2 * 1024 * 1024
+
+
+def _pick_bm(M, C, itemsize, cap_bytes):
+    """Largest power-of-two divisor of M whose (bm, C) block fits the
+    byte budget. Blocks must be BIG: a 1024-row cap put the ResNet-50
+    stem (M=3.2M) at ~3.1k sequential grid steps, and per-step overhead
+    across 53 BN layers fwd+bwd cost more than the fused read saved
+    (measured 189 vs 110 ms/step on v5e). At 4 MB the stem is 98
+    steps."""
+    # A (bm, C) block with C < 128 is still padded to 128 lanes in
+    # VMEM, so budget by the padded width.
+    cap_rows = max(8, cap_bytes // max(1, max(C, 128) * itemsize))
     bm = 1
-    while bm * 2 <= cap and M % (bm * 2) == 0:
+    while bm * 2 <= cap_rows and M % (bm * 2) == 0:
         bm *= 2
     return bm
+
+
+def _pack_factor(M, C, itemsize, cap_bytes):
+    """Lane packing: view (M, C) as (M/k, k*C) so narrow-channel layers
+    (ResNet stem C=64) fill the VPU's 128 lanes; channel c lives at
+    lanes c, C+c, ..., folded by a cheap (2, k, C) sum after the call.
+    Only pack when the packed shape still yields a >=8-row block."""
+    k = 1
+    while C * (k * 2) <= 128 and M % (k * 2) == 0:
+        k *= 2
+    while k > 1 and _pick_bm(M // k, k * C, itemsize, cap_bytes) < 8:
+        k //= 2
+    return k
+
+
+def _plan(shape, dtype, block_m, cap_bytes):
+    """(k, Mp, Cp, bm) for a (M, C) reduction: pack factor, packed
+    shape, block rows. An explicit block_m disables packing (tests pin
+    block-size semantics on the unpacked layout)."""
+    M, C = shape
+    itemsize = jnp.dtype(dtype).itemsize
+    k = 1 if block_m else _pack_factor(M, C, itemsize, cap_bytes)
+    Mp, Cp = M // k, k * C
+    bm = block_m or _pick_bm(Mp, Cp, itemsize, cap_bytes)
+    return k, Mp, Cp, bm
+
+
+def _fold(out, k, C):
+    """Undo lane packing on a (2, k*C) kernel output."""
+    return out.reshape(2, k, C).sum(axis=1) if k > 1 else out
 
 
 def _stats_kernel(x_ref, out_ref):
@@ -58,15 +108,18 @@ def batch_norm_stats(x2d, interpret=False, block_m=None):
     """Per-channel (sum, sum_of_squares) of a (M, C) array in one
     bf16-read f32-accumulate pass. Returns two (C,) f32 arrays."""
     M, C = x2d.shape
-    bm = block_m or _pick_bm(M)
+    k, Mp, Cp, bm = _plan(x2d.shape, x2d.dtype, block_m,
+                          _STATS_BLOCK_BYTES)
+    xp = x2d.reshape(Mp, Cp) if k > 1 else x2d
     out = pl.pallas_call(
         _stats_kernel,
-        grid=(M // bm,),
-        in_specs=[pl.BlockSpec((bm, C), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((2, C), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((2, C), jnp.float32),
+        grid=(Mp // bm,),
+        in_specs=[pl.BlockSpec((bm, Cp), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((2, Cp), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, Cp), jnp.float32),
         interpret=interpret,
-    )(x2d)
+    )(xp)
+    out = _fold(out, k, C)
     return out[0], out[1]
 
 
@@ -91,30 +144,38 @@ def batch_norm_grad_stats(dy2d, x2d, mean, rstd, interpret=False,
     """Per-channel (sum(dy), sum(dy * x_hat)) — i.e. (d_beta, d_gamma)
     — in one fused read of dy and x. mean/rstd are (C,) f32."""
     M, C = x2d.shape
-    bm = block_m or _pick_bm(M)
+    k, Mp, Cp, bm = _plan(x2d.shape, x2d.dtype, block_m,
+                          _GRAD_BLOCK_BYTES)
+    dyp = dy2d.reshape(Mp, Cp) if k > 1 else dy2d
+    xp = x2d.reshape(Mp, Cp) if k > 1 else x2d
+    # Packed lane l holds channel l % C, so tile the per-channel stats.
+    meanp = jnp.tile(mean, k) if k > 1 else mean
+    rstdp = jnp.tile(rstd, k) if k > 1 else rstd
     out = pl.pallas_call(
         _grad_stats_kernel,
-        grid=(M // bm,),
+        grid=(Mp // bm,),
         in_specs=[
-            pl.BlockSpec((bm, C), lambda i: (i, 0)),
-            pl.BlockSpec((bm, C), lambda i: (i, 0)),
-            pl.BlockSpec((1, C), lambda i: (0, 0)),
-            pl.BlockSpec((1, C), lambda i: (0, 0)),
+            pl.BlockSpec((bm, Cp), lambda i: (i, 0)),
+            pl.BlockSpec((bm, Cp), lambda i: (i, 0)),
+            pl.BlockSpec((1, Cp), lambda i: (0, 0)),
+            pl.BlockSpec((1, Cp), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((2, C), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((2, C), jnp.float32),
+        out_specs=pl.BlockSpec((2, Cp), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, Cp), jnp.float32),
         interpret=interpret,
-    )(dy2d, x2d, mean.reshape(1, C), rstd.reshape(1, C))
+    )(dyp, xp, meanp.reshape(1, Cp), rstdp.reshape(1, Cp))
+    out = _fold(out, k, C)
     return out[0], out[1]
 
 
-def _use_kernel(M):
-    return _pick_bm(M) >= 8
+def _use_kernel(M, C, itemsize):
+    return _pick_bm(M, C, itemsize, _GRAD_BLOCK_BYTES) >= 8
 
 
 def _stats(x2d, interpret):
-    M, _ = x2d.shape
-    if interpret is not None and _use_kernel(M):
+    M, C = x2d.shape
+    if interpret is not None and _use_kernel(
+            M, C, jnp.dtype(x2d.dtype).itemsize):
         s, ss = batch_norm_stats(x2d, interpret)
     else:
         xf = x2d.astype(jnp.float32)
@@ -142,7 +203,8 @@ def _bn_train_bwd(eps, interpret, res, cotangents):
     gyf = gy.astype(jnp.float32) if gy.dtype != jnp.float32 else gy
     xf = x2d.astype(jnp.float32)
     xhat = (xf - mean) * rstd
-    if interpret is not None and _use_kernel(M):
+    if interpret is not None and _use_kernel(
+            M, C, jnp.dtype(x2d.dtype).itemsize):
         dbeta, dgamma = batch_norm_grad_stats(gy, x2d, mean, rstd,
                                               interpret)
     else:
