@@ -144,8 +144,10 @@ def batch_norm_grad_stats(dy2d, x2d, mean, rstd, interpret=False,
     """Per-channel (sum(dy), sum(dy * x_hat)) — i.e. (d_beta, d_gamma)
     — in one fused read of dy and x. mean/rstd are (C,) f32."""
     M, C = x2d.shape
-    k, Mp, Cp, bm = _plan(x2d.shape, x2d.dtype, block_m,
-                          _GRAD_BLOCK_BYTES)
+    # Budget by the wider operand: the public API allows f32 dy with
+    # bf16 x, and the dy block must fit the per-input budget too.
+    wider = max((dy2d.dtype, x2d.dtype), key=lambda d: jnp.dtype(d).itemsize)
+    k, Mp, Cp, bm = _plan(x2d.shape, wider, block_m, _GRAD_BLOCK_BYTES)
     dyp = dy2d.reshape(Mp, Cp) if k > 1 else dy2d
     xp = x2d.reshape(Mp, Cp) if k > 1 else x2d
     # Packed lane l holds channel l % C, so tile the per-channel stats.
@@ -168,14 +170,15 @@ def batch_norm_grad_stats(dy2d, x2d, mean, rstd, interpret=False,
     return out[0], out[1]
 
 
-def _use_kernel(M, C, itemsize):
-    return _pick_bm(M, C, itemsize, _GRAD_BLOCK_BYTES) >= 8
+def _use_kernel(M):
+    # The max(8, ...) floor in _pick_bm means the kernel-usable test
+    # reduces to "M has a power-of-two divisor >= 8".
+    return M % 8 == 0
 
 
 def _stats(x2d, interpret):
     M, C = x2d.shape
-    if interpret is not None and _use_kernel(
-            M, C, jnp.dtype(x2d.dtype).itemsize):
+    if interpret is not None and _use_kernel(M):
         s, ss = batch_norm_stats(x2d, interpret)
     else:
         xf = x2d.astype(jnp.float32)
@@ -183,9 +186,15 @@ def _stats(x2d, interpret):
     return s, ss
 
 
-def _bn_train_fwd(x2d, gamma, beta, eps, interpret):
+def _bn_train_fwd(x2d, gamma, beta, eps, interpret, axis_name=None):
     M, C = x2d.shape
     s, ss = _stats(x2d, interpret)
+    if axis_name is not None:
+        # Cross-replica (sync) BN: the kernels produce per-device
+        # partial sums; one packed psum over the data axis makes the
+        # statistics global. M_g = M * group size (equal shards).
+        s, ss = jax.lax.psum((s, ss), axis_name)
+        M = M * jax.lax.psum(1, axis_name)
     mean = s / M
     var = jnp.maximum(ss / M - mean * mean, 0.0)
     rstd = jax.lax.rsqrt(var + eps)
@@ -196,39 +205,50 @@ def _bn_train_fwd(x2d, gamma, beta, eps, interpret):
     return (y, mean, var), (x2d, gamma, mean, rstd)
 
 
-def _bn_train_bwd(eps, interpret, res, cotangents):
+def _bn_train_bwd(eps, interpret, axis_name, res, cotangents):
     gy, gmean, gvar = cotangents
     x2d, gamma, mean, rstd = res
     M, C = x2d.shape
     gyf = gy.astype(jnp.float32) if gy.dtype != jnp.float32 else gy
     xf = x2d.astype(jnp.float32)
     xhat = (xf - mean) * rstd
-    if interpret is not None and _use_kernel(
-            M, C, jnp.dtype(x2d.dtype).itemsize):
+    if interpret is not None and _use_kernel(M):
         dbeta, dgamma = batch_norm_grad_stats(gy, x2d, mean, rstd,
                                               interpret)
     else:
         dbeta = jnp.sum(gyf, axis=0)
         dgamma = jnp.sum(gyf * xhat, axis=0)
-    dx = (gamma * rstd) * (gyf - dbeta / M - xhat * (dgamma / M))
+    if axis_name is not None:
+        # dx needs the GLOBAL reductions over the sync group; the
+        # returned dgamma/dbeta stay local — the training loop's
+        # gradient allreduce completes them (matching what autodiff
+        # of a psum-of-stats formulation yields).
+        dbeta_g, dgamma_g = jax.lax.psum((dbeta, dgamma), axis_name)
+        Mg = M * jax.lax.psum(1, axis_name)
+    else:
+        dbeta_g, dgamma_g, Mg = dbeta, dgamma, M
+    dx = (gamma * rstd) * (gyf - dbeta_g / Mg - xhat * (dgamma_g / Mg))
     # Direct mean/var cotangent terms (zero in training use — running
     # stats aren't differentiated — and XLA folds the add-zeros away;
     # kept exact so jax.grad through mean/var is still correct).
-    dx = dx + gmean / M + gvar * (2.0 / M) * (xf - mean)
+    dx = dx + gmean / Mg + gvar * (2.0 / Mg) * (xf - mean)
     return dx.astype(x2d.dtype), dgamma, dbeta
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def fused_batch_norm_train(x2d, gamma, beta, eps=1e-5, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_batch_norm_train(x2d, gamma, beta, eps=1e-5, interpret=False,
+                           axis_name=None):
     """Training-mode BN over (M, C): returns (y, mean, var) with the
     Pallas stats kernels on both the forward and the VJP path. mean /
     var are f32 batch statistics for the caller's running-stats
-    update."""
-    return _bn_train_fwd(x2d, gamma, beta, eps, interpret)[0]
+    update. `axis_name` enables cross-replica (sync) BN: statistics
+    are psummed over that mesh axis (kernels stay per-device; one
+    packed psum each way rides the ICI)."""
+    return _bn_train_fwd(x2d, gamma, beta, eps, interpret, axis_name)[0]
 
 
-def _bn_train_vjp_fwd(x2d, gamma, beta, eps, interpret):
-    return _bn_train_fwd(x2d, gamma, beta, eps, interpret)
+def _bn_train_vjp_fwd(x2d, gamma, beta, eps, interpret, axis_name):
+    return _bn_train_fwd(x2d, gamma, beta, eps, interpret, axis_name)
 
 
 fused_batch_norm_train.defvjp(_bn_train_vjp_fwd, _bn_train_bwd)
@@ -249,7 +269,7 @@ try:
         param_dtype: Any = jnp.float32
         scale_init: Callable = nn.initializers.ones
         bias_init: Callable = nn.initializers.zeros
-        axis_name: str = None  # API parity; cross-replica BN unsupported
+        axis_name: str = None  # sync BN: psum stats over this mesh axis
         interpret: bool = False
 
         @nn.compact
@@ -273,7 +293,8 @@ try:
             if jax.default_backend() != "tpu" and not interpret:
                 interpret = None  # plain-XLA fallback off-TPU
             y, mean, var = fused_batch_norm_train(
-                x2d, scale, bias, self.epsilon, interpret)
+                x2d, scale, bias, self.epsilon, interpret,
+                self.axis_name)
             if not self.is_initializing():
                 m = self.momentum
                 ra_mean.value = m * ra_mean.value + (1 - m) * mean

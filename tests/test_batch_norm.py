@@ -122,6 +122,71 @@ def test_pallas_bn_module_train_eval_roundtrip():
         np.asarray(flax_e.apply(v1, x)), rtol=2e-4, atol=2e-4)
 
 
+def test_sync_bn_matches_global_batch():
+    """axis_name sync BN over a 4-way sharded batch must equal plain BN
+    over the concatenated batch under the canonical DP loss contract
+    (each shard computes a LOCAL loss; total = implicit sum over
+    shards; param grads are per-shard contributions the gradient
+    allreduce completes): outputs, batch stats, dx per shard, and
+    summed dgamma/dbeta must all match the global-batch run. No
+    explicit loss psum — under check_vma=False its transpose is
+    another psum, which would scale every cotangent by n."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n, M, C = 4, 64, 32
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(n * M, C).astype(np.float32)) * 1.5 + 0.3
+    # Random linear loss weights: sum(y*w) has a non-degenerate dx
+    # (sum(y^2)'s dx is ~1e-5 — BN outputs are nearly invariant to
+    # input perturbations — and would vacuously pass any atol).
+    w = jnp.asarray(rng.randn(n * M, C).astype(np.float32))
+    gamma = jnp.asarray(rng.rand(C).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(C).astype(np.float32))
+    mesh = Mesh(np.array(jax.devices("cpu")[:n]), ("dp",))
+
+    def global_loss(x, gamma, beta):
+        y, mean, var = fused_batch_norm_train(x, gamma, beta, 1e-5, True)
+        return jnp.sum(y * w), (mean, var)
+
+    def sharded_loss(xs, gamma, beta, ws):
+        y, mean, var = fused_batch_norm_train(
+            xs, gamma, beta, 1e-5, True, "dp")
+        return jnp.sum(y * ws), (mean, var)
+
+    (l_g, (mean_g, var_g)), g_g = jax.value_and_grad(
+        global_loss, argnums=(0, 1, 2), has_aux=True)(x, gamma, beta)
+
+    fwd = jax.jit(jax.shard_map(
+        lambda xs, gamma, beta: fused_batch_norm_train(
+            xs, gamma, beta, 1e-5, True, "dp"),
+        mesh=mesh, in_specs=(P("dp"), P(), P()),
+        out_specs=(P("dp"), P(None), P(None)), check_vma=False))
+    y_s, mean_s, var_s = fwd(x, gamma, beta)
+
+    grad = jax.jit(jax.shard_map(
+        jax.grad(lambda *a: sharded_loss(*a)[0], argnums=(0, 1, 2)),
+        mesh=mesh, in_specs=(P("dp"), P(), P(), P("dp")),
+        out_specs=(P("dp"), P("dp"), P("dp")), check_vma=False))
+    dx_s, dgamma_s, dbeta_s = grad(x, gamma, beta, w)
+
+    np.testing.assert_allclose(float(jnp.sum(y_s * w)), float(l_g),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(mean_s), np.asarray(mean_g),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(var_s), np.asarray(var_g),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx_s), np.asarray(g_g[0]),
+                               rtol=1e-4, atol=1e-5)
+    # Per-shard param-grad contributions; their sum (the gradient
+    # allreduce) equals the global-batch parameter gradient.
+    np.testing.assert_allclose(
+        np.asarray(dgamma_s).reshape(n, C).sum(0), np.asarray(g_g[1]),
+        rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(dbeta_s).reshape(n, C).sum(0), np.asarray(g_g[2]),
+        rtol=1e-4, atol=1e-4)
+
+
 def test_resnet_pallas_variant_one_step():
     """ResNet50PBN: one train step runs, loss finite, batch_stats
     update present (CPU falls back to the plain-XLA stats path via the
