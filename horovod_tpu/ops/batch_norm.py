@@ -1,8 +1,14 @@
 """Fused Pallas BatchNorm statistics for TPU.
 
-Attacks the PERF.md profile's biggest non-conv line
-(`convert_reduce_fusion`, ~29 ms/step on ResNet-50 batch 256): the BN
-statistics passes. Both reductions the op needs —
+Built to attack the PERF.md profile's biggest non-conv line
+(`convert_reduce_fusion`, ~29 ms/step on ResNet-50 batch 256).
+MEASURED OUTCOME (v5e, PERF.md "negative result" section): the stats
+kernels beat XLA's reductions (~17.6 vs 29 ms/step) but the 53 Pallas
+islands per direction cost ~80 ms/step in fusion-boundary copies/
+reshapes/unfused masks — stock XLA BN wins for deep conv nets. Use
+`PallasBatchNorm` where norm layers are few and wide; it is also the
+package's sync-BN implementation (`axis_name`). Both reductions the
+op needs —
 
 * forward: per-channel sum and sum-of-squares of the activation, and
 * backward: per-channel sum(dy) and sum(dy * x_hat)
