@@ -272,6 +272,12 @@ _ZOO = [
     # and have killed the tunnel before) and a reduced batch.
     ("transformer", ["--seq-len", "8192", "--fused-xent",
                      "--tokens-batch", "2"]),
+    # TPU-native head shape at long context: 6 x D=128 heads, identical
+    # FLOPs to GPT-2's 12 x D=64, but every attention matmul runs the
+    # MXU at full width (D=64 caps contraction/output at 64 of 128
+    # lanes). Measured v5e: 36.4% vs 27.6% kernel-counted MFU.
+    ("transformer", ["--seq-len", "8192", "--fused-xent",
+                     "--tokens-batch", "2", "--num-heads", "6"]),
 ]
 
 
@@ -472,6 +478,12 @@ def main():
                     help="sequence length (transformer model)")
     ap.add_argument("--tokens-batch", type=int, default=8,
                     help="per-chip sequences per step (transformer model)")
+    ap.add_argument("--num-heads", type=int, default=12,
+                    help="transformer attention heads; embed_dim stays "
+                         "768, so head_dim = 768/H. H=6 gives D=128 "
+                         "heads — identical FLOPs to GPT-2's 12xD64 but "
+                         "full MXU width (D=64 caps every attention "
+                         "matmul at half the systolic array)")
     ap.add_argument("--zero1", action="store_true",
                     help="ZeRO-1 optimizer-state sharding in the train "
                          "step (parallel/train.py) - state memory/n, "
@@ -504,6 +516,12 @@ def main():
     ap.add_argument("--scaling-single", action="store_true",
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.model == "transformer":
+        if 768 % args.num_heads or (768 // args.num_heads) % 64:
+            ap.error("--num-heads must divide embed_dim=768 with a "
+                     "64-multiple head_dim (the Pallas kernels need "
+                     "lane-tileable D); got H=%d -> D=%s"
+                     % (args.num_heads, 768 / args.num_heads))
 
     if args.scaling_worker is not None:
         return scaling_worker(args)
@@ -548,9 +566,10 @@ def main():
             moe = dict(moe_experts=args.moe_experts, moe_every=2,
                        moe_capacity_factor=1.25)
         cfg = models.TransformerConfig(
-            vocab_size=32000, num_layers=12, num_heads=12, embed_dim=768,
-            mlp_dim=3072, attention="flash", dtype=jnp.bfloat16,
-            max_seq_len=max(8192, args.seq_len), **moe)
+            vocab_size=32000, num_layers=12, num_heads=args.num_heads,
+            embed_dim=768, mlp_dim=3072, attention="flash",
+            dtype=jnp.bfloat16, max_seq_len=max(8192, args.seq_len),
+            **moe)
         model = models.Transformer(cfg)
         L = args.seq_len
         global_batch = args.tokens_batch * n
@@ -686,6 +705,8 @@ def main():
         label = "transformer"
         if args.moe_experts:
             label = "transformer_moe%d" % args.moe_experts
+        if args.num_heads != 12:
+            label += "_h%d" % args.num_heads
         out = {
             "metric": "%s_flash_L%d_sequences_per_sec_per_chip"
                       % (label, args.seq_len),

@@ -71,7 +71,7 @@ def main():
     ap.add_argument("--batch-size", type=int, default=256)
     ap.add_argument("--seq-len", type=int, default=2048)
     ap.add_argument("--logdir", default=None)
-    args = ap.parse_args()
+    args, extra = ap.parse_known_args()
 
     logdir = args.logdir or tempfile.mkdtemp(prefix="hvdtpu_prof_")
     bench = os.path.join(os.path.dirname(os.path.dirname(
@@ -83,7 +83,7 @@ def main():
            "--num-warmup", "2", "--num-rounds", "1",
            "--num-iters", str(args.steps),
            "--batch-size", str(args.batch_size),
-           "--seq-len", str(args.seq_len)]
+           "--seq-len", str(args.seq_len)] + extra
     proc = subprocess.run(cmd, capture_output=True, text=True, env=env)
     sys.stderr.write(proc.stderr[-1500:])
     if proc.returncode != 0:

@@ -122,14 +122,24 @@ def _pick_block(L, preferred):
     return None
 
 
-def _default_blocks(D, backward=False):
-    """Preferred (block_q, block_k) by head dim, from v5e sweeps
-    (examples/flash_block_sweep.py): (256, 512) at D=128; D<=64 leaves
-    VMEM headroom for wider k blocks — (256, 1024) forward,
-    (512, 1024) backward. ONE definition for the plain and ring paths
-    so a retune can't leave them inconsistent."""
+def _default_blocks(D, L=None, backward=False):
+    """Preferred (block_q, block_k) by head dim and sequence length,
+    from v5e sweeps (examples/flash_block_sweep.py): (256, 512) at
+    D=128; D<=64 leaves VMEM headroom for wider blocks — (256, 1024)
+    forward / (512, 1024) backward at L=2048. Long sequences amortize
+    still-bigger q blocks (L=8192 sweep: fwd (512,1024) 8.95 vs 10.39
+    ms/layer, bwd (1024,1024) ~15.7 vs ~17.1): at L>=4096 the q block
+    doubles. ONE definition for the plain and ring paths so a retune
+    can't leave them inconsistent."""
+    long_seq = L is not None and L >= 4096
     if D <= 64:
-        return (512, 1024) if backward else (256, 1024)
+        if backward:
+            return (1024, 1024) if long_seq else (512, 1024)
+        return (512, 1024) if long_seq else (256, 1024)
+    # D=128 at L=8192: fwd (512,512) 6.12 vs 8.24 ms/layer for the
+    # L=2048-swept (256,512); bwd (512,1024) ~8.2 vs ~10.3.
+    if long_seq:
+        return (512, 1024) if backward else (512, 512)
     return (256, 512)
 
 
@@ -181,7 +191,7 @@ def _pallas_forward_lse(q, k, v, scale, causal, interpret,
     # step is tiny); bounded so s [BQ, BK] and the double-buffered k/v
     # blocks stay well inside VMEM. Preferences are D-aware — see
     # _default_blocks.
-    pq, pk = _default_blocks(D)
+    pq, pk = _default_blocks(D, L)
     bq = block_q or _pick_block(L, pq)
     bk = block_k or _pick_block(L, pk)
     num_kb = L // bk
@@ -279,7 +289,7 @@ def flash_ring_step(q, k, v, o, m, l, q_offset, kv_offset, causal=True,
     Lk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
-    pq, pk = _default_blocks(D)
+    pq, pk = _default_blocks(D, Lq)
     bq = block_q or _require_block(Lq, pq, "q shard length")
     bk = block_k or _require_block(Lk, pk, "k/v shard length")
     num_kb = Lk // bk
@@ -415,7 +425,7 @@ def flash_ring_bwd_step(q, k, v, do, lse, delta, dq, dk, dv, q_offset,
     Lk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
-    pq, pk = _default_blocks(D, backward=True)
+    pq, pk = _default_blocks(D, Lq, backward=True)
     bq = block_q or _require_block(Lq, pq, "q shard length")
     bk = block_k or _require_block(Lk, pk, "k/v shard length")
     num_kb, num_qb = Lk // bk, Lq // bq
@@ -572,7 +582,7 @@ def _pallas_backward(q, k, v, out, lse, g, scale, causal, interpret,
     # Backward blocks are independent of the forward's (lse/delta
     # stripes are block-agnostic); see _default_blocks for the swept
     # preferences.
-    pq, pk = _default_blocks(D, backward=True)
+    pq, pk = _default_blocks(D, L, backward=True)
     bq = block_q or _pick_block(L, pq)
     bk = block_k or _pick_block(L, pk)
     num_kb, num_qb = L // bk, L // bq
