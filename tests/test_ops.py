@@ -124,6 +124,33 @@ def test_flash_pallas_backward_interpret(causal, bq, bk):
             rtol=2e-4, atol=2e-4)
 
 
+def test_flash_default_block_policy():
+    """Pins the swept block-preference table (_default_blocks) and the
+    invariant that the chosen blocks always divide L: D- and L-aware
+    (L=8192 sweeps: bigger q blocks at L>=4096), one definition for
+    plain and ring paths."""
+    from horovod_tpu.ops.flash_attention import (_default_blocks,
+                                                 _pick_block)
+    # (D, L, backward) -> swept preference
+    assert _default_blocks(64, 2048) == (256, 1024)
+    assert _default_blocks(64, 2048, backward=True) == (512, 1024)
+    assert _default_blocks(64, 8192) == (512, 1024)
+    assert _default_blocks(64, 8192, backward=True) == (1024, 1024)
+    assert _default_blocks(128, 2048) == (256, 512)
+    assert _default_blocks(128, 8192) == (512, 512)
+    assert _default_blocks(128, 8192, backward=True) == (512, 1024)
+    # L unknown (ring callers pass shard length; None = conservative)
+    assert _default_blocks(64) == (256, 1024)
+    # The picked block always divides L, falling back down the ladder.
+    for D in (64, 128):
+        for L in (256, 384, 2048, 4096, 8192, 12288):
+            for backward in (False, True):
+                pq, pk = _default_blocks(D, L, backward)
+                for pref in (pq, pk):
+                    b = _pick_block(L, pref)
+                    assert b is not None and L % b == 0 and b <= pref
+
+
 def test_flash_fallback_tail_block():
     """L not a multiple of BLOCK_Q (160 = 128 + 32 tail): the blockwise
     fallback must cover the remainder, full shape, values AND grads."""
