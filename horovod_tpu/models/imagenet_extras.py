@@ -50,6 +50,7 @@ class _ConvBN(nn.Module):
     padding: Any = "SAME"
     dtype: Any = jnp.bfloat16
     norm: str = "batch"
+    bn_axis_name: str = None  # sync BN: psum stats over this mesh axis
 
     @nn.compact
     def __call__(self, x, train):
@@ -63,7 +64,8 @@ class _ConvBN(nn.Module):
             bn_cls = nn.BatchNorm
         x = bn_cls(use_running_average=not train, momentum=0.9,
                    epsilon=1e-3, dtype=self.dtype,
-                   param_dtype=jnp.float32)(x)
+                   param_dtype=jnp.float32,
+                   axis_name=self.bn_axis_name)(x)
         return nn.relu(x)
 
 
@@ -77,10 +79,12 @@ class InceptionV3(nn.Module):
     norm: str = "batch"
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    bn_axis_name: str = None  # sync BN over this mesh axis
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        cbn = partial(_ConvBN, dtype=self.dtype, norm=self.norm)
+        cbn = partial(_ConvBN, dtype=self.dtype, norm=self.norm,
+                      bn_axis_name=self.bn_axis_name)
         x = x.astype(self.dtype)
         # Stem: 299x299x3 -> 35x35x192
         x = cbn(32, (3, 3), (2, 2), "VALID")(x, train)

@@ -87,6 +87,11 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     norm: str = "batch"
+    # Cross-replica (sync) BN: psum batch statistics over this mesh
+    # axis (both the flax and the Pallas norm paths support it). The
+    # standard choice at small per-chip batch, where per-device BN
+    # statistics get noisy.
+    bn_axis_name: str = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -109,11 +114,13 @@ class ResNet(nn.Module):
             from horovod_tpu.ops.batch_norm import PallasBatchNorm
             norm = partial(PallasBatchNorm, use_running_average=not train,
                            momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                           param_dtype=jnp.float32)
+                           param_dtype=jnp.float32,
+                           axis_name=self.bn_axis_name)
         else:
             norm = partial(nn.BatchNorm, use_running_average=not train,
                            momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                           param_dtype=jnp.float32, axis_name=None)
+                           param_dtype=jnp.float32,
+                           axis_name=self.bn_axis_name)
         act = nn.relu
 
         x = x.astype(self.dtype)

@@ -187,6 +187,54 @@ def test_sync_bn_matches_global_batch():
         rtol=1e-4, atol=1e-4)
 
 
+def test_resnet_sync_bn_wiring():
+    """ResNet(bn_axis_name='dp'): training forward over a 4-way
+    sharded batch produces the same outputs and running-stat updates
+    as the unsharded model (sync BN sees the global batch either
+    way). Covers the model-level wiring of both norm paths' axis_name
+    plumb-through (the pallas module falls back to XLA stats off-TPU
+    but keeps the psum)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.models.resnet import ResNet, BottleneckBlock
+
+    n = 4
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(8, 16, 16, 3).astype(np.float32))
+    mesh = Mesh(np.array(jax.devices("cpu")[:n]), ("dp",))
+
+    def build(axis):
+        return ResNet(stage_sizes=[1], block_cls=BottleneckBlock,
+                      num_classes=5, num_filters=8, dtype=jnp.float32,
+                      norm="pallas", bn_axis_name=axis)
+
+    variables = build(None).init(jax.random.PRNGKey(0), x, train=False)
+    y_ref, upd_ref = build(None).apply(
+        variables, x, train=True, mutable=["batch_stats"])
+
+    model = build("dp")
+
+    def shard_fwd(xs):
+        y, upd = model.apply(variables, xs, train=True,
+                             mutable=["batch_stats"])
+        return y, upd["batch_stats"]
+
+    f = jax.jit(jax.shard_map(
+        shard_fwd, mesh=mesh, in_specs=(P("dp"),),
+        out_specs=(P("dp"), P(None)), check_vma=False))
+    y_s, stats_s = f(x)
+
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_ref),
+                               rtol=2e-4, atol=2e-4)
+    ref_stats = upd_ref["batch_stats"]
+    flat_s = jax.tree_util.tree_leaves_with_path(stats_s)
+    flat_r = dict(jax.tree_util.tree_leaves_with_path(ref_stats))
+    assert flat_s
+    for path, leaf in flat_s:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_r[path]),
+            rtol=1e-4, atol=1e-5, err_msg=str(path))
+
+
 def test_resnet_pallas_variant_one_step():
     """ResNet50PBN: one train step runs, loss finite, batch_stats
     update present (CPU falls back to the plain-XLA stats path via the
