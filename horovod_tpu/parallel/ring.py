@@ -79,6 +79,33 @@ def _use_flash_ring(Lq, Lk, scale):
     return jax.default_backend() == "tpu" or _interpret_mode()
 
 
+def _shard_visible(src, idx, Lq, Lk):
+    """Whether the kv shard starting at src*Lk overlaps the causal
+    lower triangle of this rank's q rows [idx*Lq, (idx+1)*Lq)."""
+    return src * Lk <= idx * Lq + (Lq - 1)
+
+
+def _causal_skip_step(causal, src, idx, Lq, Lk, step, a, b, c,
+                      k_blk, v_blk):
+    """Run `step(a, b, c, k_blk, v_blk)` unless the held kv shard is
+    entirely in this rank's future on a causal run (then pass the
+    carry through untouched). ONE definition for the jnp, kernel-fwd
+    and kernel-bwd rings so the predicate cannot desynchronize.
+
+    What this buys: on the jnp ring it skips real masked-einsum FLOPs;
+    on the kernel rings the per-block `pl.when` guards already skipped
+    the FLOPs, so it skips the pallas_call dispatch, its block DMAs,
+    and the carry copies. Either way it is per-rank work/energy, NOT
+    ring latency: the schedule is lockstep and rank n-1 computes at
+    every step, so the critical path is unchanged (balancing it needs
+    zigzag/striped sequence sharding — not implemented)."""
+    if not causal:
+        return step(a, b, c, k_blk, v_blk)
+    return lax.cond(_shard_visible(src, idx, Lq, Lk), step,
+                    lambda a, b, c, *_: (a, b, c),
+                    a, b, c, k_blk, v_blk)
+
+
 def _ring_jnp(q, k, v, axis_name, causal, scale):
     """Blockwise jnp ring (non-TPU / unaligned-shape fallback)."""
     n = lax.psum(1, axis_name)
@@ -96,8 +123,13 @@ def _ring_jnp(q, k, v, axis_name, causal, scale):
     def body(i, carry):
         o, m, l, k_blk, v_blk = carry
         src = (idx - i) % n  # which global block we currently hold
-        o, m, l = step(q, k_blk, v_blk, o, m, l,
-                       q_offset=idx * Lq, kv_offset=src * Lk)
+
+        def compute(o, m, l, k_blk, v_blk):
+            return step(q, k_blk, v_blk, o, m, l,
+                        q_offset=idx * Lq, kv_offset=src * Lk)
+
+        o, m, l = _causal_skip_step(causal, src, idx, Lq, Lk, compute,
+                                    o, m, l, k_blk, v_blk)
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
         return o, m, l, k_nxt, v_nxt
@@ -142,10 +174,15 @@ def _ring_flash_impl(q, k, v, axis_name, causal, scale):
     def body(i, carry):
         o, m, l, k_blk, v_blk = carry
         src = (idx - i) % n
-        o, m, l = flash_ring_step(
-            qk, k_blk, v_blk, o, m, l,
-            q_offset=idx * Lq, kv_offset=src * Lk, causal=causal,
-            scale=scale, interpret=_interpret_mode())
+
+        def compute(o, m, l, k_blk, v_blk):
+            return flash_ring_step(
+                qk, k_blk, v_blk, o, m, l,
+                q_offset=idx * Lq, kv_offset=src * Lk, causal=causal,
+                scale=scale, interpret=_interpret_mode())
+
+        o, m, l = _causal_skip_step(causal, src, idx, Lq, Lk, compute,
+                                    o, m, l, k_blk, v_blk)
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
         return o, m, l, k_nxt, v_nxt
@@ -200,10 +237,16 @@ def _ring_flash_bwd(axis_name, causal, scale, res, g):
     def body(i, carry):
         dq, k_blk, v_blk, dk, dv = carry
         src = (idx - i) % n
-        dq, dk, dv = flash_ring_bwd_step(
-            qk, k_blk, v_blk, gk, lse, delta, dq, dk, dv,
-            q_offset=idx * Lq, kv_offset=src * Lk, causal=causal,
-            scale=scale, interpret=_interpret_mode())
+
+        def compute(dq, dk, dv, k_blk, v_blk):
+            return flash_ring_bwd_step(
+                qk, k_blk, v_blk, gk, lse, delta, dq, dk, dv,
+                q_offset=idx * Lq, kv_offset=src * Lk, causal=causal,
+                scale=scale, interpret=_interpret_mode())
+
+        dq, dk, dv = _causal_skip_step(causal, src, idx, Lq, Lk,
+                                       compute, dq, dk, dv, k_blk,
+                                       v_blk)
         # dk/dv ride the ring with their k/v shard; after n steps each
         # shard's gradient arrives back on its home device.
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
@@ -227,6 +270,10 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None):
     Args: q, k, v of shape [B, L_local, H, D] (per-device shards, equal
     L_local on every device), inside shard_map over `axis_name`.
     Returns [B, L_local, H, D] in q.dtype.
+
+    Causal runs dispatch nothing for kv shards entirely in a rank's
+    future (see `_causal_skip_step` for exactly what that saves — and
+    what it does not: ring latency is set by the last rank either way).
 
     On TPU with 128-aligned shards the per-step local compute runs as a
     Pallas flash kernel with carried online-softmax state
