@@ -233,24 +233,26 @@ def _pallas_forward(q, k, v, scale, causal, interpret,
                                block_q, block_k)[0]
 
 
-def _ring_step_kernel(offs_ref, q_ref, k_ref, v_ref, oi_ref, mi_ref,
-                      li_ref, oo_ref, mo_ref, lo_ref, acc_ref, m_ref,
-                      l_ref, *, scale, causal, num_kb):
+def _ring_step_kernel(q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref,
+                      oi_ref, mi_ref, li_ref, oo_ref, mo_ref, lo_ref,
+                      acc_ref, m_ref, l_ref, *, scale, causal, num_kb):
     """One ring-attention step as a flash kernel with carried state.
 
     Same online-softmax update as `_fwd_kernel`, but the (acc, m, l)
     state is loaded from the previous ring step's outputs instead of
     initialized, and written back un-normalized (the caller divides by l
     after the last ring step). Causal masking uses *global* token
-    offsets (offs_ref in SMEM: [[q_offset, kv_offset]]) because the
-    local q and the rotating k/v block sit at different positions of the
-    full sequence; block skipping is dynamic for the same reason.
+    offsets — PER-BLOCK arrays in SMEM (q_offs_ref[qi], kv_offs_ref[kj])
+    rather than one scalar per shard, so a shard may hold discontiguous
+    sequence chunks (the zigzag causal schedule) as long as chunk
+    boundaries align with block boundaries. Block skipping is dynamic
+    for the same reason.
     """
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     block_q, block_k = q_ref.shape[0], k_ref.shape[0]
-    q_off = offs_ref[0, 0] + qi * block_q
-    kv_off = offs_ref[0, 1] + kj * block_k
+    q_off = q_offs_ref[qi]
+    kv_off = kv_offs_ref[kj]
 
     @pl.when(kj == 0)
     def _load_state():
@@ -275,6 +277,40 @@ def _ring_step_kernel(offs_ref, q_ref, k_ref, v_ref, oi_ref, mi_ref,
         lo_ref[...] = jnp.broadcast_to(l_ref[:, :1], lo_ref.shape)
 
 
+def _chunk_len(L, offset, what):
+    """Chunk length for a scalar shard offset (one chunk = the shard)
+    or a 1-D array of per-chunk offsets (equal chunks)."""
+    arr = jnp.asarray(offset)
+    if arr.ndim == 0:
+        return L
+    if L % arr.shape[0]:
+        raise ValueError(f"{what}: {arr.shape[0]} chunks must divide "
+                         f"shard length {L}")
+    return L // arr.shape[0]
+
+
+def _block_offsets(offset, L, blk):
+    """Per-block global offsets (L // blk,) int32 from a scalar shard
+    offset or a 1-D array of per-chunk offsets (equal chunks whose
+    length must be a multiple of blk — blocks may not straddle chunk
+    boundaries)."""
+    off = jnp.asarray(offset, jnp.int32)
+    pos = jnp.arange(L // blk, dtype=jnp.int32) * blk
+    if off.ndim == 0:
+        return off + pos
+    Lc = L // off.shape[0]
+    if Lc % blk:
+        # Reachable only via an explicit block_q/block_k override that
+        # bypasses the _require_block(chunk_len, ...) pick: a block
+        # spanning two discontiguous chunks would get one (wrong)
+        # offset and silently mis-mask.
+        raise ValueError(
+            f"block size {blk} must divide the chunk length {Lc} "
+            f"(chunked shards cannot have blocks straddling chunk "
+            f"boundaries)")
+    return off[pos // Lc] + pos % Lc
+
+
 def flash_ring_step(q, k, v, o, m, l, q_offset, kv_offset, causal=True,
                     scale=None, interpret=False, block_q=None,
                     block_k=None):
@@ -283,18 +319,22 @@ def flash_ring_step(q, k, v, o, m, l, q_offset, kv_offset, causal=True,
     Args: q [BH, Lq, D] (bf16/f32), k/v [BH, Lk, D], carried state
     o [BH, Lq, D] f32 (un-normalized accumulator), m/l [BH, Lq, 8] f32
     (running max / normalizer stripes), q_offset/kv_offset global token
-    offsets (traced int32 scalars). Returns updated (o, m, l).
+    offsets — traced int32 scalars (contiguous shards), or 1-D arrays
+    of per-chunk offsets for shards holding several equal discontiguous
+    chunks (the zigzag causal schedule). Returns updated (o, m, l).
     """
     BH, Lq, D = q.shape
     Lk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
+    Lcq = _chunk_len(Lq, q_offset, "q_offset")
+    Lck = _chunk_len(Lk, kv_offset, "kv_offset")
     pq, pk = _default_blocks(D, Lq)
-    bq = block_q or _require_block(Lq, pq, "q shard length")
-    bk = block_k or _require_block(Lk, pk, "k/v shard length")
+    bq = block_q or _require_block(Lcq, pq, "q chunk length")
+    bk = block_k or _require_block(Lck, pk, "k/v chunk length")
     num_kb = Lk // bk
-    offs = jnp.array([[0, 0]], jnp.int32).at[0, 0].set(q_offset) \
-        .at[0, 1].set(kv_offset)
+    q_offs = _block_offsets(q_offset, Lq, bq)
+    kv_offs = _block_offsets(kv_offset, Lk, bk)
     kernel = functools.partial(_ring_step_kernel, scale=scale,
                                causal=causal, num_kb=num_kb)
     grid = (BH, Lq // bq, num_kb)
@@ -307,7 +347,8 @@ def flash_ring_step(q, k, v, o, m, l, q_offset, kv_offset, causal=True,
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # offsets [[q, kv]]
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # per-q-block offs
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # per-kv-block offs
             pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
@@ -326,12 +367,12 @@ def flash_ring_step(q, k, v, o, m, l, q_offset, kv_offset, causal=True,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(offs, q, k, v, o, m, l)
+    )(q_offs, kv_offs, q, k, v, o, m, l)
 
 
-def _ring_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                        delta_ref, dqi_ref, dqo_ref, dq_acc, *, scale,
-                        causal, num_kb):
+def _ring_bwd_dq_kernel(q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref,
+                        do_ref, lse_ref, delta_ref, dqi_ref, dqo_ref,
+                        dq_acc, *, scale, causal, num_kb):
     """dQ contribution of one backward ring step (FlashAttention-2
     math, global offsets like `_ring_step_kernel`). The dq accumulator
     is carried *across ring steps* (dqi -> dqo, f32): each arriving k/v
@@ -340,8 +381,8 @@ def _ring_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     block_q, block_k = q_ref.shape[0], k_ref.shape[0]
-    q_off = offs_ref[0, 0] + qi * block_q
-    kv_off = offs_ref[0, 1] + kj * block_k
+    q_off = q_offs_ref[qi]
+    kv_off = kv_offs_ref[kj]
 
     @pl.when(kj == 0)
     def _load():
@@ -367,9 +408,10 @@ def _ring_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dqo_ref[...] = dq_acc[...]
 
 
-def _ring_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                         delta_ref, dki_ref, dvi_ref, dko_ref, dvo_ref,
-                         dk_acc, dv_acc, *, scale, causal, num_qb):
+def _ring_bwd_dkv_kernel(q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref,
+                         do_ref, lse_ref, delta_ref, dki_ref, dvi_ref,
+                         dko_ref, dvo_ref, dk_acc, dv_acc, *, scale,
+                         causal, num_qb):
     """dK/dV contribution of one backward ring step. The dk/dv
     accumulators travel around the ring with their k/v shard (the
     caller ppermutes them together), so after n steps each shard
@@ -378,8 +420,8 @@ def _ring_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     block_q, block_k = q_ref.shape[0], k_ref.shape[0]
-    q_off = offs_ref[0, 0] + qi * block_q
-    kv_off = offs_ref[0, 1] + kj * block_k
+    q_off = q_offs_ref[qi]
+    kv_off = kv_offs_ref[kj]
 
     @pl.when(qi == 0)
     def _load():
@@ -425,12 +467,14 @@ def flash_ring_bwd_step(q, k, v, do, lse, delta, dq, dk, dv, q_offset,
     Lk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
+    Lcq = _chunk_len(Lq, q_offset, "q_offset")
+    Lck = _chunk_len(Lk, kv_offset, "kv_offset")
     pq, pk = _default_blocks(D, Lq, backward=True)
-    bq = block_q or _require_block(Lq, pq, "q shard length")
-    bk = block_k or _require_block(Lk, pk, "k/v shard length")
+    bq = block_q or _require_block(Lcq, pq, "q chunk length")
+    bk = block_k or _require_block(Lck, pk, "k/v chunk length")
     num_kb, num_qb = Lk // bk, Lq // bq
-    offs = jnp.array([[0, 0]], jnp.int32).at[0, 0].set(q_offset) \
-        .at[0, 1].set(kv_offset)
+    q_offs = _block_offsets(q_offset, Lq, bq)
+    kv_offs = _block_offsets(kv_offset, Lk, bk)
 
     q_spec = lambda b, i, j: (b, i, 0)      # noqa: E731
     stripe_spec = lambda b, i, j: (b, i, 0)  # noqa: E731
@@ -440,6 +484,7 @@ def flash_ring_bwd_step(q, k, v, do, lse, delta, dq, dk, dv, q_offset,
                           num_kb=num_kb),
         grid=(BH, num_qb, num_kb),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((None, bq, D), q_spec),
             pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
@@ -455,7 +500,7 @@ def flash_ring_bwd_step(q, k, v, do, lse, delta, dq, dk, dv, q_offset,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(offs, q, k, v, do, lse, delta, dq)
+    )(q_offs, kv_offs, q, k, v, do, lse, delta, dq)
 
     k_spec = lambda b, j, i: (b, j, 0)  # noqa: E731
     dk, dv = pl.pallas_call(
@@ -463,6 +508,7 @@ def flash_ring_bwd_step(q, k, v, do, lse, delta, dq, dk, dv, q_offset,
                           causal=causal, num_qb=num_qb),
         grid=(BH, num_kb, num_qb),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((None, bk, D), k_spec),
@@ -486,7 +532,7 @@ def flash_ring_bwd_step(q, k, v, do, lse, delta, dq, dk, dv, q_offset,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(offs, q, k, v, do, lse, delta, dk, dv)
+    )(q_offs, kv_offs, q, k, v, do, lse, delta, dk, dv)
     return dq, dk, dv
 
 

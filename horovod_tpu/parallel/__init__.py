@@ -43,7 +43,8 @@ from .mesh import (  # noqa: F401
 from .expert import (  # noqa: F401
     MoeMlp, ep_grad_sync, ep_param_specs, moe_ffn, switch_dispatch)
 from .pipeline import pipeline_apply, stack_block_params  # noqa: F401
-from .ring import ring_attention, ulysses_attention  # noqa: F401
+from .ring import (ring_attention, ulysses_attention,  # noqa: F401
+                   zigzag_shard, zigzag_unshard)
 from .tensor_parallel import (  # noqa: F401
     tp_grad_sync, tp_param_specs)
 from .train import make_fsdp_train_step, make_train_step  # noqa: F401

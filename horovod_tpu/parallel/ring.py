@@ -97,8 +97,8 @@ def _causal_skip_step(causal, src, idx, Lq, Lk, step, a, b, c,
     the FLOPs, so it skips the pallas_call dispatch, its block DMAs,
     and the carry copies. Either way it is per-rank work/energy, NOT
     ring latency: the schedule is lockstep and rank n-1 computes at
-    every step, so the critical path is unchanged (balancing it needs
-    zigzag/striped sequence sharding — not implemented)."""
+    every step, so the critical path is unchanged — that is what
+    `ring_attention(schedule="zigzag")` below fixes."""
     if not causal:
         return step(a, b, c, k_blk, v_blk)
     return lax.cond(_shard_visible(src, idx, Lq, Lk), step,
@@ -151,7 +151,22 @@ def _from_kernel(x, B, H):
     return x.reshape(B, H, L, D).transpose(0, 2, 1, 3)
 
 
-def _ring_flash_impl(q, k, v, axis_name, causal, scale):
+def _schedule_offsets(schedule, rank, n, L):
+    """Global token offset(s) of the shard held by `rank` (traced).
+
+    contiguous: one chunk at rank*L. zigzag: the sequence is split into
+    2n chunks of L/2; rank r holds chunks (r, 2n-1-r) concatenated —
+    the causal load-balancing layout (every rank's lower-triangle work
+    is equal, so the lockstep ring's critical path halves vs the
+    contiguous layout where rank n-1 does all n steps' work)."""
+    if schedule == "zigzag":
+        Lc = L // 2
+        return jnp.stack([rank * Lc, (2 * n - 1 - rank) * Lc])
+    return rank * L
+
+
+def _ring_flash_impl(q, k, v, axis_name, causal, scale,
+                     schedule="contiguous"):
     """Pallas ring forward. Returns (out [B,Lq,H,D], out_k, lse) where
     out_k is the normalized output in kernel layout and lse [B*H,Lq,8]
     is the per-row log-sum-exp stripe the backward ring consumes."""
@@ -171,6 +186,8 @@ def _ring_flash_impl(q, k, v, axis_name, causal, scale):
     m0 = jnp.full((B * H, Lq, 8), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B * H, Lq, 8), jnp.float32)
 
+    q_off = _schedule_offsets(schedule, idx, n, Lq)
+
     def body(i, carry):
         o, m, l, k_blk, v_blk = carry
         src = (idx - i) % n
@@ -178,11 +195,19 @@ def _ring_flash_impl(q, k, v, axis_name, causal, scale):
         def compute(o, m, l, k_blk, v_blk):
             return flash_ring_step(
                 qk, k_blk, v_blk, o, m, l,
-                q_offset=idx * Lq, kv_offset=src * Lk, causal=causal,
-                scale=scale, interpret=_interpret_mode())
+                q_offset=q_off,
+                kv_offset=_schedule_offsets(schedule, src, n, Lk),
+                causal=causal, scale=scale,
+                interpret=_interpret_mode())
 
-        o, m, l = _causal_skip_step(causal, src, idx, Lq, Lk, compute,
-                                    o, m, l, k_blk, v_blk)
+        if schedule == "zigzag":
+            # Every step has at-or-below-diagonal work by construction
+            # (rank r's high chunk sees every kv shard) — that balance
+            # IS the point; no step-level skip exists to take.
+            o, m, l = compute(o, m, l, k_blk, v_blk)
+        else:
+            o, m, l = _causal_skip_step(causal, src, idx, Lq, Lk,
+                                        compute, o, m, l, k_blk, v_blk)
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
         return o, m, l, k_nxt, v_nxt
@@ -195,22 +220,25 @@ def _ring_flash_impl(q, k, v, axis_name, causal, scale):
     return _from_kernel(out_k, B, H), out_k, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_flash(q, k, v, axis_name, causal, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, causal, scale,
+                schedule="contiguous"):
     """Pallas ring attention, wrapped in a custom VJP because Pallas
     kernels are not auto-differentiable. The backward is a second ring
     pass (FlashAttention-2 style) over the saved per-row log-sum-exp —
     no forward recompute: dq accumulates locally while dk/dv travel
     around the ring with their k/v shard."""
-    return _ring_flash_impl(q, k, v, axis_name, causal, scale)[0]
+    return _ring_flash_impl(q, k, v, axis_name, causal, scale,
+                            schedule)[0]
 
 
-def _ring_flash_fwd(q, k, v, axis_name, causal, scale):
-    out, out_k, lse = _ring_flash_impl(q, k, v, axis_name, causal, scale)
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, schedule):
+    out, out_k, lse = _ring_flash_impl(q, k, v, axis_name, causal,
+                                       scale, schedule)
     return out, (q, k, v, out_k, lse)
 
 
-def _ring_flash_bwd(axis_name, causal, scale, res, g):
+def _ring_flash_bwd(axis_name, causal, scale, schedule, res, g):
     from horovod_tpu.ops.flash_attention import flash_ring_bwd_step
 
     q, k, v, out_k, lse = res
@@ -234,6 +262,8 @@ def _ring_flash_bwd(axis_name, causal, scale, res, g):
     dk0 = jnp.zeros((B * H, Lk, D), jnp.float32)
     dv0 = jnp.zeros((B * H, Lk, D), jnp.float32)
 
+    q_off = _schedule_offsets(schedule, idx, n, Lq)
+
     def body(i, carry):
         dq, k_blk, v_blk, dk, dv = carry
         src = (idx - i) % n
@@ -241,12 +271,17 @@ def _ring_flash_bwd(axis_name, causal, scale, res, g):
         def compute(dq, dk, dv, k_blk, v_blk):
             return flash_ring_bwd_step(
                 qk, k_blk, v_blk, gk, lse, delta, dq, dk, dv,
-                q_offset=idx * Lq, kv_offset=src * Lk, causal=causal,
-                scale=scale, interpret=_interpret_mode())
+                q_offset=q_off,
+                kv_offset=_schedule_offsets(schedule, src, n, Lk),
+                causal=causal, scale=scale,
+                interpret=_interpret_mode())
 
-        dq, dk, dv = _causal_skip_step(causal, src, idx, Lq, Lk,
-                                       compute, dq, dk, dv, k_blk,
-                                       v_blk)
+        if schedule == "zigzag":
+            dq, dk, dv = compute(dq, dk, dv, k_blk, v_blk)
+        else:
+            dq, dk, dv = _causal_skip_step(causal, src, idx, Lq, Lk,
+                                           compute, dq, dk, dv, k_blk,
+                                           v_blk)
         # dk/dv ride the ring with their k/v shard; after n steps each
         # shard's gradient arrives back on its home device.
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
@@ -264,16 +299,27 @@ def _ring_flash_bwd(axis_name, causal, scale, res, g):
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
-def ring_attention(q, k, v, axis_name, causal=True, scale=None):
+def ring_attention(q, k, v, axis_name, causal=True, scale=None,
+                   schedule="contiguous"):
     """Exact multi-head attention over a sequence sharded on `axis_name`.
 
     Args: q, k, v of shape [B, L_local, H, D] (per-device shards, equal
     L_local on every device), inside shard_map over `axis_name`.
     Returns [B, L_local, H, D] in q.dtype.
 
-    Causal runs dispatch nothing for kv shards entirely in a rank's
-    future (see `_causal_skip_step` for exactly what that saves — and
-    what it does not: ring latency is set by the last rank either way).
+    schedule:
+      * "contiguous" (default): rank r holds tokens [r*L_local,
+        (r+1)*L_local). Causal runs dispatch nothing for kv shards
+        entirely in a rank's future (see `_causal_skip_step` for
+        exactly what that saves — and what it does not: ring latency
+        is set by the last rank, which computes at every step).
+      * "zigzag": the global sequence is split into 2n chunks; rank r
+        holds chunks (r, 2n-1-r) concatenated (`zigzag_shard` /
+        `zigzag_unshard` convert layouts). Every rank then does the
+        same amount of causal lower-triangle work at every ring step,
+        halving the lockstep critical path at large n. Kernel path
+        only (per-block offset arrays; L_local must be a multiple of
+        256 so each chunk is 128-aligned).
 
     On TPU with 128-aligned shards the per-step local compute runs as a
     Pallas flash kernel with carried online-softmax state
@@ -285,13 +331,56 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None):
     recompute), with dk/dv accumulators riding the ring alongside
     their k/v shard.
     """
+    if schedule not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring schedule: {schedule!r}")
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
+    if schedule == "zigzag":
+        if not causal:
+            # Non-causal work is already balanced; the zigzag layout
+            # buys nothing and only complicates offsets.
+            raise ValueError("schedule='zigzag' is a causal load-"
+                             "balancing layout; use contiguous for "
+                             "non-causal attention")
+        if Lq % 256 or Lk % 256:
+            raise ValueError(
+                f"zigzag needs 256-multiple shard lengths (two "
+                f"128-aligned chunks per rank); got Lq={Lq}, Lk={Lk}")
+        if not _use_flash_ring(Lq, Lk, scale):
+            raise ValueError(
+                "schedule='zigzag' runs on the Pallas kernel ring "
+                "only (TPU backend, or HVD_TPU_PALLAS_INTERPRET=1, "
+                "static scale)")
+        return _ring_flash(q, k, v, axis_name, causal, scale, "zigzag")
     if _use_flash_ring(Lq, Lk, scale):
         return _ring_flash(q, k, v, axis_name, causal, scale)
     return _ring_jnp(q, k, v, axis_name, causal, scale)
+
+
+def zigzag_shard(x, n, axis=1):
+    """Re-layout a GLOBAL sequence axis into zigzag device order:
+    split into 2n chunks, device r's shard = concat(chunk r,
+    chunk 2n-1-r). The result, sharded contiguously over n devices
+    (e.g. shard_map in_specs P(axis_name) on `axis`), gives each
+    device exactly the layout `ring_attention(schedule='zigzag')`
+    expects. Inverse: `zigzag_unshard`."""
+    ch = jnp.split(x, 2 * n, axis=axis)
+    return jnp.concatenate(
+        [jnp.concatenate([ch[r], ch[2 * n - 1 - r]], axis=axis)
+         for r in range(n)], axis=axis)
+
+
+def zigzag_unshard(x, n, axis=1):
+    """Inverse of `zigzag_shard` (zigzag device order -> the natural
+    global sequence order)."""
+    pairs = jnp.split(x, 2 * n, axis=axis)  # [r0, r0', r1, r1', ...]
+    out = [None] * (2 * n)
+    for r in range(n):
+        out[r] = pairs[2 * r]
+        out[2 * n - 1 - r] = pairs[2 * r + 1]
+    return jnp.concatenate(out, axis=axis)
 
 
 def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):

@@ -90,6 +90,76 @@ def test_ring_attention_flash_path_values_and_grads(monkeypatch):
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_zigzag_ring_matches_dense(monkeypatch):
+    """schedule='zigzag' (causal load-balanced layout): values AND all
+    three gradients must equal dense attention on the natural-order
+    sequence, round-tripped through zigzag_shard/zigzag_unshard.
+    Lq=1024/rank -> two 512-token chunks; with bq=256/bk=512 the q
+    chunks span TWO blocks each (the per-block offset arrays carry
+    real discontiguities) while each kv chunk is one block."""
+    from horovod_tpu.parallel import (ring_attention, zigzag_shard,
+                                      zigzag_unshard)
+    monkeypatch.setenv("HVD_TPU_PALLAS_INTERPRET", "1")
+    n = 4
+    B, L, H, D = 1, 4096, 2, 16  # 1024/rank = 2 x 512-token chunks
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    w = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    expected = _dense_reference(q, k, v, causal=True)
+
+    qz, kz, vz, wz = (zigzag_shard(x, n) for x in (q, k, v, w))
+    mesh = _mesh(n, "sp")
+
+    def fwd_and_grads(q, k, v, w):
+        def loss(q, k, v):
+            out = ring_attention(q, k, v, "sp", causal=True,
+                                 schedule="zigzag")
+            return jnp.sum(out.astype(jnp.float32) * w), out
+        (_, out), grads = jax.value_and_grad(
+            loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+        return (out,) + grads
+
+    f = jax.jit(jax.shard_map(
+        fwd_and_grads, mesh=mesh, in_specs=(P(None, "sp"),) * 4,
+        out_specs=(P(None, "sp"),) * 4, check_vma=False))
+    out, gq, gk, gv = f(qz, kz, vz, wz)
+
+    np.testing.assert_allclose(
+        np.asarray(zigzag_unshard(out, n)), np.asarray(expected),
+        rtol=2e-5, atol=2e-5)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(_dense_reference(q, k, v, True) * w)
+
+    dq, dk, dv = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for got, exp, nm in ((gq, dq, "dq"), (gk, dk, "dk"), (gv, dv, "dv")):
+        np.testing.assert_allclose(
+            np.asarray(zigzag_unshard(got, n)), np.asarray(exp),
+            rtol=2e-4, atol=2e-4, err_msg=nm)
+
+
+def test_zigzag_shard_roundtrip_and_validation():
+    """zigzag_shard/unshard invert each other; ring_attention rejects
+    zigzag with non-causal or unaligned shards."""
+    from horovod_tpu.parallel import (ring_attention, zigzag_shard,
+                                      zigzag_unshard)
+    x = jnp.arange(2 * 1024 * 3, dtype=jnp.float32).reshape(2, 1024, 3)
+    for n in (2, 4):
+        np.testing.assert_array_equal(
+            np.asarray(zigzag_unshard(zigzag_shard(x, n), n)),
+            np.asarray(x))
+    q = jnp.zeros((1, 256, 2, 16), jnp.float32)
+    with pytest.raises(ValueError, match="causal"):
+        ring_attention(q, q, q, "sp", causal=False, schedule="zigzag")
+    with pytest.raises(ValueError, match="256"):
+        ring_attention(q[:, :128], q[:, :128], q[:, :128], "sp",
+                       causal=True, schedule="zigzag")
+    with pytest.raises(ValueError, match="unknown ring schedule"):
+        ring_attention(q, q, q, "sp", schedule="stripey")
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_flash_backward_multiblock(monkeypatch, causal):
     """Multi-block shards (1024/shard -> num_qb=4, num_kb=2): the
