@@ -33,6 +33,11 @@ class TransformerConfig:
     max_seq_len: int = 8192
     attention: str = "dense"      # dense | flash | ring | ulysses
     sp_axis: Optional[str] = None  # mesh axis holding the sequence shards
+    # Ring schedule: "zigzag" is the causal load-balanced layout
+    # (parallel.ring.zigzag_shard the tokens/positions/labels; the
+    # explicit global `positions` input makes rotary correct for any
+    # layout). Only meaningful with attention="ring".
+    sp_schedule: str = "contiguous"
     # Megatron-style tensor parallelism: when set, the module runs
     # inside shard_map with attention heads and the MLP hidden dim
     # sharded over this axis (num_heads/mlp_dim are the LOCAL sizes —
@@ -106,7 +111,8 @@ class Attention(nn.Module):
         k = _rotary(dense("key")(x), positions)
         v = dense("value")(x)
         if cfg.attention == "ring":
-            o = ring_attention(q, k, v, cfg.sp_axis, causal=True)
+            o = ring_attention(q, k, v, cfg.sp_axis, causal=True,
+                               schedule=cfg.sp_schedule)
         elif cfg.attention == "ulysses":
             o = ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
         elif cfg.attention == "flash":
