@@ -27,6 +27,20 @@ def run_example(np_, script, extra_args=(), timeout=420):
         env=env, timeout=timeout, capture_output=True, text=True)
 
 
+def run_mesh_example(script, steps, extra_env=None, timeout=420):
+    """Single-process example on the 8-device virtual CPU mesh."""
+    from conftest import clean_worker_env
+    env = clean_worker_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8")
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script),
+         "--steps", str(steps)],
+        env=env, timeout=timeout, capture_output=True, text=True)
+
+
 def test_torch_mnist_example():
     proc = run_example(2, "torch_mnist.py", ["--epochs", "1"])
     assert proc.returncode == 0, proc.stdout + proc.stderr
@@ -141,37 +155,29 @@ def test_jax_moe_lm_example():
     member of the parallelism family as a user writes it (sharded
     experts, all_to_all dispatch, aux loss in the objective, loss
     decreasing)."""
-    import subprocess
-
-    from conftest import clean_worker_env
-
-    env = clean_worker_env()
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
-                        " --xla_force_host_platform_device_count=8")
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "examples", "jax_moe_lm.py"),
-         "--steps", "6"],
-        env=env, timeout=420, capture_output=True, text=True)
+    proc = run_mesh_example("jax_moe_lm.py", 6)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "done" in proc.stdout
+
+
+def test_jax_zigzag_lm_example():
+    """Causal load-balanced sequence parallelism as a user writes it:
+    zigzag-shard the data, sp_schedule='zigzag', explicit gradient
+    psum — loss decreasing over 4 steps on a 4-way ring (Pallas
+    kernels in interpret mode)."""
+    proc = run_mesh_example("jax_zigzag_lm.py", 4, timeout=560,
+                            extra_env={"HVD_TPU_PALLAS_INTERPRET": "1"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "done" in proc.stdout
+    losses = [float(ln.split()[-1]) for ln in proc.stdout.splitlines()
+              if ln.startswith("step ")]
+    assert losses[-1] < losses[0]
 
 
 def test_jax_pp_lm_example():
     """Pipeline-parallel LM on a (dp x pp) mesh — the pp member as a
     user writes it, with the pinned pipeline gradient contract."""
-    import subprocess
-
-    from conftest import clean_worker_env
-
-    env = clean_worker_env()
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
-                        " --xla_force_host_platform_device_count=8")
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "examples", "jax_pp_lm.py"),
-         "--steps", "6"],
-        env=env, timeout=420, capture_output=True, text=True)
+    proc = run_mesh_example("jax_pp_lm.py", 6)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "done" in proc.stdout
 
@@ -179,17 +185,6 @@ def test_jax_pp_lm_example():
 def test_jax_fsdp_lm_example():
     """GSPMD FSDP LM — unmodified model code, sharded params/state,
     XLA-inserted collectives, loss decreasing."""
-    import subprocess
-
-    from conftest import clean_worker_env
-
-    env = clean_worker_env()
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
-                        " --xla_force_host_platform_device_count=8")
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "examples", "jax_fsdp_lm.py"),
-         "--steps", "6"],
-        env=env, timeout=420, capture_output=True, text=True)
+    proc = run_mesh_example("jax_fsdp_lm.py", 6)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "done" in proc.stdout
