@@ -520,8 +520,9 @@ def main():
         if 768 % args.num_heads or (768 // args.num_heads) % 64:
             ap.error("--num-heads must divide embed_dim=768 with a "
                      "64-multiple head_dim (the Pallas kernels need "
-                     "lane-tileable D); got H=%d -> D=%s"
-                     % (args.num_heads, 768 / args.num_heads))
+                     "lane-tileable D); got H=%d -> D=%d rem %d"
+                     % (args.num_heads, 768 // args.num_heads,
+                        768 % args.num_heads))
 
     if args.scaling_worker is not None:
         return scaling_worker(args)

@@ -7,7 +7,7 @@ params/statistics, static shapes.
 """
 
 from functools import partial
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -50,7 +50,7 @@ class _ConvBN(nn.Module):
     padding: Any = "SAME"
     dtype: Any = jnp.bfloat16
     norm: str = "batch"
-    bn_axis_name: str = None  # sync BN: psum stats over this mesh axis
+    bn_axis_name: Optional[str] = None  # sync BN: psum stats over this mesh axis
 
     @nn.compact
     def __call__(self, x, train):
@@ -79,7 +79,7 @@ class InceptionV3(nn.Module):
     norm: str = "batch"
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
-    bn_axis_name: str = None  # sync BN over this mesh axis
+    bn_axis_name: Optional[str] = None  # sync BN over this mesh axis
 
     @nn.compact
     def __call__(self, x, train: bool = True):

@@ -16,7 +16,7 @@ TPU-first choices (not inherited from the reference):
 """
 
 from functools import partial
-from typing import Any, Callable, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -91,7 +91,7 @@ class ResNet(nn.Module):
     # axis (both the flax and the Pallas norm paths support it). The
     # standard choice at small per-chip batch, where per-device BN
     # statistics get noisy.
-    bn_axis_name: str = None
+    bn_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):

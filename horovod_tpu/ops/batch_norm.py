@@ -57,9 +57,10 @@ def _pick_bm(M, C, itemsize, cap_bytes):
     across 53 BN layers fwd+bwd cost more than the fused read saved
     (measured 189 vs 110 ms/step on v5e). At 4 MB the stem is 98
     steps."""
-    # A (bm, C) block with C < 128 is still padded to 128 lanes in
-    # VMEM, so budget by the padded width.
-    cap_rows = max(8, cap_bytes // max(1, max(C, 128) * itemsize))
+    # VMEM pads the lane dim to the next 128 multiple (C=64 -> 128,
+    # C=288 -> 384), so budget by the padded width.
+    padded_c = ((C + 127) // 128) * 128
+    cap_rows = max(8, cap_bytes // (padded_c * itemsize))
     bm = 1
     while bm * 2 <= cap_rows and M % (bm * 2) == 0:
         bm *= 2
