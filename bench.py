@@ -278,6 +278,16 @@ _ZOO = [
     # lanes). Measured v5e: 36.4% vs 27.6% kernel-counted MFU.
     ("transformer", ["--seq-len", "8192", "--fused-xent",
                      "--tokens-batch", "2", "--num-heads", "6"]),
+    # Fused rotary alone (isolates the saved q/k HBM round trip), then
+    # GQA G=2 on top (kv projections a third the size, grouped-rows
+    # kernel layout) — the modern-LM kernel surface at the same
+    # long-context shape as the h6 row above.
+    ("transformer", ["--seq-len", "8192", "--fused-xent",
+                     "--tokens-batch", "2", "--num-heads", "6",
+                     "--fused-rope"]),
+    ("transformer", ["--seq-len", "8192", "--fused-xent",
+                     "--tokens-batch", "2", "--num-heads", "6",
+                     "--num-kv-heads", "2", "--fused-rope"]),
 ]
 
 
@@ -484,6 +494,16 @@ def main():
                          "heads — identical FLOPs to GPT-2's 12xD64 but "
                          "full MXU width (D=64 caps every attention "
                          "matmul at half the systolic array)")
+    ap.add_argument("--num-kv-heads", type=int, default=0,
+                    help="transformer GQA/MQA: kv heads < query heads "
+                         "(0 = plain MHA). Shrinks the k/v projections "
+                         "and runs the flash kernels' grouped-rows "
+                         "layout (one kv fetch per query-head group, "
+                         "in-kernel dK/dV group reduction)")
+    ap.add_argument("--fused-rope", action="store_true",
+                    help="fuse rotary embedding into the flash kernels' "
+                         "q/k load path (saves the HBM round trip of "
+                         "writing rotated q/k outside the kernel)")
     ap.add_argument("--zero1", action="store_true",
                     help="ZeRO-1 optimizer-state sharding in the train "
                          "step (parallel/train.py) - state memory/n, "
@@ -523,6 +543,9 @@ def main():
                      "lane-tileable D); got H=%d -> D=%d rem %d"
                      % (args.num_heads, 768 // args.num_heads,
                         768 % args.num_heads))
+        if args.num_kv_heads and args.num_heads % args.num_kv_heads:
+            ap.error("--num-kv-heads must divide --num-heads; got "
+                     "G=%d, H=%d" % (args.num_kv_heads, args.num_heads))
 
     if args.scaling_worker is not None:
         return scaling_worker(args)
@@ -568,6 +591,8 @@ def main():
                        moe_capacity_factor=1.25)
         cfg = models.TransformerConfig(
             vocab_size=32000, num_layers=12, num_heads=args.num_heads,
+            num_kv_heads=args.num_kv_heads or None,
+            rope_fused=args.fused_rope,
             embed_dim=768, mlp_dim=3072, attention="flash",
             dtype=jnp.bfloat16, max_seq_len=max(8192, args.seq_len),
             **moe)
@@ -710,6 +735,10 @@ def main():
             label = "transformer_moe%d" % args.moe_experts
         if args.num_heads != 12:
             label += "_h%d" % args.num_heads
+        if args.num_kv_heads:
+            label += "_gqa%d" % args.num_kv_heads
+        if args.fused_rope:
+            label += "_frope"
         out = {
             "metric": "%s_flash_L%d_sequences_per_sec_per_chip"
                       % (label, args.seq_len),

@@ -6,6 +6,10 @@ differ. Sweeps (block_q, block_k) for fwd and fwd+bwd with the
 single-dispatch lax.scan recipe and prints a table.
 
 Usage: python examples/flash_block_sweep.py [--B 8 --L 2048 --H 12 --D 64]
+GQA/MQA (--G < --H) sweeps the grouped-rows layout: the q-block
+candidates become bqp*group rows (the `_grouped_blocks` policy was
+tuned from this sweep at B2 H6 G2 L8192 D128 — grouped layouts want
+bigger row blocks and bk=512).
 """
 
 import argparse
@@ -50,23 +54,32 @@ def main():
     ap.add_argument("--B", type=int, default=8)
     ap.add_argument("--L", type=int, default=2048)
     ap.add_argument("--H", type=int, default=12)
+    ap.add_argument("--G", type=int, default=0,
+                    help="kv heads (GQA/MQA; 0 = H, plain MHA). The "
+                         "q-block candidates become bqp*group rows in "
+                         "the grouped layout")
     ap.add_argument("--D", type=int, default=64)
     ap.add_argument("--iters", type=int, default=30)
     args = ap.parse_args()
     B, L, H, D = args.B, args.L, args.H, args.D
+    G = args.G or H
+    group = H // G
 
     rng = np.random.RandomState(0)
     q = jnp.asarray(rng.randn(B, H, L, D), jnp.bfloat16)
-    k = jnp.asarray(rng.randn(B, H, L, D), jnp.bfloat16)
-    v = jnp.asarray(rng.randn(B, H, L, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, G, L, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, G, L, D), jnp.bfloat16)
     g = jnp.asarray(rng.randn(B, H, L, D), jnp.bfloat16)
     scale = D ** -0.5
+    rows = L * group
 
-    print("shape B=%d L=%d H=%d D=%d (kernel layout)" % (B, L, H, D))
+    print("shape B=%d L=%d H=%d G=%d D=%d (kernel layout, %d rows/slab)"
+          % (B, L, H, G, D, rows))
     print("%8s %8s | %9s | %9s" % ("bq", "bk", "fwd ms", "fwd+bwd ms"))
-    for bq in (128, 256, 512):
+    for bqp in (128, 256, 512):
+        bq = bqp * group
         for bk in (256, 512, 1024):
-            if L % bq or L % bk:
+            if rows % bq or L % bk or L % bqp:
                 continue
             try:
                 fwd = functools.partial(
@@ -79,7 +92,11 @@ def main():
                         q, k, v, scale, True, False, bq, bk)
                     dq, dk, dv = fa._pallas_backward(
                         q, k, v, out, lse, g, scale, True, False, bq, bk)
-                    return dq + dk + dv
+                    # All three grads live (dq/dk shapes differ under
+                    # GQA; a dead output would let XLA drop a kernel).
+                    return (jnp.sum(dq.astype(jnp.float32)) +
+                            jnp.sum(dk.astype(jnp.float32)) +
+                            jnp.sum(dv.astype(jnp.float32)))
 
                 t_fb = timed(lambda q: fb(q, k, v, g), (q,), args.iters)
                 print("%8d %8d | %9.3f | %9.3f" %

@@ -32,6 +32,19 @@ class TransformerConfig:
     mlp_dim: int = 3072
     max_seq_len: int = 8192
     attention: str = "dense"      # dense | flash | ring | ulysses
+    # GQA/MQA: number of kv heads (None = num_heads, plain MHA). Must
+    # divide num_heads; query head h reads kv head h // (H//G) — the
+    # llama convention. Shrinks the k/v projections and lets the flash
+    # kernels run the grouped-rows layout (one kv fetch per head
+    # group, in-kernel dK/dV group reduction).
+    num_kv_heads: Optional[int] = None
+    # Fuse rotary embedding into the flash/ring/ulysses kernels' q/k
+    # load path (positions derived in-kernel from global offsets —
+    # the explicit `positions` input is then unused by attention, so
+    # it only works for the standard layouts those offsets describe).
+    # The dense path always rotates outside.
+    rope_fused: bool = False
+    rope_base: float = 10000.0
     sp_axis: Optional[str] = None  # mesh axis holding the sequence shards
     # Ring schedule: "zigzag" is the causal load-balanced layout
     # (parallel.ring.zigzag_shard the tokens/positions/labels; the
@@ -77,17 +90,26 @@ class TransformerConfig:
             raise ValueError(
                 "tp_size=%d must divide both num_heads=%d and "
                 "mlp_dim=%d" % (tp_size, self.num_heads, self.mlp_dim))
+        kv = self.num_kv_heads
+        if kv is not None:
+            if kv % tp_size:
+                raise ValueError(
+                    "tp_size=%d must divide num_kv_heads=%d (tensor "
+                    "parallelism shards the kv heads too)"
+                    % (tp_size, kv))
+            kv = kv // tp_size
         return dataclasses.replace(
             self, num_heads=self.num_heads // tp_size,
+            num_kv_heads=kv,
             mlp_dim=self.mlp_dim // tp_size,
             head_dim=self.head_dim or self.embed_dim // self.num_heads)
 
 
-def _rotary(x, positions):
+def _rotary(x, positions, base=10000.0):
     """Rotary embedding over the last dim; positions [B, L] global."""
     d = x.shape[-1]
     half = d // 2
-    freq = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    freq = 1.0 / (base ** (jnp.arange(half, dtype=jnp.float32) / half))
     ang = positions[..., None].astype(jnp.float32) * freq  # [B, L, half]
     ang = ang[:, :, None, :]                               # [B, L, 1, half]
     cos, sin = jnp.cos(ang), jnp.sin(ang)
@@ -104,21 +126,36 @@ class Attention(nn.Module):
     def __call__(self, x, positions):
         cfg = self.cfg
         head_dim = cfg.head_dim or cfg.embed_dim // cfg.num_heads
-        dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            (cfg.num_heads, head_dim), dtype=cfg.dtype,
+        G = cfg.num_kv_heads or cfg.num_heads
+        if cfg.num_heads % G:
+            raise ValueError(
+                "num_kv_heads=%d must divide num_heads=%d"
+                % (G, cfg.num_heads))
+        heads = lambda n, name: nn.DenseGeneral(  # noqa: E731
+            (n, head_dim), dtype=cfg.dtype,
             param_dtype=jnp.float32, use_bias=False, name=name)
-        q = _rotary(dense("query")(x), positions)
-        k = _rotary(dense("key")(x), positions)
-        v = dense("value")(x)
+        q = heads(cfg.num_heads, "query")(x)
+        k = heads(G, "key")(x)
+        v = heads(G, "value")(x)
+        fused = (cfg.rope_fused and
+                 cfg.attention in ("flash", "ring", "ulysses"))
+        if not fused:
+            q = _rotary(q, positions, cfg.rope_base)
+            k = _rotary(k, positions, cfg.rope_base)
+        rb = cfg.rope_base if fused else None
         if cfg.attention == "ring":
             o = ring_attention(q, k, v, cfg.sp_axis, causal=True,
-                               schedule=cfg.sp_schedule)
+                               schedule=cfg.sp_schedule, rotary_base=rb)
         elif cfg.attention == "ulysses":
-            o = ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
+            o = ulysses_attention(q, k, v, cfg.sp_axis, causal=True,
+                                  rotary_base=rb)
         elif cfg.attention == "flash":
             from horovod_tpu.ops import flash_attention
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention(q, k, v, causal=True, rotary_base=rb)
         else:
+            if G != cfg.num_heads:
+                k = jnp.repeat(k, cfg.num_heads // G, axis=2)
+                v = jnp.repeat(v, cfg.num_heads // G, axis=2)
             s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                            preferred_element_type=jnp.float32)
             s = s * (head_dim ** -0.5)

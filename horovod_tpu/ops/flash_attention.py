@@ -1,6 +1,6 @@
 """Flash attention as a Pallas TPU kernel.
 
-Forward: a (batch*head, q-block, k-block) grid. The k dimension is the
+Forward: a (batch*kv_head, q-block, k-block) grid. The k dimension is the
 innermost sequential axis: each step's k/v block is streamed HBM->VMEM by
 the Pallas pipeline (double-buffered against the MXU work of the previous
 block), while the online-softmax state (acc, running max, running sum)
@@ -11,13 +11,35 @@ the fetch (index maps clamp above-diagonal steps to the frontier
 block; Pallas elides the DMA for a revisited block index) of k-blocks
 above the diagonal — at long L this halves attention HBM traffic.
 
-Backward: custom VJP that recomputes attention blockwise over q in plain
-JAX (O(BLOCK_Q * L) live memory) — XLA fuses it well, and it keeps the
-kernel surface small. The softmax statistics are not saved; stability
-comes from a fresh log-sum-exp per block.
+GQA/MQA (num_kv_heads < num_heads) uses a grouped-rows layout: the
+`group = H / G` query heads sharing one kv head are interleaved into the
+q rows (row r of kv-head g's [L*group, D] slab is position r//group,
+head g*group + r%group). One kv block then serves the whole group per
+fetch, k/v is never materialized at H heads (the HBM win that motivates
+GQA), and dK/dV accumulate the group reduction inside the kernel instead
+of a [B,H,L,D] gradient plus a post-hoc sum. The only kernel change is
+that row positions are `row // group` — masks, frontier clamps and
+block-skip predicates all run in position units.
 
-On non-TPU backends the same kernel runs in Pallas interpret mode (tests)
-or falls back to the blockwise JAX implementation.
+Rotary embedding can be fused into the kernels (`rotary_base`), which
+removes the HBM round trip of writing rotated q/k outside the kernel.
+The cos/sin terms are NOT computed in-kernel: transcendentals plus the
+half-pair shuffle on every block visit serialize the VPU ahead of each
+MXU step and measured ~2x whole-kernel cost at L=8192. Instead the
+caller builds full-width (C, S) tables once per call (f32, sign folded
+into S; XLA CSEs them across layers) and the kernels stream table
+blocks through the same index maps as q/k — per-visit work drops to
+one lane-roll + 2 mul + 1 add (`_rot_apply`), and rotated q is cached
+in VMEM scratch for the whole k sweep. Rotation is linear-orthogonal
+per row, so the backward kernels rotate q/k the same way to recompute
+scores and counter-rotate finished dQ/dK blocks (the S sign flips —
+see `_rot_apply(neg=True)`) at finalize. The ring-step kernels instead
+accumulate gradients in rotated space across ring steps; the caller
+counter-rotates once after the last step (`apply_rotary(neg=True)`).
+
+Backward: custom VJP over saved per-row log-sum-exp (FlashAttention-2
+style). On non-TPU backends the same kernels run in Pallas interpret
+mode (tests) or fall back to the blockwise JAX implementation.
 """
 
 import functools
@@ -32,25 +54,93 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _masked_scores(q_ref, k_ref, scale, causal, q_off, kv_off, fill):
-    """s = (q.k^T)*scale with causal masking by global row/col offsets.
-    Only blocks straddling the diagonal pay the elementwise mask pass
-    (the kernels are VPU-bound, every pass counts); `fill` is -inf for
-    scores, 0 for probabilities."""
-    block_q, block_k = q_ref.shape[0], k_ref.shape[0]
+def apply_rotary(x, positions, base=10000.0, neg=False):
+    """Rotary embedding outside the kernels (jnp fallbacks, ring
+    gradient counter-rotation). ``positions`` must be broadcastable to
+    ``x.shape[:-1]``; pairs are (d, d + D/2) — the same convention as
+    the in-kernel table path and `models.transformer._rotary`.
+    ``neg=True`` applies the transpose rotation R(-pos) (the gradient
+    counter-rotation)."""
+    D = x.shape[-1]
+    half = D // 2
+    inv = base ** (-jnp.arange(half, dtype=jnp.float32) * 2.0 / D)
+    ang = positions[..., None].astype(jnp.float32) * inv
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    if neg:
+        sin = -sin
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+def _rope_tables(positions, D, base):
+    """Full-width rotary tables for the kernels: (C, S) [R, D] f32 with
+    C[r, j] = cos(pos_r * inv_freq[j mod D/2]) and the application sign
+    baked into S (= [-sin | +sin]), so the in-kernel work is
+    x * C + roll(x, D/2) * S — no transcendentals, no half-pair
+    slicing. Built once per call; XLA CSEs identical tables across
+    layers."""
+    half = D // 2
+    inv = base ** (-jnp.arange(half, dtype=jnp.float32) * 2.0 / D)
+    ang = positions[:, None].astype(jnp.float32) * inv  # [R, half]
+    c = jnp.cos(ang)
+    s = jnp.sin(ang)
+    return (jnp.concatenate([c, c], axis=-1),
+            jnp.concatenate([-s, s], axis=-1))
+
+
+def _rot_apply(x, cos_ref, sin_ref, neg=False):
+    """Rotate a [R, D] block by streamed tables: each row's pair
+    partner sits half a lane-width away, fetched with one lane-roll.
+    ``neg=True`` is the transpose rotation (gradient counter-rotation;
+    for the baked-sign tables that is exactly an S sign flip)."""
+    xf = x.astype(jnp.float32)
+    partner = pltpu.roll(xf, x.shape[-1] // 2, 1)
+    ps = partner * sin_ref[...]
+    out = xf * cos_ref[...] + (-ps if neg else ps)
+    return out.astype(x.dtype)
+
+
+def _to_rows(x, group):
+    """[B, H, L, D] (H = G*group) -> grouped kernel layout
+    [B*G, L*group, D], row = pos*group + u for head g*group + u."""
+    B, H, L, D = x.shape
+    G = H // group
+    return (x.reshape(B, G, group, L, D).transpose(0, 1, 3, 2, 4)
+            .reshape(B * G, L * group, D))
+
+
+def _from_rows(x, B, group):
+    """Inverse of `_to_rows`: [B*G, L*group, D] -> [B, G*group, L, D]."""
+    BG, R, D = x.shape
+    G = BG // B
+    L = R // group
+    return (x.reshape(B, G, L, group, D).transpose(0, 1, 3, 2, 4)
+            .reshape(B, G * group, L, D))
+
+
+def _masked_scores(q, k, scale, causal, q_off, kv_off, fill, group=1):
+    """s = (q.k^T)*scale with causal masking by global positions: q row
+    r is position q_off + r//group (grouped GQA layout; group=1 is the
+    plain layout). Only blocks straddling the diagonal pay the
+    elementwise mask pass (the kernels are VPU-bound, every pass
+    counts); `fill` is -inf for scores, 0 for probabilities."""
+    block_q, block_k = q.shape[0], k.shape[0]
     s = jax.lax.dot_general(
-        q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
+        q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # [BQ, BK]
     if not causal:
         return s
 
     def _mask(s):
-        rows = q_off + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
+        riota = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        rows = q_off + (riota // group if group > 1 else riota)
         cols = kv_off + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         return jnp.where(rows >= cols, s, fill)
 
+    # q_off is the POSITION of the block's first row.
     straddles = kv_off + (block_k - 1) > q_off
     return jax.lax.cond(straddles, _mask, lambda s: s, s)
 
@@ -77,31 +167,46 @@ def _online_softmax_update(s, v_ref, acc_ref, m_ref, l_ref, guard_empty):
         preferred_element_type=jnp.float32)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                l_ref, *, scale, causal, num_kb):
+def _fwd_kernel(*refs, scale, causal, num_kb, bqp, group, rotary):
     # q_ref: [BQ, D]; k_ref/v_ref: [BK, D]; o_ref: [BQ, D];
-    # scratch: acc [BQ, D] f32, m/l [BQ, 128] f32 (state across k steps).
+    # scratch: acc [BQ, D] f32, m/l [BQ, 128] f32 (state across k steps)
+    # + qrot [BQ, D] under fused rotary (q rotated ONCE per q block at
+    # kj==0). bqp = BQ // group: positions per q block (grouped GQA).
+    if rotary:
+        (q_ref, k_ref, v_ref, qc_ref, qs_ref, kc_ref, ks_ref, o_ref,
+         lse_ref, acc_ref, m_ref, l_ref, qrot_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+         l_ref) = refs
     qi = pl.program_id(1)
     kj = pl.program_id(2)
-    block_q, block_k = q_ref.shape[0], k_ref.shape[0]
+    block_k = k_ref.shape[0]
 
     @pl.when(kj == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
         m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
         l_ref[...] = jnp.zeros_like(l_ref)
+        if rotary:
+            qrot_ref[...] = _rot_apply(q_ref[...], qc_ref, qs_ref)
 
     # Causal: skip the compute (the fetch is pipelined regardless) of
-    # k-blocks entirely above the diagonal.
-    visible = (kj * block_k < (qi + 1) * block_q) if causal else kj >= 0
+    # k-blocks entirely above the diagonal. Position units.
+    visible = (kj * block_k < (qi + 1) * bqp) if causal else kj >= 0
 
     @pl.when(visible)
     def _compute():
         # Matmuls take the inputs' native (bf16) dtype — the MXU's fast
         # path — and accumulate in f32; only softmax runs in f32.
-        s = _masked_scores(q_ref, k_ref, scale, causal,
-                           q_off=qi * block_q, kv_off=kj * block_k,
-                           fill=-jnp.inf)
+        if rotary:
+            q = qrot_ref[...]
+            k = _rot_apply(k_ref[...], kc_ref, ks_ref)
+        else:
+            q = q_ref[...]
+            k = k_ref[...]
+        s = _masked_scores(q, k, scale, causal,
+                           q_off=qi * bqp, kv_off=kj * block_k,
+                           fill=-jnp.inf, group=group)
         _online_softmax_update(s, v_ref, acc_ref, m_ref, l_ref,
                                guard_empty=False)
 
@@ -120,6 +225,38 @@ def _pick_block(L, preferred):
         if b <= preferred and L % b == 0:
             return b
     return None
+
+
+def _pick_rows_block(L, preferred, group):
+    """Row-block size. group=1: the plain picker. Grouped GQA layouts
+    pick `bqp` positions * `group` interleaved head rows with bqp | L
+    and total rows at most the preference (for grouped layouts that is
+    `_grouped_blocks`' row cap, swept separately from the plain row
+    budgets); bqp >= 8 keeps the resulting rows a sublane multiple for
+    any group."""
+    if group == 1:
+        return _pick_block(L, preferred)
+    for bqp in (512, 256, 128, 64, 32, 16, 8):
+        if bqp * group <= preferred and L % bqp == 0:
+            return bqp * group
+    return None
+
+
+def _grouped_blocks(D, L, group, backward=False):
+    """(rows_cap, block_k) for grouped-GQA layouts. v5e sweep at the
+    h6/G=2 shape (group=3, D=128, L=8192; reproducible via
+    examples/flash_block_sweep.py --G 2): grouped blocks want MORE rows
+    and a NARROWER k block than the plain policy — fwd 1536/512 beats
+    the plain-cap 384/512 by 10% AND plain MHA itself by 1.4%; bwd
+    1536/512 is 22% under the plain-cap pick and 19% under plain MHA
+    (the in-kernel dK/dV group reduction writes G instead of H heads).
+    Shapes without sweep data (D<=64 or short L) keep the conservative
+    plain-preference cap."""
+    pq, pk = _default_blocks(D, L, backward)
+    long_seq = L is not None and L >= 4096
+    if group > 1 and D > 64 and long_seq:
+        return 1536, (512 if L % 512 == 0 else pk)
+    return pq, pk
 
 
 def _default_blocks(D, L=None, backward=False):
@@ -143,114 +280,164 @@ def _default_blocks(D, L=None, backward=False):
     return (256, 512)
 
 
-def _kv_index_map(bq, bk, causal):
-    """k/v BlockSpec index map for grids with k innermost. Causal runs
-    clamp the k-block index to the diagonal frontier: steps above the
-    diagonal revisit the frontier block, and Pallas skips the DMA for a
-    revisited index — halving k/v HBM traffic at long L (the compute is
-    separately gated by `pl.when(visible)`)."""
+def _kv_index_map(bqp, bk, causal, rank2=False):
+    """k/v BlockSpec index map for grids with k innermost (position
+    units: bqp = positions per q block). Causal runs clamp the k-block
+    index to the diagonal frontier: steps above the diagonal revisit
+    the frontier block, and Pallas skips the DMA for a revisited index
+    — halving k/v HBM traffic at long L (the compute is separately
+    gated by `pl.when(visible)`). ``rank2`` drops the batch coordinate
+    (the rotary tables have no batch dim)."""
     if not causal:
+        if rank2:
+            return lambda b, i, j: (j, 0)
         return lambda b, i, j: (b, j, 0)
-    return lambda b, i, j: (b, jnp.minimum(j, ((i + 1) * bq - 1) // bk), 0)
+    if rank2:
+        return lambda b, i, j: (jnp.minimum(j, ((i + 1) * bqp - 1) // bk), 0)
+    return lambda b, i, j: (b, jnp.minimum(j, ((i + 1) * bqp - 1) // bk), 0)
 
 
-def _q_index_map(bq, bk, causal):
+def _q_index_map(bqp, bk, causal, rank2=False):
     """q-side BlockSpec index map for the dk/dv grid (q innermost).
     Causal runs clamp the q-block index UP to the first block at or
-    below the diagonal (qi_min = (kj*bk)//bq): the leading invisible
-    steps revisit that block, skipping their DMA."""
+    below the diagonal (qi_min = (kj*bk)//bqp, position units): the
+    leading invisible steps revisit that block, skipping their DMA."""
     if not causal:
+        if rank2:
+            return lambda b, j, i: (i, 0)
         return lambda b, j, i: (b, i, 0)
-    return lambda b, j, i: (b, jnp.maximum(i, (j * bk) // bq), 0)
+    if rank2:
+        return lambda b, j, i: (jnp.maximum(i, (j * bk) // bqp), 0)
+    return lambda b, j, i: (b, jnp.maximum(i, (j * bk) // bqp), 0)
 
 
-def _require_block(L, preferred, what):
-    b = _pick_block(L, preferred)
+def _require_rows_block(L, preferred, group, what):
+    b = _pick_rows_block(L, preferred, group)
     if b is None:
         raise ValueError(
-            f"{what}={L} must be a multiple of 128 for the Pallas ring "
-            f"kernels (got {L} % 128 == {L % 128}); pad the sequence "
-            "shard or use the jnp ring path")
+            f"{what}={L} must be a multiple of 128 (or of 8*group for "
+            f"grouped kv heads, group={group}) for the Pallas ring "
+            f"kernels; pad the sequence shard or use the jnp ring path")
     return b
 
 
+def _check_blocks(rows, L, bq, bk, group):
+    """Fail loudly on block sizes that do not tile the arrays: a
+    Pallas grid of rows//bq steps silently TRUNCATES coverage when bq
+    does not divide the row count (observed in a block sweep — wrong
+    results that look fast)."""
+    if not bq or not bk or rows % bq or L % bk or bq % group:
+        raise ValueError(
+            f"invalid flash blocks: block_q={bq} must divide "
+            f"rows={rows} and be a multiple of group={group}; "
+            f"block_k={bk} must divide the kv length {L}")
+
+
+def _row_positions(L, group):
+    """Positions of the grouped-rows layout's rows for a full sequence
+    starting at 0: row r = pos*group + u -> position r//group."""
+    return jnp.repeat(jnp.arange(L, dtype=jnp.int32), group)
+
+
 def _pallas_forward_lse(q, k, v, scale, causal, interpret,
-                        block_q=None, block_k=None):
-    """Returns (out [B,H,L,D], lse [B*H, L, 8] f32) — lse is the
-    per-row log-sum-exp the backward kernels need (replicated over a
-    8-wide trailing dim: keeps the block Mosaic-tileable and the DMA a
-    contiguous stripe; 1-wide measured slower, 128-wide wastes 16x the
-    memory)."""
-    # q,k,v: [B, H, L, D]
+                        block_q=None, block_k=None, rotary_base=None):
+    """q [B, H, L, D], k/v [B, G, L, D] with G | H. Returns
+    (out [B,H,L,D], lse [B*G, L*group, 8] f32) — lse is the per-row
+    log-sum-exp the backward kernels need, in the grouped-rows layout
+    (replicated over an 8-wide trailing dim: keeps the block
+    Mosaic-tileable and the DMA a contiguous stripe; 1-wide measured
+    slower, 128-wide wastes 16x the memory)."""
     B, H, L, D = q.shape
-    qf = q.reshape(B * H, L, D)
-    kf = k.reshape(B * H, L, D)
-    vf = v.reshape(B * H, L, D)
+    G = k.shape[1]
+    group = H // G
+    qf = _to_rows(q, group)
+    kf = k.reshape(B * G, L, D)
+    vf = v.reshape(B * G, L, D)
 
     # Bigger blocks amortize per-grid-step overhead (the MXU work per
     # step is tiny); bounded so s [BQ, BK] and the double-buffered k/v
     # blocks stay well inside VMEM. Preferences are D-aware — see
     # _default_blocks.
-    pq, pk = _default_blocks(D, L)
-    bq = block_q or _pick_block(L, pq)
+    pq, pk = _grouped_blocks(D, L, group)
+    bq = block_q or _pick_rows_block(L, pq, group)
     bk = block_k or _pick_block(L, pk)
+    rows = L * group
+    _check_blocks(rows, L, bq, bk, group)
+    bqp = bq // group
     num_kb = L // bk
+    rotary = rotary_base is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               num_kb=num_kb)
-    grid = (B * H, L // bq, num_kb)
-    kv_im = _kv_index_map(bq, bk, causal)
+                               num_kb=num_kb, bqp=bqp, group=group,
+                               rotary=rotary)
+    grid = (B * G, rows // bq, num_kb)
+    kv_im = _kv_index_map(bqp, bk, causal)
+    q_spec = pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0))
+    in_specs = [q_spec,
+                pl.BlockSpec((None, bk, D), kv_im),
+                pl.BlockSpec((None, bk, D), kv_im)]
+    inputs = [qf, kf, vf]
+    if rotary:
+        qc, qs = _rope_tables(_row_positions(L, group), D, rotary_base)
+        kc, ks = _rope_tables(jnp.arange(L, dtype=jnp.int32), D,
+                              rotary_base)
+        tq_spec = pl.BlockSpec((bq, D), lambda b, i, j: (i, 0))
+        tk_spec = pl.BlockSpec((bk, D),
+                               _kv_index_map(bqp, bk, causal, rank2=True))
+        in_specs += [tq_spec, tq_spec, tk_spec, tk_spec]
+        inputs += [qc, qs, kc, ks]
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, bk, D), kv_im),
-            pl.BlockSpec((None, bk, D), kv_im),
-        ],
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            q_spec,
             pl.BlockSpec((None, bq, 8), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, L, 8), jnp.float32),
+            jax.ShapeDtypeStruct((B * G, rows, D), q.dtype),
+            jax.ShapeDtypeStruct((B * G, rows, 8), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
-        ],
+        ] + ([pltpu.VMEM((bq, D), q.dtype)] if rotary else []),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(B, H, L, D), lse
+    )(*inputs)
+    return _from_rows(out, B, group), lse
 
 
 def _pallas_forward(q, k, v, scale, causal, interpret,
-                    block_q=None, block_k=None):
+                    block_q=None, block_k=None, rotary_base=None):
     return _pallas_forward_lse(q, k, v, scale, causal, interpret,
-                               block_q, block_k)[0]
+                               block_q, block_k, rotary_base)[0]
 
 
-def _ring_step_kernel(q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref,
-                      oi_ref, mi_ref, li_ref, oo_ref, mo_ref, lo_ref,
-                      acc_ref, m_ref, l_ref, *, scale, causal, num_kb):
+def _ring_step_kernel(*refs, scale, causal, num_kb, bqp, group, rotary):
     """One ring-attention step as a flash kernel with carried state.
 
     Same online-softmax update as `_fwd_kernel`, but the (acc, m, l)
     state is loaded from the previous ring step's outputs instead of
     initialized, and written back un-normalized (the caller divides by l
     after the last ring step). Causal masking uses *global* token
-    offsets — PER-BLOCK arrays in SMEM (q_offs_ref[qi], kv_offs_ref[kj])
-    rather than one scalar per shard, so a shard may hold discontiguous
-    sequence chunks (the zigzag causal schedule) as long as chunk
-    boundaries align with block boundaries. Block skipping is dynamic
-    for the same reason.
+    offsets — PER-BLOCK arrays in SMEM (q_offs_ref[qi], kv_offs_ref[kj],
+    position units) rather than one scalar per shard, so a shard may
+    hold discontiguous sequence chunks (the zigzag causal schedule) as
+    long as chunk boundaries align with block boundaries. Block skipping
+    is dynamic for the same reason. Fused rotary streams shard-global
+    (C, S) tables built by the caller from the same offsets.
     """
+    if rotary:
+        (q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref, qc_ref, qs_ref,
+         kc_ref, ks_ref, oi_ref, mi_ref, li_ref, oo_ref, mo_ref, lo_ref,
+         acc_ref, m_ref, l_ref, qrot_ref) = refs
+    else:
+        (q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref, oi_ref, mi_ref,
+         li_ref, oo_ref, mo_ref, lo_ref, acc_ref, m_ref, l_ref) = refs
     qi = pl.program_id(1)
     kj = pl.program_id(2)
-    block_q, block_k = q_ref.shape[0], k_ref.shape[0]
     q_off = q_offs_ref[qi]
     kv_off = kv_offs_ref[kj]
 
@@ -259,14 +446,22 @@ def _ring_step_kernel(q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref,
         acc_ref[...] = oi_ref[...]
         m_ref[...] = jnp.broadcast_to(mi_ref[:, :1], m_ref.shape)
         l_ref[...] = jnp.broadcast_to(li_ref[:, :1], l_ref.shape)
+        if rotary:
+            qrot_ref[...] = _rot_apply(q_ref[...], qc_ref, qs_ref)
 
     # A k/v block entirely in this q block's future contributes nothing.
-    visible = (kv_off <= q_off + block_q - 1) if causal else kj >= 0
+    visible = (kv_off <= q_off + bqp - 1) if causal else kj >= 0
 
     @pl.when(visible)
     def _compute():
-        s = _masked_scores(q_ref, k_ref, scale, causal, q_off=q_off,
-                           kv_off=kv_off, fill=-jnp.inf)
+        if rotary:
+            q = qrot_ref[...]
+            k = _rot_apply(k_ref[...], kc_ref, ks_ref)
+        else:
+            q = q_ref[...]
+            k = k_ref[...]
+        s = _masked_scores(q, k, scale, causal, q_off=q_off,
+                           kv_off=kv_off, fill=-jnp.inf, group=group)
         _online_softmax_update(s, v_ref, acc_ref, m_ref, l_ref,
                                guard_empty=True)
 
@@ -293,7 +488,7 @@ def _block_offsets(offset, L, blk):
     """Per-block global offsets (L // blk,) int32 from a scalar shard
     offset or a 1-D array of per-chunk offsets (equal chunks whose
     length must be a multiple of blk — blocks may not straddle chunk
-    boundaries)."""
+    boundaries). Position units throughout."""
     off = jnp.asarray(offset, jnp.int32)
     pos = jnp.arange(L // blk, dtype=jnp.int32) * blk
     if off.ndim == 0:
@@ -301,7 +496,7 @@ def _block_offsets(offset, L, blk):
     Lc = L // off.shape[0]
     if Lc % blk:
         # Reachable only via an explicit block_q/block_k override that
-        # bypasses the _require_block(chunk_len, ...) pick: a block
+        # bypasses the _require_rows_block(chunk_len, ...) pick: a block
         # spanning two discontiguous chunks would get one (wrong)
         # offset and silently mis-mask.
         raise ValueError(
@@ -311,96 +506,150 @@ def _block_offsets(offset, L, blk):
     return off[pos // Lc] + pos % Lc
 
 
+def shard_positions(offset, L):
+    """Global positions [L] of a shard described by a scalar offset or
+    a 1-D array of per-chunk offsets (the `_block_offsets` convention);
+    used for the ring path's rotary tables and post-loop
+    counter-rotation."""
+    off = jnp.asarray(offset, jnp.int32)
+    if off.ndim == 0:
+        return off + jnp.arange(L, dtype=jnp.int32)
+    Lc = L // off.shape[0]
+    return (off[:, None] +
+            jnp.arange(Lc, dtype=jnp.int32)[None]).reshape(-1)
+
+
+def _ring_tables(q_offset, kv_offset, Lq, Lk, D, group, rotary_base):
+    """(qc, qs, kc, ks) rotary tables for one ring step, from the
+    shard/chunk offsets (shard-global positions; q in grouped-rows
+    order)."""
+    qpos = jnp.repeat(shard_positions(q_offset, Lq), group)
+    kpos = shard_positions(kv_offset, Lk)
+    qc, qs = _rope_tables(qpos, D, rotary_base)
+    kc, ks = _rope_tables(kpos, D, rotary_base)
+    return qc, qs, kc, ks
+
+
 def flash_ring_step(q, k, v, o, m, l, q_offset, kv_offset, causal=True,
                     scale=None, interpret=False, block_q=None,
-                    block_k=None):
+                    block_k=None, group=1, rotary_base=None):
     """One ring-attention local step over kernel-layout shards.
 
-    Args: q [BH, Lq, D] (bf16/f32), k/v [BH, Lk, D], carried state
-    o [BH, Lq, D] f32 (un-normalized accumulator), m/l [BH, Lq, 8] f32
-    (running max / normalizer stripes), q_offset/kv_offset global token
-    offsets — traced int32 scalars (contiguous shards), or 1-D arrays
-    of per-chunk offsets for shards holding several equal discontiguous
-    chunks (the zigzag causal schedule). Returns updated (o, m, l).
-    """
-    BH, Lq, D = q.shape
+    Args: q [BG, Lq*group, D] grouped-rows layout (bf16/f32; group=1 is
+    the plain [B*H, Lq, D] layout), k/v [BG, Lk, D], carried state
+    o [BG, Lq*group, D] f32 (un-normalized accumulator), m/l
+    [BG, Lq*group, 8] f32 (running max / normalizer stripes),
+    q_offset/kv_offset global token POSITION offsets — traced int32
+    scalars (contiguous shards), or 1-D arrays of per-chunk offsets for
+    shards holding several equal discontiguous chunks (the zigzag
+    causal schedule). Returns updated (o, m, l)."""
+    BG, rows, D = q.shape
+    Lq = rows // group
     Lk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
     Lcq = _chunk_len(Lq, q_offset, "q_offset")
     Lck = _chunk_len(Lk, kv_offset, "kv_offset")
-    pq, pk = _default_blocks(D, Lq)
-    bq = block_q or _require_block(Lcq, pq, "q chunk length")
-    bk = block_k or _require_block(Lck, pk, "k/v chunk length")
+    pq, pk = _grouped_blocks(D, Lq, group)
+    bq = block_q or _require_rows_block(Lcq, pq, group, "q chunk length")
+    bk = block_k or _require_rows_block(Lck, pk, 1, "k/v chunk length")
+    _check_blocks(rows, Lk, bq, bk, group)
+    bqp = bq // group
     num_kb = Lk // bk
-    q_offs = _block_offsets(q_offset, Lq, bq)
+    q_offs = _block_offsets(q_offset, Lq, bqp)
     kv_offs = _block_offsets(kv_offset, Lk, bk)
+    rotary = rotary_base is not None
     kernel = functools.partial(_ring_step_kernel, scale=scale,
-                               causal=causal, num_kb=num_kb)
-    grid = (BH, Lq // bq, num_kb)
+                               causal=causal, num_kb=num_kb, bqp=bqp,
+                               group=group, rotary=rotary)
+    grid = (BG, rows // bq, num_kb)
+    q_spec = pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0))
     state_specs = [
-        pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+        q_spec,
         pl.BlockSpec((None, bq, 8), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((None, bq, 8), lambda b, i, j: (b, i, 0)),
     ]
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # per-q-block offs
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # per-kv-block offs
+        q_spec, kv_spec, kv_spec,
+    ]
+    inputs = [q_offs, kv_offs, q, k, v]
+    if rotary:
+        qc, qs, kc, ks = _ring_tables(q_offset, kv_offset, Lq, Lk, D,
+                                      group, rotary_base)
+        tq = pl.BlockSpec((bq, D), lambda b, i, j: (i, 0))
+        tk = pl.BlockSpec((bk, D), lambda b, i, j: (j, 0))
+        in_specs += [tq, tq, tk, tk]
+        inputs += [qc, qs, kc, ks]
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # per-q-block offs
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # per-kv-block offs
-            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
-        ] + state_specs,
+        in_specs=in_specs + state_specs,
         out_specs=state_specs,
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Lq, D), jnp.float32),
-            jax.ShapeDtypeStruct((BH, Lq, 8), jnp.float32),
-            jax.ShapeDtypeStruct((BH, Lq, 8), jnp.float32),
+            jax.ShapeDtypeStruct((BG, rows, D), jnp.float32),
+            jax.ShapeDtypeStruct((BG, rows, 8), jnp.float32),
+            jax.ShapeDtypeStruct((BG, rows, 8), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
-        ],
+        ] + ([pltpu.VMEM((bq, D), q.dtype)] if rotary else []),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q_offs, kv_offs, q, k, v, o, m, l)
+    )(*(inputs + [o, m, l]))
 
 
-def _ring_bwd_dq_kernel(q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref,
-                        do_ref, lse_ref, delta_ref, dqi_ref, dqo_ref,
-                        dq_acc, *, scale, causal, num_kb):
+def _ring_bwd_dq_kernel(*refs, scale, causal, num_kb, bqp, group,
+                        rotary):
     """dQ contribution of one backward ring step (FlashAttention-2
     math, global offsets like `_ring_step_kernel`). The dq accumulator
     is carried *across ring steps* (dqi -> dqo, f32): each arriving k/v
     shard adds its `sum_k dS.K` term; no forward recompute — p comes
-    from the saved per-row lse."""
+    from the saved per-row lse. With fused rotary the accumulator stays
+    in ROTATED space across steps; the caller counter-rotates once
+    after the last ring step."""
+    if rotary:
+        (q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref, qc_ref, qs_ref,
+         kc_ref, ks_ref, do_ref, lse_ref, delta_ref, dqi_ref, dqo_ref,
+         dq_acc, qrot_ref) = refs
+    else:
+        (q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+         delta_ref, dqi_ref, dqo_ref, dq_acc) = refs
     qi = pl.program_id(1)
     kj = pl.program_id(2)
-    block_q, block_k = q_ref.shape[0], k_ref.shape[0]
     q_off = q_offs_ref[qi]
     kv_off = kv_offs_ref[kj]
 
     @pl.when(kj == 0)
     def _load():
         dq_acc[...] = dqi_ref[...]
+        if rotary:
+            qrot_ref[...] = _rot_apply(q_ref[...], qc_ref, qs_ref)
 
-    visible = (kv_off <= q_off + block_q - 1) if causal else kj >= 0
+    visible = (kv_off <= q_off + bqp - 1) if causal else kj >= 0
 
     @pl.when(visible)
     def _compute():
-        s = _masked_scores(q_ref, k_ref, scale, causal, q_off=q_off,
-                           kv_off=kv_off, fill=-jnp.inf)
+        if rotary:
+            q = qrot_ref[...]
+            k = _rot_apply(k_ref[...], kc_ref, ks_ref)
+        else:
+            q = q_ref[...]
+            k = k_ref[...]
+        s = _masked_scores(q, k, scale, causal, q_off=q_off,
+                           kv_off=kv_off, fill=-jnp.inf, group=group)
         p = jnp.exp(s - lse_ref[:, :1])  # masked entries: exp(-inf) = 0
         dp = jax.lax.dot_general(
             do_ref[...], v_ref[...], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = (p * (dp - delta_ref[:, :1]) * scale)
         dq_acc[...] += jax.lax.dot_general(
-            ds.astype(k_ref.dtype), k_ref[...], (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kj == num_kb - 1)
@@ -408,18 +657,24 @@ def _ring_bwd_dq_kernel(q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref,
         dqo_ref[...] = dq_acc[...]
 
 
-def _ring_bwd_dkv_kernel(q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref,
-                         do_ref, lse_ref, delta_ref, dki_ref, dvi_ref,
-                         dko_ref, dvo_ref, dk_acc, dv_acc, *, scale,
-                         causal, num_qb):
+def _ring_bwd_dkv_kernel(*refs, scale, causal, num_qb, bqp, group,
+                         rotary):
     """dK/dV contribution of one backward ring step. The dk/dv
     accumulators travel around the ring with their k/v shard (the
     caller ppermutes them together), so after n steps each shard
-    arrives home with its full gradient. Grid (bh, k-block, q-block),
-    q innermost sequential."""
+    arrives home with its full gradient (dk in rotated space under
+    fused rotary — counter-rotated at home after the loop). Grid
+    (bg, k-block, q-block), q innermost sequential."""
+    if rotary:
+        (q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref, qc_ref, qs_ref,
+         kc_ref, ks_ref, do_ref, lse_ref, delta_ref, dki_ref, dvi_ref,
+         dko_ref, dvo_ref, dk_acc, dv_acc, krot_ref) = refs
+    else:
+        (q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+         delta_ref, dki_ref, dvi_ref, dko_ref, dvo_ref, dk_acc,
+         dv_acc) = refs
     kj = pl.program_id(1)
     qi = pl.program_id(2)
-    block_q, block_k = q_ref.shape[0], k_ref.shape[0]
     q_off = q_offs_ref[qi]
     kv_off = kv_offs_ref[kj]
 
@@ -427,13 +682,21 @@ def _ring_bwd_dkv_kernel(q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref,
     def _load():
         dk_acc[...] = dki_ref[...]
         dv_acc[...] = dvi_ref[...]
+        if rotary:
+            krot_ref[...] = _rot_apply(k_ref[...], kc_ref, ks_ref)
 
-    visible = (q_off + block_q - 1 >= kv_off) if causal else qi >= 0
+    visible = (q_off + bqp - 1 >= kv_off) if causal else qi >= 0
 
     @pl.when(visible)
     def _compute():
-        s = _masked_scores(q_ref, k_ref, scale, causal, q_off=q_off,
-                           kv_off=kv_off, fill=-jnp.inf)
+        if rotary:
+            q = _rot_apply(q_ref[...], qc_ref, qs_ref)
+            k = krot_ref[...]
+        else:
+            q = q_ref[...]
+            k = k_ref[...]
+        s = _masked_scores(q, k, scale, causal, q_off=q_off,
+                           kv_off=kv_off, fill=-jnp.inf, group=group)
         p = jnp.exp(s - lse_ref[:, :1])  # masked entries: exp(-inf) = 0
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[...], (((0,), (0,)), ((), ())),
@@ -441,9 +704,9 @@ def _ring_bwd_dkv_kernel(q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref,
         dp = jax.lax.dot_general(
             do_ref[...], v_ref[...], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta_ref[:, :1]) * scale).astype(q_ref.dtype)
+        ds = (p * (dp - delta_ref[:, :1]) * scale).astype(q.dtype)
         dk_acc[...] += jax.lax.dot_general(
-            ds, q_ref[...], (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_qb - 1)
@@ -454,65 +717,90 @@ def _ring_bwd_dkv_kernel(q_offs_ref, kv_offs_ref, q_ref, k_ref, v_ref,
 
 def flash_ring_bwd_step(q, k, v, do, lse, delta, dq, dk, dv, q_offset,
                         kv_offset, causal=True, scale=None,
-                        interpret=False, block_q=None, block_k=None):
+                        interpret=False, block_q=None, block_k=None,
+                        group=1, rotary_base=None):
     """One backward ring step over kernel-layout shards.
 
-    Args: q/do [BH, Lq, D], k/v [BH, Lk, D], lse/delta [BH, Lq, 8] f32
-    (per-row log-sum-exp from the forward; delta = rowsum(dO*O)),
-    dq [BH, Lq, D] f32 (local accumulator), dk/dv [BH, Lk, D] f32
-    (accumulators traveling with the k/v shard), q_offset/kv_offset
-    global token offsets. Returns updated (dq, dk, dv).
-    """
-    BH, Lq, D = q.shape
+    Args: q/do [BG, Lq*group, D] grouped-rows layout, k/v [BG, Lk, D],
+    lse/delta [BG, Lq*group, 8] f32 (per-row log-sum-exp from the
+    forward; delta = rowsum(dO*O)), dq [BG, Lq*group, D] f32 (local
+    accumulator), dk/dv [BG, Lk, D] f32 (accumulators traveling with
+    the k/v shard), q_offset/kv_offset global token position offsets.
+    Returns updated (dq, dk, dv). Under fused rotary, dq and dk stay
+    in rotated space — counter-rotate after the last ring step with
+    `apply_rotary(..., neg=True)`."""
+    BG, rows, D = q.shape
+    Lq = rows // group
     Lk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
     Lcq = _chunk_len(Lq, q_offset, "q_offset")
     Lck = _chunk_len(Lk, kv_offset, "kv_offset")
-    pq, pk = _default_blocks(D, Lq, backward=True)
-    bq = block_q or _require_block(Lcq, pq, "q chunk length")
-    bk = block_k or _require_block(Lck, pk, "k/v chunk length")
-    num_kb, num_qb = Lk // bk, Lq // bq
-    q_offs = _block_offsets(q_offset, Lq, bq)
+    pq, pk = _grouped_blocks(D, Lq, group, backward=True)
+    bq = block_q or _require_rows_block(Lcq, pq, group, "q chunk length")
+    bk = block_k or _require_rows_block(Lck, pk, 1, "k/v chunk length")
+    _check_blocks(rows, Lk, bq, bk, group)
+    bqp = bq // group
+    num_kb, num_qb = Lk // bk, rows // bq
+    q_offs = _block_offsets(q_offset, Lq, bqp)
     kv_offs = _block_offsets(kv_offset, Lk, bk)
+    rotary = rotary_base is not None
+    if rotary:
+        tables = list(_ring_tables(q_offset, kv_offset, Lq, Lk, D,
+                                   group, rotary_base))
+    else:
+        tables = []
 
     q_spec = lambda b, i, j: (b, i, 0)      # noqa: E731
     stripe_spec = lambda b, i, j: (b, i, 0)  # noqa: E731
+    table_specs_ki = ([pl.BlockSpec((bq, D), lambda b, i, j: (i, 0))] * 2
+                      + [pl.BlockSpec((bk, D),
+                                      lambda b, i, j: (j, 0))] * 2
+                      if rotary else [])
 
     dq = pl.pallas_call(
         functools.partial(_ring_bwd_dq_kernel, scale=scale, causal=causal,
-                          num_kb=num_kb),
-        grid=(BH, num_qb, num_kb),
+                          num_kb=num_kb, bqp=bqp, group=group,
+                          rotary=rotary),
+        grid=(BG, num_qb, num_kb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((None, bq, D), q_spec),
             pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+        ] + table_specs_ki + [
             pl.BlockSpec((None, bq, D), q_spec),
             pl.BlockSpec((None, bq, 8), stripe_spec),
             pl.BlockSpec((None, bq, 8), stripe_spec),
             pl.BlockSpec((None, bq, D), q_spec),
         ],
         out_specs=pl.BlockSpec((None, bq, D), q_spec),
-        out_shape=jax.ShapeDtypeStruct((BH, Lq, D), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((BG, rows, D), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)] + (
+            [pltpu.VMEM((bq, D), q.dtype)] if rotary else []),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q_offs, kv_offs, q, k, v, do, lse, delta, dq)
+    )(q_offs, kv_offs, q, k, v, *tables, do, lse, delta, dq)
 
     k_spec = lambda b, j, i: (b, j, 0)  # noqa: E731
+    table_specs_qi = ([pl.BlockSpec((bq, D), lambda b, j, i: (i, 0))] * 2
+                      + [pl.BlockSpec((bk, D),
+                                      lambda b, j, i: (j, 0))] * 2
+                      if rotary else [])
     dk, dv = pl.pallas_call(
         functools.partial(_ring_bwd_dkv_kernel, scale=scale,
-                          causal=causal, num_qb=num_qb),
-        grid=(BH, num_kb, num_qb),
+                          causal=causal, num_qb=num_qb, bqp=bqp,
+                          group=group, rotary=rotary),
+        grid=(BG, num_kb, num_qb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((None, bk, D), k_spec),
             pl.BlockSpec((None, bk, D), k_spec),
+        ] + table_specs_qi + [
             pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((None, bq, 8), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((None, bq, 8), lambda b, j, i: (b, i, 0)),
@@ -524,76 +812,115 @@ def flash_ring_bwd_step(q, k, v, do, lse, delta, dq, dk, dv, q_offset,
             pl.BlockSpec((None, bk, D), k_spec),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Lk, D), jnp.float32),
-            jax.ShapeDtypeStruct((BH, Lk, D), jnp.float32),
+            jax.ShapeDtypeStruct((BG, Lk, D), jnp.float32),
+            jax.ShapeDtypeStruct((BG, Lk, D), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
-                        pltpu.VMEM((bk, D), jnp.float32)],
+                        pltpu.VMEM((bk, D), jnp.float32)] + (
+            [pltpu.VMEM((bk, D), k.dtype)] if rotary else []),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q_offs, kv_offs, q, k, v, do, lse, delta, dk, dv)
+    )(q_offs, kv_offs, q, k, v, *tables, do, lse, delta, dk, dv)
     return dq, dk, dv
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, scale, causal, num_kb):
-    """dQ: grid (bh, q-block, k-block), k innermost sequential.
+def _bwd_dq_kernel(*refs, scale, causal, num_kb, bqp, group, rotary):
+    """dQ: grid (bg, q-block, k-block), k innermost sequential.
     Recomputes p = exp(s - lse) per block; dS = p * (dO.V^T - delta);
     dQ = sum_k dS.K * scale accumulated in VMEM scratch. lse and
-    delta = rowsum(dO*O) are precomputed per row and streamed in."""
+    delta = rowsum(dO*O) are precomputed per row and streamed in.
+    Fused rotary: q rotated once per q block into scratch (kj==0);
+    accumulate in rotated space, counter-rotate the finished block at
+    finalize."""
+    if rotary:
+        (q_ref, k_ref, v_ref, qc_ref, qs_ref, kc_ref, ks_ref, do_ref,
+         lse_ref, delta_ref, dq_ref, dq_acc, qrot_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+         dq_acc) = refs
     qi = pl.program_id(1)
     kj = pl.program_id(2)
-    block_q, block_k = q_ref.shape[0], k_ref.shape[0]
+    block_k = k_ref.shape[0]
 
     @pl.when(kj == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
+        if rotary:
+            qrot_ref[...] = _rot_apply(q_ref[...], qc_ref, qs_ref)
 
-    visible = (kj * block_k < (qi + 1) * block_q) if causal else kj >= 0
+    visible = (kj * block_k < (qi + 1) * bqp) if causal else kj >= 0
 
     @pl.when(visible)
     def _compute():
-        s = _masked_scores(q_ref, k_ref, scale, causal,
-                           q_off=qi * block_q, kv_off=kj * block_k,
-                           fill=-jnp.inf)
+        if rotary:
+            q = qrot_ref[...]
+            k = _rot_apply(k_ref[...], kc_ref, ks_ref)
+        else:
+            q = q_ref[...]
+            k = k_ref[...]
+        s = _masked_scores(q, k, scale, causal,
+                           q_off=qi * bqp, kv_off=kj * block_k,
+                           fill=-jnp.inf, group=group)
         p = jnp.exp(s - lse_ref[:, :1])  # masked entries: exp(-inf) = 0
         dp = jax.lax.dot_general(
             do_ref[...], v_ref[...], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[:, :1]) * scale
         dq_acc[...] += jax.lax.dot_general(
-            ds.astype(k_ref.dtype), k_ref[...], (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kj == num_kb - 1)
     def _finalize():
-        dq_ref[...] = dq_acc[...].astype(dq_ref.dtype)
+        dq = dq_acc[...]
+        if rotary:
+            dq = _rot_apply(dq, qc_ref, qs_ref, neg=True)
+        dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    num_qb):
-    """dK/dV: grid (bh, k-block, q-block), q innermost sequential.
-    dV = sum_q P^T.dO; dK = sum_q dS^T.Q * scale."""
+def _bwd_dkv_kernel(*refs, scale, causal, num_qb, bqp, group, rotary):
+    """dK/dV: grid (bg, k-block, q-block), q innermost sequential.
+    dV = sum_q P^T.dO; dK = sum_q dS^T.Q * scale. In the grouped GQA
+    layout the q rows interleave the whole head group, so the group
+    reduction of dK/dV happens in these same accumulators. Fused
+    rotary: k rotated once per OUTER k block into scratch (qi==0, the
+    block is fixed across the inner q sweep); q rotated per visit (a
+    fresh DMA each step anyway); dK counter-rotated at finalize (dV is
+    rotation-free)."""
+    if rotary:
+        (q_ref, k_ref, v_ref, qc_ref, qs_ref, kc_ref, ks_ref, do_ref,
+         lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+         krot_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+         dv_ref, dk_acc, dv_acc) = refs
     kj = pl.program_id(1)
     qi = pl.program_id(2)
-    block_q, block_k = q_ref.shape[0], k_ref.shape[0]
+    block_k = k_ref.shape[0]
 
     @pl.when(qi == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
+        if rotary:
+            krot_ref[...] = _rot_apply(k_ref[...], kc_ref, ks_ref)
 
     # Causal: q blocks entirely above this k block see none of it.
-    visible = (qi * block_q + (block_q - 1) >= kj * block_k) if causal \
+    visible = (qi * bqp + (bqp - 1) >= kj * block_k) if causal \
         else qi >= 0
 
     @pl.when(visible)
     def _compute():
-        s = _masked_scores(q_ref, k_ref, scale, causal,
-                           q_off=qi * block_q, kv_off=kj * block_k,
-                           fill=-jnp.inf)
+        if rotary:
+            q = _rot_apply(q_ref[...], qc_ref, qs_ref)
+            k = krot_ref[...]
+        else:
+            q = q_ref[...]
+            k = k_ref[...]
+        s = _masked_scores(q, k, scale, causal,
+                           q_off=qi * bqp, kv_off=kj * block_k,
+                           fill=-jnp.inf, group=group)
         p = jnp.exp(s - lse_ref[:, :1])  # masked entries: exp(-inf) = 0
         p_lo = p.astype(do_ref.dtype)
         dv_acc[...] += jax.lax.dot_general(
@@ -602,67 +929,98 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do_ref[...], v_ref[...], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta_ref[:, :1]) * scale).astype(q_ref.dtype)
+        ds = (p * (dp - delta_ref[:, :1]) * scale).astype(q.dtype)
         dk_acc[...] += jax.lax.dot_general(
-            ds, q_ref[...], (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_qb - 1)
     def _finalize():
-        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dk = dk_acc[...]
+        if rotary:
+            dk = _rot_apply(dk, kc_ref, ks_ref, neg=True)
+        dk_ref[...] = dk.astype(dk_ref.dtype)
         dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _pallas_backward(q, k, v, out, lse, g, scale, causal, interpret,
-                     block_q=None, block_k=None):
-    """Pallas backward: returns (dq, dk, dv) in the inputs' dtypes."""
+                     block_q=None, block_k=None, rotary_base=None):
+    """Pallas backward: q/out/g [B,H,L,D], k/v [B,G,L,D], lse in the
+    grouped-rows layout. Returns (dq [B,H,L,D], dk/dv [B,G,L,D]) in the
+    inputs' dtypes."""
     B, H, L, D = q.shape
-    qf, kf, vf, gf = (x.reshape(B * H, L, D) for x in (q, k, v, g))
+    G = k.shape[1]
+    group = H // G
+    qf, gf, outf = (_to_rows(x, group) for x in (q, g, out))
+    kf = k.reshape(B * G, L, D)
+    vf = v.reshape(B * G, L, D)
     # delta = rowsum(dO * O): one fused XLA pass, streamed into both
     # kernels per q block (recomputing it per grid step would redo the
     # reduction num_kb/num_qb times).
     delta = jnp.broadcast_to(
-        jnp.sum(gf.astype(jnp.float32) *
-                out.reshape(B * H, L, D).astype(jnp.float32), axis=-1,
-                keepdims=True), (B * H, L, 8))
+        jnp.sum(gf.astype(jnp.float32) * outf.astype(jnp.float32),
+                axis=-1, keepdims=True), lse.shape)
     # Backward blocks are independent of the forward's (lse/delta
     # stripes are block-agnostic); see _default_blocks for the swept
     # preferences.
-    pq, pk = _default_blocks(D, L, backward=True)
-    bq = block_q or _pick_block(L, pq)
+    pq, pk = _grouped_blocks(D, L, group, backward=True)
+    bq = block_q or _pick_rows_block(L, pq, group)
     bk = block_k or _pick_block(L, pk)
-    num_kb, num_qb = L // bk, L // bq
+    rows = L * group
+    _check_blocks(rows, L, bq, bk, group)
+    bqp = bq // group
+    num_kb, num_qb = L // bk, rows // bq
+    rotary = rotary_base is not None
+    if rotary:
+        qc, qs = _rope_tables(_row_positions(L, group), D, rotary_base)
+        kc, ks = _rope_tables(jnp.arange(L, dtype=jnp.int32), D,
+                              rotary_base)
+        tables = [qc, qs, kc, ks]
+    else:
+        tables = []
 
-    kv_im = _kv_index_map(bq, bk, causal)
+    kv_im = _kv_index_map(bqp, bk, causal)
+    tq_spec = pl.BlockSpec((bq, D), lambda b, i, j: (i, 0))
+    tk_spec = pl.BlockSpec((bk, D),
+                           _kv_index_map(bqp, bk, causal, rank2=True))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          num_kb=num_kb),
-        grid=(B * H, L // bq, num_kb),
+                          num_kb=num_kb, bqp=bqp, group=group,
+                          rotary=rotary),
+        grid=(B * G, rows // bq, num_kb),
         in_specs=[
             pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, bk, D), kv_im),
             pl.BlockSpec((None, bk, D), kv_im),
+        ] + ([tq_spec, tq_spec, tk_spec, tk_spec] if rotary else []) + [
             pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, bq, 8), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, bq, 8), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((B * G, rows, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)] + (
+            [pltpu.VMEM((bq, D), q.dtype)] if rotary else []),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
+    )(qf, kf, vf, *tables, gf, lse, delta)
 
-    q_im = _q_index_map(bq, bk, causal)
+    q_im = _q_index_map(bqp, bk, causal)
+    tq2_spec = pl.BlockSpec((bq, D), _q_index_map(bqp, bk, causal,
+                                                  rank2=True))
+    tk2_spec = pl.BlockSpec((bk, D), lambda b, j, i: (j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          num_qb=num_qb),
-        grid=(B * H, num_kb, num_qb),
+                          num_qb=num_qb, bqp=bqp, group=group,
+                          rotary=rotary),
+        grid=(B * G, num_kb, num_qb),
         in_specs=[
             pl.BlockSpec((None, bq, D), q_im),
             pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
+        ] + ([tq2_spec, tq2_spec, tk2_spec, tk2_spec]
+             if rotary else []) + [
             pl.BlockSpec((None, bq, D), q_im),
             pl.BlockSpec((None, bq, 8), q_im),
             pl.BlockSpec((None, bq, 8), q_im),
@@ -672,24 +1030,36 @@ def _pallas_backward(q, k, v, out, lse, g, scale, causal, interpret,
             pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, L, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, L, D), v.dtype),
+            jax.ShapeDtypeStruct((B * G, L, D), k.dtype),
+            jax.ShapeDtypeStruct((B * G, L, D), v.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
-                        pltpu.VMEM((bk, D), jnp.float32)],
+                        pltpu.VMEM((bk, D), jnp.float32)] + (
+            [pltpu.VMEM((bk, D), k.dtype)] if rotary else []),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
+    )(qf, kf, vf, *tables, gf, lse, delta)
 
-    shape = (B, H, L, D)
-    return (dq.reshape(shape), dk.reshape(shape), dv.reshape(shape))
+    return (_from_rows(dq, B, group), dk.reshape(B, G, L, D),
+            dv.reshape(B, G, L, D))
 
 
-def _blockwise_reference(q, k, v, scale, causal):
+def _blockwise_reference(q, k, v, scale, causal, rotary_base=None):
     """Blockwise JAX attention, O(BLOCK_Q * L) live memory; used for the
-    backward recompute and as the non-TPU fallback."""
+    backward recompute and as the non-TPU fallback. q [B,H,L,D], k/v
+    [B,G,L,D] — GQA repeats kv across each head group here (the kernel
+    path never materializes that)."""
     B, H, L, D = q.shape
+    G = k.shape[1]
+    group = H // G
+    if rotary_base is not None:
+        pos = jnp.arange(L, dtype=jnp.int32)
+        q = apply_rotary(q, pos, rotary_base)
+        k = apply_rotary(k, pos, rotary_base)
+    if group > 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
     block_q = min(BLOCK_Q, L)
 
     def per_qblock(start, size):
@@ -711,30 +1081,34 @@ def _blockwise_reference(q, k, v, scale, causal):
     return blocks[0] if len(blocks) == 1 else jnp.concatenate(blocks, axis=2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, scale, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, interpret, rotary_base=None):
     if interpret is None:
-        return _blockwise_reference(q, k, v, scale, causal)
-    return _pallas_forward(q, k, v, scale, causal, interpret)
+        return _blockwise_reference(q, k, v, scale, causal, rotary_base)
+    return _pallas_forward(q, k, v, scale, causal, interpret,
+                           rotary_base=rotary_base)
 
 
-def _flash_fwd(q, k, v, scale, causal, interpret):
+def _flash_fwd(q, k, v, scale, causal, interpret, rotary_base=None):
     if interpret is None:
-        return _blockwise_reference(q, k, v, scale, causal), \
-            (q, k, v, None, None)
-    out, lse = _pallas_forward_lse(q, k, v, scale, causal, interpret)
+        return _blockwise_reference(q, k, v, scale, causal,
+                                    rotary_base), (q, k, v, None, None)
+    out, lse = _pallas_forward_lse(q, k, v, scale, causal, interpret,
+                                   rotary_base=rotary_base)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(scale, causal, interpret, res, g):
+def _flash_bwd(scale, causal, interpret, rotary_base, res, g):
     q, k, v, out, lse = res
     if interpret is None:
         # Non-kernel path: recompute-blockwise VJP in plain JAX.
         _, vjp = jax.vjp(
-            lambda q, k, v: _blockwise_reference(q, k, v, scale, causal),
+            lambda q, k, v: _blockwise_reference(q, k, v, scale, causal,
+                                                 rotary_base),
             q, k, v)
         return vjp(g)
-    return _pallas_backward(q, k, v, out, lse, g, scale, causal, interpret)
+    return _pallas_backward(q, k, v, out, lse, g, scale, causal,
+                            interpret, rotary_base=rotary_base)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -749,32 +1123,48 @@ def analytic_attention_flops(B, H, L, D, causal=True, training=False):
     dK/dV kernels, plus the dQ/dK/dV products). ``training=True``
     therefore returns the FULL forward+backward step count (2 + 7 = 9
     per block pair) — callers must NOT add a separate forward term.
-    Causal halves the visited block pairs."""
+    Causal halves the visited block pairs. H is the number of QUERY
+    heads — GQA/MQA change kv memory traffic, not attention FLOPs."""
     per_matmul = 2.0 * B * H * L * L * D
     if causal:
         per_matmul /= 2.0
     return (9.0 if training else 2.0) * per_matmul
 
 
-def flash_attention(q, k, v, causal=True, scale=None):
+def flash_attention(q, k, v, causal=True, scale=None, rotary_base=None):
     """Flash attention over [B, L, H, D] inputs (same layout as
     `parallel.ring.ring_attention`); returns [B, L, H, D] in q.dtype.
+
+    GQA/MQA: pass k/v with fewer heads, [B, L, G, D] with G dividing H
+    — query head h attends through kv head h // (H // G) (consecutive
+    query heads share a kv head, the llama convention). ``rotary_base``
+    fuses rotary position embedding (positions 0..L-1) into the
+    kernels' q/k load path — do not also rotate outside.
 
     L must be a multiple of 128 to hit the Pallas kernel; other shapes
     (and non-TPU backends without interpret mode) use the blockwise JAX
     fallback, which is numerically identical.
     """
     B, L, H, D = q.shape
+    G = k.shape[2]
+    if H % G:
+        raise ValueError(
+            f"num_heads={H} must be a multiple of num_kv_heads={G}")
+    group = H // G
     if scale is None:
         scale = D ** -0.5
-    # Kernel layout: [B, H, L, D].
+    # Kernel layout: [B, H, L, D] / [B, G, L, D].
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
 
     on_tpu = jax.default_backend() == "tpu"
-    if L % BLOCK_Q != 0 or not on_tpu:
-        out = _flash(qt, kt, vt, scale, causal, None)
-    else:
-        out = _flash(qt, kt, vt, scale, causal, False)
+    kernel_ok = (
+        on_tpu and L % BLOCK_Q == 0 and
+        _pick_rows_block(L, _grouped_blocks(D, L, group)[0], group)
+        is not None and _pick_rows_block(
+            L, _grouped_blocks(D, L, group, backward=True)[0], group)
+        is not None)
+    out = _flash(qt, kt, vt, scale, causal, False if kernel_ok else None,
+                 rotary_base)
     return out.transpose(0, 2, 1, 3)

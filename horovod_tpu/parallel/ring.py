@@ -14,6 +14,10 @@ first-class here because long context shapes the core design on TPU.
   sequence-sharded to head-sharded, run dense local attention, reshard
   back. Better when heads >= devices and the per-device sequence is short.
 
+Both support GQA/MQA (k/v with fewer heads than q: [B, L, G, D] with
+G | H) and fused rotary (``rotary_base`` — positions are the *global*
+token positions implied by the schedule, so sequence shards agree).
+
 Both are meant to run inside ``shard_map`` over a mesh axis (see
 `horovod_tpu.parallel.mesh.hybrid_mesh`).
 """
@@ -23,6 +27,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from horovod_tpu.ops.flash_attention import apply_rotary, shard_positions
 
 
 def _block_attention(q, k, v, o, m, l, q_offset, kv_offset, causal, scale):
@@ -106,13 +112,25 @@ def _causal_skip_step(causal, src, idx, Lq, Lk, step, a, b, c,
                     a, b, c, k_blk, v_blk)
 
 
-def _ring_jnp(q, k, v, axis_name, causal, scale):
-    """Blockwise jnp ring (non-TPU / unaligned-shape fallback)."""
+def _ring_jnp(q, k, v, axis_name, causal, scale, rotary_base=None):
+    """Blockwise jnp ring (non-TPU / unaligned-shape fallback).
+    q [B,Lq,H,D]; k/v [B,Lk,G,D] — GQA repeats kv across each head
+    group (the kernel path never materializes that). Rotary is applied
+    up front: q with this shard's global positions, k with the HOME
+    shard's positions before it starts traveling (each k row's rotation
+    is fixed by its own global position, not by who computes with it).
+    """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
-    Lk = k.shape[1]
+    Lk, G = k.shape[1], k.shape[2]
     perm = [(j, (j + 1) % n) for j in range(n)]
+
+    if rotary_base is not None:
+        qpos = idx * Lq + jnp.arange(Lq, dtype=jnp.int32)
+        kpos = idx * Lk + jnp.arange(Lk, dtype=jnp.int32)
+        q = apply_rotary(q, qpos[None, :, None], rotary_base)
+        k = apply_rotary(k, kpos[None, :, None], rotary_base)
 
     step = functools.partial(_block_attention, causal=causal, scale=scale)
 
@@ -125,6 +143,11 @@ def _ring_jnp(q, k, v, axis_name, causal, scale):
         src = (idx - i) % n  # which global block we currently hold
 
         def compute(o, m, l, k_blk, v_blk):
+            if G != H:
+                # GQA: repeat the traveling G-head shard up to H just
+                # for the local einsum (the ring moves the small one).
+                k_blk = jnp.repeat(k_blk, H // G, axis=2)
+                v_blk = jnp.repeat(v_blk, H // G, axis=2)
             return step(q, k_blk, v_blk, o, m, l,
                         q_offset=idx * Lq, kv_offset=src * Lk)
 
@@ -140,15 +163,19 @@ def _ring_jnp(q, k, v, axis_name, causal, scale):
     return out.astype(q.dtype)
 
 
-def _to_kernel(x, B, H):
-    """[B, L, H, D] -> kernel layout [B*H, L, D]."""
-    return x.transpose(0, 2, 1, 3).reshape(B * H, -1, x.shape[-1])
+def _to_rows_bl(x, group):
+    """[B, L, H, D] (H = G*group) -> grouped kernel layout
+    [B*G, L*group, D]; ONE row-ordering definition (the kernel
+    module's `_to_rows`) so the ring and plain layouts cannot
+    disagree. group=1 is the plain [B*H, L, D] layout."""
+    from horovod_tpu.ops.flash_attention import _to_rows
+    return _to_rows(x.transpose(0, 2, 1, 3), group)
 
 
-def _from_kernel(x, B, H):
-    """Kernel layout [B*H, L, D] -> [B, L, H, D]."""
-    BH, L, D = x.shape
-    return x.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+def _from_rows_bl(x, B, group):
+    """Inverse of `_to_rows_bl`: [B*G, L*group, D] -> [B, L, H, D]."""
+    from horovod_tpu.ops.flash_attention import _from_rows
+    return _from_rows(x, B, group).transpose(0, 2, 1, 3)
 
 
 def _schedule_offsets(schedule, rank, n, L):
@@ -166,25 +193,28 @@ def _schedule_offsets(schedule, rank, n, L):
 
 
 def _ring_flash_impl(q, k, v, axis_name, causal, scale,
-                     schedule="contiguous"):
-    """Pallas ring forward. Returns (out [B,Lq,H,D], out_k, lse) where
-    out_k is the normalized output in kernel layout and lse [B*H,Lq,8]
-    is the per-row log-sum-exp stripe the backward ring consumes."""
+                     schedule="contiguous", rotary_base=None):
+    """Pallas ring forward. q [B,Lq,H,D], k/v [B,Lk,G,D]. Returns
+    (out [B,Lq,H,D], out_k, lse) where out_k is the normalized output
+    in the grouped-rows kernel layout and lse [B*G, Lq*group, 8] is the
+    per-row log-sum-exp stripe the backward ring consumes."""
     from horovod_tpu.ops.flash_attention import flash_ring_step
 
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
-    Lk = k.shape[1]
+    Lk, G = k.shape[1], k.shape[2]
+    group = H // G
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     # Transpose once; the ring circulates kernel-layout k/v shards.
-    qk = _to_kernel(q, B, H)
-    kk = _to_kernel(k, B, H)
-    vk = _to_kernel(v, B, H)
-    o0 = jnp.zeros((B * H, Lq, D), jnp.float32)
-    m0 = jnp.full((B * H, Lq, 8), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B * H, Lq, 8), jnp.float32)
+    qk = _to_rows_bl(q, group)
+    kk = _to_rows_bl(k, 1)
+    vk = _to_rows_bl(v, 1)
+    rows = Lq * group
+    o0 = jnp.zeros((B * G, rows, D), jnp.float32)
+    m0 = jnp.full((B * G, rows, 8), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B * G, rows, 8), jnp.float32)
 
     q_off = _schedule_offsets(schedule, idx, n, Lq)
 
@@ -198,7 +228,8 @@ def _ring_flash_impl(q, k, v, axis_name, causal, scale,
                 q_offset=q_off,
                 kv_offset=_schedule_offsets(schedule, src, n, Lk),
                 causal=causal, scale=scale,
-                interpret=_interpret_mode())
+                interpret=_interpret_mode(), group=group,
+                rotary_base=rotary_base)
 
         if schedule == "zigzag":
             # Every step has at-or-below-diagonal work by construction
@@ -217,50 +248,54 @@ def _ring_flash_impl(q, k, v, axis_name, causal, scale,
     out_k = (o / l1).astype(q.dtype)
     # lse = m + log(l); untouched rows (m == -inf, l == 0) stay -inf.
     lse = jnp.broadcast_to(m[:, :, :1] + jnp.log(l1), m.shape)
-    return _from_kernel(out_k, B, H), out_k, lse
+    return _from_rows_bl(out_k, B, group), out_k, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _ring_flash(q, k, v, axis_name, causal, scale,
-                schedule="contiguous"):
+                schedule="contiguous", rotary_base=None):
     """Pallas ring attention, wrapped in a custom VJP because Pallas
     kernels are not auto-differentiable. The backward is a second ring
     pass (FlashAttention-2 style) over the saved per-row log-sum-exp —
     no forward recompute: dq accumulates locally while dk/dv travel
     around the ring with their k/v shard."""
     return _ring_flash_impl(q, k, v, axis_name, causal, scale,
-                            schedule)[0]
+                            schedule, rotary_base)[0]
 
 
-def _ring_flash_fwd(q, k, v, axis_name, causal, scale, schedule):
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, schedule,
+                    rotary_base):
     out, out_k, lse = _ring_flash_impl(q, k, v, axis_name, causal,
-                                       scale, schedule)
+                                       scale, schedule, rotary_base)
     return out, (q, k, v, out_k, lse)
 
 
-def _ring_flash_bwd(axis_name, causal, scale, schedule, res, g):
+def _ring_flash_bwd(axis_name, causal, scale, schedule, rotary_base,
+                    res, g):
     from horovod_tpu.ops.flash_attention import flash_ring_bwd_step
 
     q, k, v, out_k, lse = res
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
-    Lk = k.shape[1]
+    Lk, G = k.shape[1], k.shape[2]
+    group = H // G
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    qk = _to_kernel(q, B, H)
-    kk = _to_kernel(k, B, H)
-    vk = _to_kernel(v, B, H)
-    gk = _to_kernel(g, B, H)
+    qk = _to_rows_bl(q, group)
+    kk = _to_rows_bl(k, 1)
+    vk = _to_rows_bl(v, 1)
+    gk = _to_rows_bl(g, group)
     # delta = rowsum(dO * O): one fused XLA pass per shard, reused by
     # every ring step (both backward kernels stream it per q block).
     delta = jnp.broadcast_to(
         jnp.sum(gk.astype(jnp.float32) * out_k.astype(jnp.float32),
                 axis=-1, keepdims=True), lse.shape)
 
-    dq0 = jnp.zeros((B * H, Lq, D), jnp.float32)
-    dk0 = jnp.zeros((B * H, Lk, D), jnp.float32)
-    dv0 = jnp.zeros((B * H, Lk, D), jnp.float32)
+    rows = Lq * group
+    dq0 = jnp.zeros((B * G, rows, D), jnp.float32)
+    dk0 = jnp.zeros((B * G, Lk, D), jnp.float32)
+    dv0 = jnp.zeros((B * G, Lk, D), jnp.float32)
 
     q_off = _schedule_offsets(schedule, idx, n, Lq)
 
@@ -274,7 +309,8 @@ def _ring_flash_bwd(axis_name, causal, scale, schedule, res, g):
                 q_offset=q_off,
                 kv_offset=_schedule_offsets(schedule, src, n, Lk),
                 causal=causal, scale=scale,
-                interpret=_interpret_mode())
+                interpret=_interpret_mode(), group=group,
+                rotary_base=rotary_base)
 
         if schedule == "zigzag":
             dq, dk, dv = compute(dq, dk, dv, k_blk, v_blk)
@@ -291,21 +327,35 @@ def _ring_flash_bwd(axis_name, causal, scale, schedule, res, g):
         return dq, k_nxt, v_nxt, dk_nxt, dv_nxt
 
     dq, _, _, dk, dv = lax.fori_loop(0, n, body, (dq0, kk, vk, dk0, dv0))
-    return (_from_kernel(dq, B, H).astype(q.dtype),
-            _from_kernel(dk, B, H).astype(k.dtype),
-            _from_kernel(dv, B, H).astype(v.dtype))
+    if rotary_base is not None:
+        # The ring kernels accumulate dq/dk in ROTATED space (the
+        # accumulators persist across ring steps, so per-step counter-
+        # rotation would corrupt later additions). One counter-rotation
+        # at the end: dq by this shard's q-row positions, dk by its
+        # HOME kv positions (it traveled the full ring and is home).
+        qpos_rows = jnp.repeat(shard_positions(q_off, Lq), group)
+        dq = apply_rotary(dq, qpos_rows[None, :], rotary_base, neg=True)
+        kpos = shard_positions(
+            _schedule_offsets(schedule, idx, n, Lk), Lk)
+        dk = apply_rotary(dk, kpos[None, :], rotary_base, neg=True)
+    return (_from_rows_bl(dq, B, group).astype(q.dtype),
+            _from_rows_bl(dk, B, 1).astype(k.dtype),
+            _from_rows_bl(dv, B, 1).astype(v.dtype))
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ring_attention(q, k, v, axis_name, causal=True, scale=None,
-                   schedule="contiguous"):
+                   schedule="contiguous", rotary_base=None):
     """Exact multi-head attention over a sequence sharded on `axis_name`.
 
-    Args: q, k, v of shape [B, L_local, H, D] (per-device shards, equal
-    L_local on every device), inside shard_map over `axis_name`.
-    Returns [B, L_local, H, D] in q.dtype.
+    Args: q of shape [B, L_local, H, D], k/v [B, L_local, G, D] with
+    G | H (GQA/MQA: query head h reads kv head h // (H//G); G == H is
+    plain MHA) — per-device shards, equal L_local on every device,
+    inside shard_map over `axis_name`. Returns [B, L_local, H, D] in
+    q.dtype. ``rotary_base`` fuses rotary embedding into the kernels
+    using the schedule's global positions — do not also rotate outside.
 
     schedule:
       * "contiguous" (default): rank r holds tokens [r*L_local,
@@ -334,7 +384,10 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None,
     if schedule not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown ring schedule: {schedule!r}")
     B, Lq, H, D = q.shape
-    Lk = k.shape[1]
+    Lk, G = k.shape[1], k.shape[2]
+    if H % G:
+        raise ValueError(
+            f"num_heads={H} must be a multiple of num_kv_heads={G}")
     if scale is None:
         scale = D ** -0.5
     if schedule == "zigzag":
@@ -353,10 +406,12 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None,
                 "schedule='zigzag' runs on the Pallas kernel ring "
                 "only (TPU backend, or HVD_TPU_PALLAS_INTERPRET=1, "
                 "static scale)")
-        return _ring_flash(q, k, v, axis_name, causal, scale, "zigzag")
+        return _ring_flash(q, k, v, axis_name, causal, scale, "zigzag",
+                           rotary_base)
     if _use_flash_ring(Lq, Lk, scale):
-        return _ring_flash(q, k, v, axis_name, causal, scale)
-    return _ring_jnp(q, k, v, axis_name, causal, scale)
+        return _ring_flash(q, k, v, axis_name, causal, scale,
+                           "contiguous", rotary_base)
+    return _ring_jnp(q, k, v, axis_name, causal, scale, rotary_base)
 
 
 def zigzag_shard(x, n, axis=1):
@@ -383,16 +438,31 @@ def zigzag_unshard(x, n, axis=1):
     return jnp.concatenate(out, axis=axis)
 
 
-def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):
+def ulysses_attention(q, k, v, axis_name, causal=True, scale=None,
+                      rotary_base=None):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
 
-    Input [B, L_local, H, D] sequence-sharded; all_to_all turns it into
-    [B, L_full, H/n, D] head-sharded, local dense attention runs on full
-    sequence, and a second all_to_all restores sequence sharding. H must
-    be divisible by the axis size.
+    Input q [B, L_local, H, D] / k, v [B, L_local, G, D] sequence-
+    sharded; all_to_all turns them into [B, L_full, H/n, D] (and
+    [B, L_full, G/n, D]) head-sharded, local flash attention runs on
+    the full sequence, and a second all_to_all restores sequence
+    sharding. Both H and G must be divisible by the axis size (GQA
+    keeps its head grouping because consecutive query heads share a kv
+    head and the split is contiguous). ``rotary_base`` fuses rotary in
+    the local kernel — positions are global (the gathered sequence
+    starts at 0), so shards agree.
     """
     n = lax.psum(1, axis_name)
     B, Ll, H, D = q.shape
+    G = k.shape[2]
+    if H % G:
+        raise ValueError(
+            f"num_heads={H} must be a multiple of num_kv_heads={G}")
+    if H % n or G % n:
+        raise ValueError(
+            f"ulysses needs the sp axis size ({n}) to divide both "
+            f"num_heads={H} and num_kv_heads={G} (the all_to_all "
+            f"splits the head dims)")
     if scale is None:
         scale = D ** -0.5
 
@@ -411,5 +481,6 @@ def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):
     # falls back to the numerically-identical blockwise implementation
     # on other backends/unaligned shapes.
     from horovod_tpu.ops import flash_attention
-    og = flash_attention(qg, kg, vg, causal=causal, scale=scale)
+    og = flash_attention(qg, kg, vg, causal=causal, scale=scale,
+                         rotary_base=rotary_base)
     return heads_to_seq(og)

@@ -228,3 +228,151 @@ def test_transformer_flash_matches_dense():
     out = flash_model.apply(variables, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# GQA/MQA (grouped kv heads) + fused rotary
+
+
+def _ref_rotary(x, base=10000.0):
+    """Independent outside-the-kernel rotary reference: the production
+    model path (`models.transformer._rotary`), positions 0..L-1, over
+    [B, L, H, D]. The kernels' in-block rotation must agree with it."""
+    from horovod_tpu.models.transformer import _rotary
+    B, L = x.shape[:2]
+    pos = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None], (B, L))
+    return _rotary(x, pos, base)
+
+
+def _dense_gqa(q, k, v, causal, rotary_base=None):
+    """Dense reference for q [B,L,H,D], k/v [B,L,G,D]: rotate outside,
+    repeat kv across each query-head group."""
+    H, G = q.shape[2], k.shape[2]
+    if rotary_base is not None:
+        q = _ref_rotary(q, rotary_base)
+        k = _ref_rotary(k, rotary_base)
+    if H != G:
+        k = jnp.repeat(k, H // G, axis=2)
+        v = jnp.repeat(v, H // G, axis=2)
+    return _dense(q, k, v, causal)
+
+
+def _rand_gqa(B, L, H, G, D, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, L, G, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, L, G, D), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("G,causal", [(2, True), (2, False), (1, True)])
+def test_flash_gqa_interpret_matches_dense(G, causal):
+    """Grouped-rows GQA kernel layout (G=1 is MQA: every query head on
+    one kv head) must match dense attention with repeated kv."""
+    from horovod_tpu.ops.flash_attention import _pallas_forward
+    B, L, H, D = 2, 256, 4, 32
+    q, k, v = _rand_gqa(B, L, H, G, D, seed=5)
+    out = _pallas_forward(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                          v.transpose(0, 2, 1, 3), D ** -0.5, causal,
+                          interpret=True).transpose(0, 2, 1, 3)
+    expected = _dense_gqa(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("G,rotary", [(2, None), (4, 10000.0),
+                                      (2, 10000.0), (1, 10000.0)])
+def test_flash_gqa_rotary_backward_interpret(G, rotary):
+    """Values AND all three gradients of the Pallas path (custom VJP,
+    interpret mode) for grouped kv heads and fused rotary, against
+    dense attention that rotates outside and repeats kv. Pins: the
+    in-kernel dK/dV group reduction, the rotated-space dQ/dK
+    accumulation with finalize counter-rotation, and the grouped
+    causal masks."""
+    from horovod_tpu.ops.flash_attention import _flash
+    B, L, H, D = 1, 512, 4, 32
+    q, k, v = _rand_gqa(B, L, H, G, D, seed=9)
+    w = jnp.asarray(np.random.RandomState(10).randn(B, L, H, D),
+                    jnp.float32)
+
+    def loss_flash(q, k, v):
+        out = _flash(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                     v.transpose(0, 2, 1, 3), D ** -0.5, True, True,
+                     rotary).transpose(0, 2, 1, 3)
+        return jnp.sum(out * w), out
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_gqa(q, k, v, True, rotary) * w)
+
+    (_, out), g_flash = jax.value_and_grad(
+        loss_flash, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_dense_gqa(q, k, v, True, rotary)),
+        rtol=2e-5, atol=2e-5)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, nm in zip(g_flash, g_dense, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=2e-4, atol=2e-4, err_msg=nm)
+
+
+def test_flash_attention_gqa_fallback_and_validation():
+    """Public API on CPU (blockwise fallback): GQA + fused rotary
+    values/grads match dense; mismatched head counts raise."""
+    from horovod_tpu.ops import flash_attention
+    B, L, H, G, D = 1, 48, 4, 2, 16  # L not 128-aligned -> fallback
+    q, k, v = _rand_gqa(B, L, H, G, D, seed=13)
+
+    out = flash_attention(q, k, v, causal=True, rotary_base=10000.0)
+    expected = _dense_gqa(q, k, v, True, 10000.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+    g_flash = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=True, rotary_base=10000.0) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(lambda q, k, v: jnp.sum(
+        _dense_gqa(q, k, v, True, 10000.0) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=2e-4, atol=2e-4)
+
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        flash_attention(q, k[:, :, :1].repeat(3, 2), v, causal=True)
+
+
+def test_pick_rows_block_policy():
+    """Grouped row-block picking: bqp positions * group rows stays at
+    or under the swept row preference, bqp | L, and group=1 defers to
+    the plain picker."""
+    from horovod_tpu.ops.flash_attention import (_pick_block,
+                                                 _pick_rows_block)
+    assert _pick_rows_block(8192, 512, 1) == _pick_block(8192, 512) == 512
+    assert _pick_rows_block(8192, 512, 2) == 512      # 256 pos x 2
+    assert _pick_rows_block(8192, 512, 3) == 384      # 128 pos x 3
+    assert _pick_rows_block(8192, 512, 6) == 384      # 64 pos x 6
+    assert _pick_rows_block(8192, 512, 12) == 384     # 32 pos x 12
+    assert _pick_rows_block(8192, 1024, 4) == 1024    # 256 pos x 4
+    assert _pick_rows_block(256, 512, 2) == 512       # 256 pos x 2
+
+
+def test_transformer_gqa_flash_matches_dense():
+    """Transformer with grouped kv heads: the flash path (fallback on
+    CPU) must match the dense path on the same params, with rope_fused
+    exercising the kernel-side rotary against the model-side one; the
+    kv projections must actually shrink to G heads."""
+    from horovod_tpu.models import Transformer, TransformerConfig
+    base = dict(vocab_size=64, num_layers=2, num_heads=4,
+                num_kv_heads=2, embed_dim=32, mlp_dim=64,
+                dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+    dense_model = Transformer(TransformerConfig(**base))
+    flash_model = Transformer(TransformerConfig(
+        attention="flash", rope_fused=True, **base))
+    variables = dense_model.init(jax.random.PRNGKey(0), tokens)
+    key_kernel = variables["params"]["block_0"]["attn"]["key"]["kernel"]
+    assert key_kernel.shape == (32, 2, 8)  # (embed, G, head_dim)
+    expected = dense_model.apply(variables, tokens)
+    out = flash_model.apply(variables, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
