@@ -288,6 +288,12 @@ _ZOO = [
     ("transformer", ["--seq-len", "8192", "--fused-xent",
                      "--tokens-batch", "2", "--num-heads", "6",
                      "--num-kv-heads", "2", "--fused-rope"]),
+    # Sparse (indices,values) embedding-gradient plane vs the dense
+    # full-table path — BASELINE.json config #4's IndexedSlices
+    # rationale with an on-chip number (both variants in one row;
+    # vocab matches the reference example's 50000 — the sparse win
+    # grows linearly with vocab, see PERF.md's V-sweep).
+    ("word2vec", ["--vocab-size", "50000", "--num-iters", "100"]),
 ]
 
 
@@ -465,6 +471,157 @@ def scaling_main(args):
     print(json.dumps(out))
 
 
+def w2v_make_step(mesh, n, sparse, lr=0.5, num_iters=100):
+    """Skip-gram NCE multi-step train fn over a dp mesh, sparse or
+    dense gradient plane. The IndexedSlices rationale (reference
+    horovod/tensorflow/__init__.py:65-76) as a measurable A/B:
+
+    * sparse: grads w.r.t. the GATHERED rows only (O(B*D)), shipped
+      through the PRODUCT sparse plane — `horovod_tpu.jax.sparse.
+      allreduce_sparse` (allgather (indices, values) over the axis,
+      average) + `apply_sparse` (scatter-add; duplicates accumulate,
+      exactly IndexedSlices application).
+    * dense: differentiate through the gathers (XLA materializes the
+      full [V, D] scatter-add gradient), psum it, dense SGD update —
+      O(V*D) per step, the `sparse_as_dense` escape hatch.
+
+    Top-level (not nested in word2vec_main) so tests can pin the two
+    paths against each other on a CPU mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.jax.sparse import allreduce_sparse, apply_sparse
+
+    def nce(er, pw, pb, nw, nb):
+        pos = jnp.sum(er * pw, axis=-1) + pb
+        negl = er @ nw.T + nb[None, :]
+        return jnp.mean(-jax.nn.log_sigmoid(pos) -
+                        jnp.sum(jax.nn.log_sigmoid(-negl), axis=-1))
+
+    def run(emb, nce_w, nce_b, center, context, neg):
+        def one(tables, _):
+            emb, nce_w, nce_b = tables
+            if sparse:
+                er = jnp.take(emb, center, axis=0)
+                pw = jnp.take(nce_w, context, axis=0)
+                pb = jnp.take(nce_b, context, axis=0)
+                nw = jnp.take(nce_w, neg, axis=0)
+                nb = jnp.take(nce_b, neg, axis=0)
+                loss, g = jax.value_and_grad(
+                    nce, argnums=(0, 1, 2, 3, 4))(er, pw, pb, nw, nb)
+
+                def sparse_apply(table, ix, vals):
+                    ai, av = allreduce_sparse(ix, vals, average=True,
+                                              axis_name="dp")
+                    return apply_sparse(table, ai, av, scale=-lr)
+
+                emb = sparse_apply(emb, center, g[0])
+                nce_w = sparse_apply(nce_w, context, g[1])
+                nce_b = sparse_apply(nce_b, context, g[2])
+                nce_w = sparse_apply(nce_w, neg, g[3])
+                nce_b = sparse_apply(nce_b, neg, g[4])
+            else:
+                def full_loss(emb, nce_w, nce_b):
+                    return nce(jnp.take(emb, center, axis=0),
+                               jnp.take(nce_w, context, axis=0),
+                               jnp.take(nce_b, context, axis=0),
+                               jnp.take(nce_w, neg, axis=0),
+                               jnp.take(nce_b, neg, axis=0))
+                loss, g = jax.value_and_grad(
+                    full_loss, argnums=(0, 1, 2))(emb, nce_w, nce_b)
+                emb = emb - lr * (lax.psum(g[0], "dp") / n)
+                nce_w = nce_w - lr * (lax.psum(g[1], "dp") / n)
+                nce_b = nce_b - lr * (lax.psum(g[2], "dp") / n)
+            return (emb, nce_w, nce_b), lax.pmean(loss, "dp")
+
+        tables, losses = lax.scan(one, (emb, nce_w, nce_b), None,
+                                  length=num_iters)
+        return tables + (losses[-1],)
+
+    sharded = jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(P(), P(), P(), P("dp"), P("dp"), P()),
+        out_specs=(P(), P(), P(), P()), check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+
+def word2vec_main(args):
+    """bench.py --model word2vec: the sparse (indices, values)
+    embedding-gradient plane vs the dense full-table path, on chip.
+    Reference counterpart: examples/tensorflow_word2vec.py
+    (BASELINE.json config #4, "exercises allgather + broadcast") whose
+    embedding grads are IndexedSlices. One JSON row: the sparse path
+    is the metric, the dense A/B rides along as fields."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    V, D, B, K = args.vocab_size, 256, 4096, 512
+    iters = args.num_iters
+    rng = np.random.RandomState(0)
+    # Zipf-ish ids like natural text; heavy duplication at low ids
+    # exercises the scatter-add accumulate path.
+    p = 1.0 / np.arange(1, V + 1)
+    p /= p.sum()
+    center = jnp.asarray(rng.choice(V, size=B, p=p).astype(np.int32))
+    context = jnp.asarray(rng.choice(V, size=B, p=p).astype(np.int32))
+    neg = jnp.asarray(rng.choice(V, size=K, p=p).astype(np.int32))
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("dp",))
+    print("bench: %d device(s), platform=%s" %
+          (n, devices[0].platform), file=sys.stderr)
+
+    def tables():
+        r = np.random.RandomState(1)
+        return (jnp.asarray(r.randn(V, D).astype(np.float32) * 0.1),
+                jnp.asarray(r.randn(V, D).astype(np.float32) * 0.1),
+                jnp.zeros((V,), jnp.float32))
+
+    results = {}
+    for name, sparse in (("sparse", True), ("dense", False)):
+        step = w2v_make_step(mesh, n, sparse, num_iters=iters)
+        emb, nce_w, nce_b = tables()
+        for _ in range(max(1, args.num_warmup)):
+            emb, nce_w, nce_b, loss = step(emb, nce_w, nce_b, center,
+                                           context, neg)
+        float(loss)  # true barrier (block_until_ready is not, here)
+        times = []
+        for _ in range(max(2, args.num_rounds)):
+            t0 = time.perf_counter()
+            emb, nce_w, nce_b, loss = step(emb, nce_w, nce_b, center,
+                                           context, neg)
+            float(loss)
+            times.append((time.perf_counter() - t0) / iters)
+        results[name] = sorted(times)[len(times) // 2]
+        print("word2vec %s: %.3f ms/step" % (name, results[name] * 1e3),
+              file=sys.stderr)
+
+    sparse_sps = 1.0 / results["sparse"]
+    dense_sps = 1.0 / results["dense"]
+    out = {
+        "metric": "word2vec_sparse_steps_per_sec_per_chip",
+        "value": round(sparse_sps, 1),
+        "unit": "steps/sec/chip",
+        "vs_baseline": 0.0,
+        "baseline": "reference tensorflow_word2vec (BASELINE.json #4) "
+                    "publishes no steps/s; the dense-equivalent A/B "
+                    "of the same model rides in this row",
+        "dense_steps_per_sec": round(dense_sps, 1),
+        "sparse_speedup_vs_dense": round(sparse_sps / dense_sps, 2),
+        "vocab": V, "embedding_dim": D, "batch_centers": B,
+        "num_negatives": K,
+        "sparse_rows_per_step": int(2 * B + 2 * K + B),
+    }
+    print(json.dumps(out))
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=256,
@@ -478,7 +635,8 @@ def main():
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet50", "resnet50gn", "resnet50nf",
                              "resnet50pbn", "resnet101", "resnet152",
-                             "vgg16", "inception3", "inception3pbn", "transformer"],
+                             "vgg16", "inception3", "inception3pbn",
+                             "transformer", "word2vec"],
                     help="vgg16/inception3 are the other models in the "
                          "reference's published scaling table "
                          "(docs/benchmarks.rst:13-14); use "
@@ -494,6 +652,10 @@ def main():
                          "heads — identical FLOPs to GPT-2's 12xD64 but "
                          "full MXU width (D=64 caps every attention "
                          "matmul at half the systolic array)")
+    ap.add_argument("--vocab-size", type=int, default=100000,
+                    help="word2vec model: embedding/NCE table rows "
+                         "(the dense A/B's per-step cost scales with "
+                         "this; the sparse path's does not)")
     ap.add_argument("--num-kv-heads", type=int, default=0,
                     help="transformer GQA/MQA: kv heads < query heads "
                          "(0 = plain MHA). Shrinks the k/v projections "
@@ -562,6 +724,9 @@ def main():
     # children to skip.
     if not _tpu_probe_or_report():
         return 1
+
+    if args.model == "word2vec":
+        return word2vec_main(args)
 
     import jax
     import jax.numpy as jnp
