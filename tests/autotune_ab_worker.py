@@ -1,0 +1,94 @@
+"""Autotune A/B worker: steady-state throughput of a gradient-bucket
+workload under whatever knob env the caller set.
+
+One "step" allreduces AB_TENSORS gradients of AB_ELEMS f32 each (the
+many-small-tensors shape where fusion and cycle pacing actually govern
+throughput — reference rationale: parameter_manager score = bytes/us,
+`/root/reference/horovod/common/parameter_manager.cc:136-160`). In
+autotune mode (HVD_TPU_AUTOTUNE=1) the worker first trains until the
+tuner converges (`autotune_params()["active"]` goes False — the
+coordinator adopts the best knobs and re-syncs every rank), so the
+measured window is steady state under the TUNED knobs, not the
+sampling transient. Rank 0 prints one `AB_RESULT {json}` line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+    k = int(os.environ.get("AB_TENSORS", "48"))
+    elems = int(os.environ.get("AB_ELEMS", "32768"))  # 128 KB each
+    grads = [np.full(elems, float(i % 7), np.float32) for i in range(k)]
+    names = ["ab.layer%03d.grad" % i for i in range(k)]
+
+    def step():
+        hs = [hvd.allreduce_async(g, nm) for g, nm in zip(grads, names)]
+        for h in hs:
+            hvd.synchronize(h)
+
+    tune_steps = 0
+    if os.environ.get("HVD_TPU_AUTOTUNE") == "1":
+        deadline = time.time() + float(
+            os.environ.get("AB_TUNE_TIMEOUT", "300"))
+        max_steps = int(os.environ.get("AB_TUNE_MAX_STEPS", "0"))
+        while True:
+            step()
+            tune_steps += 1
+            # Every rank must exit this loop at the SAME step: the
+            # `active` flip reaches ranks at different cycle
+            # boundaries (and per-rank deadlines skew), and ranks
+            # leaving at different counts desynchronize the collective
+            # sequence (shutdown error / hang). Rank 0 alone decides —
+            # converged (its tuner view is canonical), step-capped, or
+            # timed out — and broadcasts one verdict per step.
+            verdict = 1.0
+            if r == 0:
+                if not hvd.get_basics().autotune_params()["active"]:
+                    verdict = 0.0
+                elif max_steps and tune_steps >= max_steps:
+                    verdict = 0.0
+                elif time.time() > deadline:
+                    verdict = -1.0
+            verdict = float(hvd.broadcast(
+                np.array([verdict]), 0,
+                "ab.tune_verdict.%d" % tune_steps)[0])
+            if verdict == 0.0:
+                break
+            if verdict < 0.0:
+                print("AUTOTUNE_TIMEOUT after %d steps" % tune_steps)
+                return 1
+    else:
+        for _ in range(20):
+            step()
+
+    iters = int(os.environ.get("AB_ITERS", "80"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step()
+    dt = time.perf_counter() - t0
+    bytes_per_step = k * elems * 4
+    if r == 0:
+        out = {
+            "steps_per_s": round(iters / dt, 2),
+            "ms_per_step": round(dt / iters * 1e3, 3),
+            "mb_per_step": round(bytes_per_step / 1e6, 3),
+            "bytes_per_us": round(bytes_per_step * iters / (dt * 1e6), 2),
+            "tune_steps": tune_steps,
+            "params": hvd.get_basics().autotune_params(),
+        }
+        print("AB_RESULT %s" % json.dumps(out))
+    print("rank %d done" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -124,6 +124,113 @@ def main():
     if r == 0:
         print("PASS cross_process_fsdp_step", flush=True)
 
+    # Hierarchical (dp_cross x dp_local) train step over the global
+    # mesh — the two-level ICI/DCN reduction the reference implements
+    # as hierarchical NCCL allreduce (reference
+    # horovod/common/ops/nccl_operations.cc:150-346: intra-node reduce,
+    # inter-node allreduce, intra-node bcast). Here the mesh axes
+    # encode the split (trailing axis = devices within a process) and
+    # the program reduces in two explicit levels.
+    from jax import lax
+    from jax.sharding import PartitionSpec
+
+    if local >= 2 and n >= 2:
+        from horovod_tpu.parallel import hybrid_mesh
+
+        hmesh = hybrid_mesh((n, local), ("dp_cross", "dp_local"),
+                            devices=jax.devices())
+        lr = 0.1
+        N = n * local
+
+        def hier_local(w, x, y):
+            def lf(w):
+                return cross_entropy_loss(x @ w, y)
+            loss, g = jax.value_and_grad(lf)(w)
+            # Level 1: reduce within the process (ICI analogue);
+            # level 2: across processes (DCN analogue).
+            g = lax.psum(g, "dp_local")
+            g = lax.psum(g, "dp_cross")
+            loss = lax.pmean(lax.pmean(loss, "dp_local"), "dp_cross")
+            return w - lr * (g / N), loss
+
+        hstep = jax.jit(jax.shard_map(
+            hier_local, mesh=hmesh,
+            in_specs=(PartitionSpec(),
+                      PartitionSpec(("dp_cross", "dp_local")),
+                      PartitionSpec(("dp_cross", "dp_local"))),
+            out_specs=(PartitionSpec(), PartitionSpec()),
+            check_vma=False))
+        hw = w0
+        hlosses = []
+        for _ in range(3):
+            hw, hloss = hstep(hw, batch["x"], batch["y"])
+            hlosses.append(float(hloss))
+        assert hlosses[-1] < hlosses[0], hlosses
+        gathered_h = hvd.allgather(np.asarray([hlosses[-1]], np.float64),
+                                   name="jd_hier_loss")
+        assert np.allclose(np.asarray(gathered_h), hlosses[-1],
+                           atol=1e-9), gathered_h
+        if r == 0:
+            print("PASS cross_process_hierarchical_step", flush=True)
+
+    # Pipeline parallelism ACROSS process boundaries: pp stages on the
+    # leading (cross-process) axis, dp on the per-process devices —
+    # activations ppermute between processes every microbatch tick.
+    if n >= 2:
+        from horovod_tpu.parallel import hybrid_mesh, pipeline_apply
+
+        ppmesh = hybrid_mesh((n, local), ("pp", "dp"),
+                             devices=jax.devices())
+        d, B_pp, M = 16, 4 * local * 2, 4
+        rng2 = np.random.RandomState(7)
+        stage_w = jnp.asarray(
+            rng2.randn(n, 1, d, d).astype(np.float32) * (1.0 / d ** 0.5))
+        xs = jnp.asarray(rng2.randn(B_pp, d).astype(np.float32))
+        ys = jnp.asarray(rng2.randn(B_pp, d).astype(np.float32))
+        lr = 0.2
+
+        def stage_fn(sp, x):
+            def layer(x, w):
+                return jnp.tanh(x @ w), None
+            return lax.scan(layer, x, sp)[0]
+
+        def pp_local(stage_local, x, y):
+            def local_loss(sl):
+                sl0 = jax.tree_util.tree_map(lambda v: v[0], sl)
+                x_mb = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+                out = pipeline_apply(stage_fn, sl0, x_mb, "pp")
+                out = out.reshape(x.shape)
+                # Pipeline grad contract (test_pipeline.py): local
+                # loss scaled by 1/pp; staged grads then complete.
+                return jnp.mean((out - y) ** 2) / lax.psum(1, "pp")
+            loss, g = jax.value_and_grad(local_loss)(stage_local)
+            # dp axis: plain data-parallel gradient average.
+            g = jax.tree_util.tree_map(
+                lambda v: lax.psum(v, "dp") / lax.psum(1, "dp"), g)
+            loss = lax.pmean(lax.pmean(loss, "dp"), "pp") * n
+            new = jax.tree_util.tree_map(lambda w, gv: w - lr * gv,
+                                         stage_local, g)
+            return new, loss
+
+        pstep = jax.jit(jax.shard_map(
+            pp_local, mesh=ppmesh,
+            in_specs=(PartitionSpec("pp"), PartitionSpec("dp"),
+                      PartitionSpec("dp")),
+            out_specs=(PartitionSpec("pp"), PartitionSpec()),
+            check_vma=False))
+        sw = stage_w
+        plosses = []
+        for _ in range(4):
+            sw, ploss = pstep(sw, xs, ys)
+            plosses.append(float(ploss))
+        assert plosses[-1] < plosses[0], plosses
+        gathered_p = hvd.allgather(np.asarray([plosses[-1]], np.float64),
+                                   name="jd_pp_loss")
+        assert np.allclose(np.asarray(gathered_p), plosses[-1],
+                           atol=1e-9), gathered_p
+        if r == 0:
+            print("PASS cross_process_pp_step", flush=True)
+
     jax.distributed.shutdown()
     print("rank %d: jax.distributed bootstrap tests passed" % r,
           flush=True)

@@ -122,3 +122,32 @@ def test_autotune_e2e(run_launcher, tmp_path):
         assert row[2] in ("0", "1") and row[3] in ("0", "1") \
             and row[4] in ("0", "1"), row
         assert np.isfinite(float(row[5])), row
+
+
+@pytest.mark.e2e
+def test_autotune_ab_worker_symmetric_exit(run_launcher):
+    """The A/B worker's broadcast-gated tune loop (SCALING.md §2.2):
+    rank 0 alone decides exit (converged / step-capped / timed out)
+    and broadcasts the verdict, so every rank leaves at the SAME step
+    — per-rank polling of `active` exits ranks at different collective
+    counts and desynchronizes shutdown (the race the A/B experiment
+    hit live). Pins: clean exit at the step cap while tuning is still
+    active, identical tune_steps on the reporting rank, and a
+    well-formed AB_RESULT."""
+    result = run_launcher(2, "autotune_ab_worker.py",
+                          extra_env={"HVD_TPU_AUTOTUNE": "1",
+                                     "AB_TUNE_MAX_STEPS": "25",
+                                     "AB_ITERS": "10",
+                                     "AB_TENSORS": "8",
+                                     "AB_ELEMS": "4096"},
+                          timeout=600)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "AUTOTUNE_TIMEOUT" not in result.stdout, result.stdout
+    marker = result.stdout.find("AB_RESULT ")
+    assert marker >= 0, result.stdout
+    # raw_decode: another rank's output can interleave after the
+    # JSON object on the same line.
+    res = json.JSONDecoder().raw_decode(
+        result.stdout[marker + len("AB_RESULT "):])[0]
+    assert res["tune_steps"] == 25, res
+    assert res["steps_per_s"] > 0, res
