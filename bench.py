@@ -378,6 +378,16 @@ def all_models_main(args):
     }))
 
 
+def _cpu_per_cycle(ctr):
+    """Rank-0 CPU-us per work cycle from a negotiation-bench counter
+    dict (None when the worker predates the cpu_us field)."""
+    d = ctr.get(0) or {}
+    cycles = (d.get("cycles_fast") or 0) + (d.get("cycles_full") or 0)
+    if not d.get("cpu_us") or not cycles:
+        return None
+    return round(d["cpu_us"] / cycles, 1)
+
+
 def scaling_main(args):
     """bench.py --scaling: regenerates the SCALING.md evidence — (a)
     weak-scaling efficiency of the full jitted DP train step on the
@@ -386,7 +396,9 @@ def scaling_main(args):
     negotiation)."""
     weak = _run_weak_scaling(args.scaling_batch, args.num_iters)
 
-    rank_counts = [n for n in (32, 64, 128, 256)
+    # 512/1024 are extension sizes (real rank processes, several
+    # minutes each on a 1-core host) — opt in via --scaling-max-ranks.
+    rank_counts = [n for n in (32, 64, 128, 256, 512, 1024)
                    if n <= args.scaling_max_ranks]
     negotiation = []
     for n in rank_counts:
@@ -424,6 +436,12 @@ def scaling_main(args):
             "uncached_cycle_kinds": {
                 "fast": u_ctr.get(0, {}).get("cycles_fast"),
                 "full": u_ctr.get(0, {}).get("cycles_full")},
+            # Coordinator CPU time per work cycle (user+sys of the
+            # rank-0 process / its work-cycle count) — wall clock on a
+            # shared core measures the scheduler, CPU time measures
+            # the protocol (SCALING.md §2.3).
+            "cached_coord_cpu_us_per_cycle": _cpu_per_cycle(c_ctr),
+            "uncached_coord_cpu_us_per_cycle": _cpu_per_cycle(u_ctr),
         }
 
         # Gradient-bucket shape: one training step = 32 long-named

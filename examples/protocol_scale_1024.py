@@ -1,0 +1,88 @@
+"""Protocol-scale extension capture: 256 -> 1024 REAL rank processes
+(VERDICT r4 item 5). For each size, the bucket32 gradient-step shape
+(32 long-named async allreduces per step) in cached and uncached
+modes, recording per-step control bytes (coordinator + representative
+worker), cycle kinds, and the coordinator's CPU time per work cycle
+(user+sys of the rank-0 process — on a 1-core host wall clock measures
+the OS scheduler; CPU time measures the protocol, and its growth with
+n pins the O(n) constant of the fast path).
+
+Writes SCALING_EVIDENCE_1024_r05.json. Run alone (heavily
+load-sensitive; the 1024-rank size spawns 1024 real processes).
+
+Usage: python examples/protocol_scale_1024.py [--sizes 256,512,1024]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (the negotiation-bench launcher lives there)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="256,512,1024")
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "SCALING_EVIDENCE_1024_r05.json"))
+    args = ap.parse_args()
+    sizes = [int(s) for s in args.sizes.split(",")]
+
+    rows = []
+    for n in sizes:
+        iters = max(4, 2048 // n)
+        env = {"HVD_TPU_BENCH_TENSORS": "32"}
+        if n >= 1024:
+            # One-core 1024-process oversubscription: shrink warmup
+            # (each step is a full fleet round-robin) and widen the
+            # coordinator's blocking-poll window past scheduler
+            # starvation bursts.
+            env["HVD_TPU_BENCH_WARMUP"] = "4"
+            env["HVD_TPU_CONTROL_POLL_TIMEOUT_SECONDS"] = "600"
+        print("== n=%d (iters=%d) ==" % (n, iters), file=sys.stderr)
+        try:
+            _, c_ctr = bench._run_negotiation_bench(n, iters, env,
+                                                    timeout=3600)
+            _, u_ctr = bench._run_negotiation_bench(
+                n, max(3, iters // 2),
+                dict(env, HVD_TPU_CACHE_CAPACITY="0"), timeout=3600)
+        except Exception as e:  # keep completed sizes on a failure
+            rows.append({"ranks": n, "error": str(e)[:400]})
+            print("n=%d FAILED: %s" % (n, str(e)[:200]), file=sys.stderr)
+            continue
+
+        def per_step(ctr, rank):
+            d = ctr.get(rank)
+            if not d or not d.get("iters"):
+                return None
+            return round((d["ctrl_bytes_sent"] + d["ctrl_bytes_recv"])
+                         / d["iters"], 1)
+
+        row = {
+            "ranks": n,
+            "bucket32_cached_bytes_per_step_coord": per_step(c_ctr, 0),
+            "bucket32_uncached_bytes_per_step_coord": per_step(u_ctr, 0),
+            "bucket32_cached_bytes_per_step_worker": per_step(c_ctr, 1),
+            "bucket32_uncached_bytes_per_step_worker": per_step(u_ctr, 1),
+            "cached_cycle_kinds": {
+                "fast": c_ctr.get(0, {}).get("cycles_fast"),
+                "full": c_ctr.get(0, {}).get("cycles_full")},
+            "cached_coord_cpu_us_per_cycle": bench._cpu_per_cycle(c_ctr),
+            "uncached_coord_cpu_us_per_cycle": bench._cpu_per_cycle(u_ctr),
+        }
+        rows.append(row)
+        print(json.dumps(row), file=sys.stderr)
+
+    out = {"metric": "protocol_scale_extension", "rows": rows,
+           "host_cores": os.cpu_count()}
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

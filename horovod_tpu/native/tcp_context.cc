@@ -12,6 +12,19 @@
 
 namespace hvdtpu {
 
+// Blocking control/ring poll window. 60 s is generous for any real
+// deployment; a heavily oversubscribed localhost fleet (the 1024-rank
+// protocol sweep runs 1024 processes on one core) can starve the
+// coordinator past it mid-gather — raise via env there.
+static int ControlPollMs() {
+  static int ms = [] {
+    const char* v = std::getenv("HVD_TPU_CONTROL_POLL_TIMEOUT_SECONDS");
+    int s = v ? std::atoi(v) : 60;
+    return (s > 0 ? s : 60) * 1000;
+  }();
+  return ms;
+}
+
 static int EnvInt(const char* name, int dflt) {
   const char* v = std::getenv(name);
   return v == nullptr ? dflt : std::atoi(v);
@@ -306,7 +319,7 @@ bool TcpContext::MultiRecvFrames(uint32_t expect_tag,
         idx.push_back(i);
       }
     }
-    if (::poll(pfds.data(), pfds.size(), 60000) <= 0) {
+    if (::poll(pfds.data(), pfds.size(), ControlPollMs()) <= 0) {
       LOG(ERROR) << "control gather poll timeout/error";
       return false;
     }
@@ -389,7 +402,7 @@ bool TcpContext::MultiSendFrames(
         idx.push_back(i);
       }
     }
-    if (::poll(pfds.data(), pfds.size(), 60000) <= 0) {
+    if (::poll(pfds.data(), pfds.size(), ControlPollMs()) <= 0) {
       LOG(ERROR) << "control bcast poll timeout/error";
       return false;
     }
@@ -600,7 +613,7 @@ bool TcpContext::RingExchangeOn(Ring ring, const void* send_buf,
       pfds[n] = {prev->fd(), POLLIN, 0};
       recv_idx = n++;
     }
-    if (::poll(pfds, n, 60000) <= 0) {
+    if (::poll(pfds, n, ControlPollMs()) <= 0) {
       LOG(ERROR) << "ring exchange poll timeout/error";
       return false;
     }
@@ -650,7 +663,7 @@ bool TcpContext::RingBroadcast(void* buf, std::size_t len, int root) {
       send_idx = n++;
     }
     if (n == 0) break;
-    if (::poll(pfds, n, 60000) <= 0) {
+    if (::poll(pfds, n, ControlPollMs()) <= 0) {
       LOG(ERROR) << "ring broadcast poll timeout/error";
       return false;
     }

@@ -1,0 +1,59 @@
+"""On-disk result cache for launcher host checks.
+
+Reference analogue: `horovod/run/util/cache.py` — a 60-minute
+staleness window over an on-disk store keyed by the run parameters,
+used so repeated `horovodrun` invocations skip re-probing every host
+(`horovod/run/run.py:421-424`). TPU-native differences: JSON instead
+of cloudpickle (stdlib-only, human-inspectable, no code execution on
+load), atomic replace writes, and corrupt/stale-format files self-heal
+to empty instead of raising.
+"""
+
+import json
+import os
+import threading
+import time
+
+
+class Cache:
+    """String-keyed (timestamp, value) store under ``folder``.
+
+    Entries older than ``staleness_minutes`` read as misses; a
+    ``parameters_hash`` mismatch (different launcher arguments than the
+    run that wrote the file) invalidates the whole store, like the
+    reference's parameters_hash gate."""
+
+    def __init__(self, folder, staleness_minutes, parameters_hash):
+        self._file = os.path.join(folder, "cache.json")
+        self._ttl = staleness_minutes * 60.0
+        self._lock = threading.Lock()
+        os.makedirs(folder, exist_ok=True)
+        content = {}
+        try:
+            with open(self._file) as f:
+                content = json.load(f)
+        except (OSError, ValueError):
+            content = {}
+        if not isinstance(content, dict) or \
+                content.get("parameters_hash") != parameters_hash:
+            content = {"parameters_hash": parameters_hash}
+        content.setdefault("entries", {})
+        self._content = content
+
+    def get(self, key):
+        with self._lock:
+            ent = self._content["entries"].get(key)
+        if not ent:
+            return None
+        ts, val = ent
+        if time.time() - ts <= self._ttl:
+            return val
+        return None
+
+    def put(self, key, val):
+        with self._lock:
+            self._content["entries"][key] = (time.time(), val)
+            tmp = self._file + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._content, f)
+            os.replace(tmp, self._file)

@@ -132,6 +132,12 @@ def make_parser():
     parser.add_argument("--start-timeout", type=int, default=60,
                         help="seconds to wait for all ranks to connect")
     parser.add_argument("--check-build", action="store_true")
+    parser.add_argument("--disable-cache", action="store_true",
+                        help="re-run host checks even if cached "
+                             "(reference: horovodrun --disable-cache; "
+                             "successful ssh probes are otherwise "
+                             "remembered for 60 minutes in "
+                             "~/.horovod_tpu/cache.json)")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command to run, e.g. python train.py")
@@ -167,13 +173,35 @@ def _ssh_base_cmd(extra_opts=(), ssh_port=None):
     return cmd
 
 
-def ssh_preflight(hostnames, ssh_port=None, timeout=5):
+def _preflight_cache(ssh_port):
+    """60-minute on-disk cache of successful host checks (reference:
+    run/run.py:421-424 + run/util/cache.py), keyed by the remote-shell
+    configuration so an ssh-command/port change invalidates it.
+    Disabled by --disable-cache / HVD_TPU_DISABLE_CACHE=1."""
+    if os.environ.get("HVD_TPU_DISABLE_CACHE") == "1":
+        return None
+    from horovod_tpu.run.cache import Cache
+    params = "%r:%r" % (_ssh_base_cmd(), ssh_port)
+    folder = os.path.join(os.path.expanduser("~"), ".horovod_tpu")
+    try:
+        return Cache(folder, staleness_minutes=60,
+                     parameters_hash=params)
+    except OSError:
+        return None  # unwritable home: probe uncached
+
+
+def ssh_preflight(hostnames, ssh_port=None, timeout=5, fn_cache=None):
     """Verifies every remote host is reachable over non-interactive ssh
     before launching anything (reference: run/run.py:53-106). Raises with
-    an actionable message listing the unreachable hosts."""
+    an actionable message listing the unreachable hosts. Successful
+    checks are remembered in `fn_cache` (only successes — a host that
+    failed is re-probed next run, like the reference's None-result
+    rule)."""
     import concurrent.futures
 
     def probe(host):
+        if fn_cache is not None and fn_cache.get("ssh://" + host):
+            return host, 0, ""
         cmd = _ssh_base_cmd(
             ["-o", "BatchMode=yes", "-o", "ConnectTimeout=%d" % timeout],
             ssh_port=ssh_port)
@@ -191,6 +219,8 @@ def ssh_preflight(hostnames, ssh_port=None, timeout=5):
         for host, rc, err in pool.map(probe, hostnames):
             if rc != 0:
                 failures.append((host, err))
+            elif fn_cache is not None:
+                fn_cache.put("ssh://" + host, True)
     if failures:
         detail = "\n".join("  %s: %s" % (h, e or "ssh exited nonzero")
                            for h, e in failures)
@@ -303,7 +333,8 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
     remote_hosts = sorted({s.hostname for s in slots
                            if not util.is_local_host(s.hostname)})
     if remote_hosts:
-        ssh_preflight(remote_hosts, ssh_port=ssh_port)
+        ssh_preflight(remote_hosts, ssh_port=ssh_port,
+                      fn_cache=_preflight_cache(ssh_port))
 
     base_env = dict(env if env is not None else os.environ)
     base_env.setdefault("HVD_TPU_START_TIMEOUT", str(start_timeout))
@@ -400,6 +431,8 @@ def main(argv=None):
     if args.check_build:
         check_build()
         return 0
+    if args.disable_cache:
+        os.environ["HVD_TPU_DISABLE_CACHE"] = "1"
     command = args.command
     if command and command[0] == "--":
         command = command[1:]

@@ -41,15 +41,30 @@ def main():
         for h in handles:
             ops.synchronize(h)
 
-    for i in range(20):  # warmup; also populates the response cache
+    # Warmup (populates the response cache); tunable because at the
+    # 1024-rank oversubscribed sweep every step costs a full fleet
+    # round-robin on one core.
+    warmup = int(os.environ.get("HVD_TPU_BENCH_WARMUP", "20"))
+    for i in range(warmup):
         step()
     basics.protocol_counters_reset()
+    # Coordinator CPU time (user+sys of THIS process, coordinator
+    # thread included) over the measured window: wall clock on a
+    # 1-core host measures the OS scheduler, CPU time measures the
+    # protocol. cpu_us / work cycles = the per-cycle coordinator cost
+    # whose O(n) constant SCALING.md §2.3 pins.
+    import resource
+    ru0 = resource.getrusage(resource.RUSAGE_SELF)
     t0 = time.perf_counter()
     for i in range(iters):
         step()
     dt = time.perf_counter() - t0
+    ru1 = resource.getrusage(resource.RUSAGE_SELF)
+    cpu_us = ((ru1.ru_utime - ru0.ru_utime) +
+              (ru1.ru_stime - ru0.ru_stime)) * 1e6
     counters = basics.protocol_counters()
-    counters.update(rank=r, iters=iters, tensors_per_step=k)
+    counters.update(rank=r, iters=iters, tensors_per_step=k,
+                    cpu_us=round(cpu_us, 1))
     # Ranks 0 (coordinator, O(n) traffic) and 1 (representative worker,
     # O(1) traffic) carry the protocol-cost evidence.
     if r <= 1:

@@ -188,3 +188,15 @@ def test_jax_fsdp_lm_example():
     proc = run_mesh_example("jax_fsdp_lm.py", 6)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "done" in proc.stdout
+
+
+def test_tensorflow_mnist_estimator_example():
+    """Estimator-era flow (reference tensorflow_mnist_estimator.py)
+    on the v1 session API tf.estimator lowered to — tf.estimator
+    itself is gone in TF>=2.16. Self-verifying: loss drop, >chance
+    eval accuracy, bit-identical post-broadcast eval across ranks,
+    rank-0-only checkpoint."""
+    proc = run_example(2, "tensorflow_mnist_estimator.py",
+                       ["--steps", "120"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS estimator_equivalent" in proc.stdout

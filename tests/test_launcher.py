@@ -119,3 +119,65 @@ def test_check_build_matrix():
                 "[X] flash attention / ring attention",
                 "[X] fused BatchNorm statistics"):
         assert row in out, (row, out)
+
+
+def test_preflight_cache_roundtrip(tmp_path):
+    """The on-disk host-check cache (reference run/util/cache.py):
+    fresh-entry hit, TTL expiry miss, parameters-hash invalidation,
+    and corrupt-file self-heal."""
+    from horovod_tpu.run.cache import Cache
+
+    c = Cache(str(tmp_path), staleness_minutes=60, parameters_hash="p1")
+    assert c.get("ssh://a") is None
+    c.put("ssh://a", True)
+    assert c.get("ssh://a") is True
+    # Same params, new instance: persisted.
+    c2 = Cache(str(tmp_path), staleness_minutes=60, parameters_hash="p1")
+    assert c2.get("ssh://a") is True
+    # Different params: whole store invalidated.
+    c3 = Cache(str(tmp_path), staleness_minutes=60, parameters_hash="p2")
+    assert c3.get("ssh://a") is None
+    # TTL zero: entries immediately stale.
+    c4 = Cache(str(tmp_path), staleness_minutes=0, parameters_hash="p1")
+    c4.put("ssh://b", True)
+    import time as _t
+    _t.sleep(0.01)
+    assert c4.get("ssh://b") is None
+    # Corrupt file self-heals to empty.
+    (tmp_path / "cache.json").write_text("{not json")
+    c5 = Cache(str(tmp_path), staleness_minutes=60, parameters_hash="p1")
+    assert c5.get("ssh://a") is None
+    c5.put("ssh://a", True)
+    assert c5.get("ssh://a") is True
+
+
+def test_ssh_preflight_uses_cache(tmp_path, monkeypatch):
+    """A cached success skips the probe subprocess entirely; a cache
+    miss probes and records the success (only successes are stored —
+    failures re-probe next run)."""
+    import sys as _sys
+
+    from horovod_tpu.run.cache import Cache
+    from horovod_tpu.run.run import ssh_preflight
+
+    calls = tmp_path / "calls"
+    calls.mkdir()
+    probe_script = tmp_path / "counting_ssh.py"
+    probe_script.write_text(
+        "import os, sys, uuid\n"
+        "open(os.path.join(%r, str(uuid.uuid4())), 'w').close()\n"
+        "sys.exit(0)\n" % str(calls))
+    monkeypatch.setenv("HVD_TPU_SSH_CMD",
+                       "%s %s" % (_sys.executable, probe_script))
+
+    cache = Cache(str(tmp_path / "store"), staleness_minutes=60,
+                  parameters_hash="t")
+    ssh_preflight(["hostA", "hostB"], fn_cache=cache)
+    assert len(list(calls.iterdir())) == 2
+    assert cache.get("ssh://hostA") and cache.get("ssh://hostB")
+    # Second preflight: fully served from cache, zero probes.
+    ssh_preflight(["hostA", "hostB"], fn_cache=cache)
+    assert len(list(calls.iterdir())) == 2
+    # A new host probes; the cached two still don't.
+    ssh_preflight(["hostA", "hostB", "hostC"], fn_cache=cache)
+    assert len(list(calls.iterdir())) == 3

@@ -1,0 +1,82 @@
+"""Chip-attached observability worker (round-5 artifact capture).
+
+Rank 0 computes real model gradients ON THE TPU (the axon tunnel chip
+— the launcher driver re-injects the pool pointer as
+HVD_TPU_AXON_SAVED so only rank 0 engages the plugin; the single chip
+cannot be shared); every other rank computes the same model on its CPU
+backend. All ranks then allreduce the gradients through the HOST core
+(the plane the timeline instruments — on-chip XLA collectives are
+compiled into the jit step and invisible to a host-side tracer by
+design). One mid-run straggler step on rank 1 crosses the
+stall-check threshold, so the coordinator's stall inspector fires its
+warning DURING a live chip-attached training loop — not a synthetic
+CPU toy. Reference analogue: docs/timeline.rst:1-60 (capture a
+timeline from a real training job)."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    r = int(os.environ.get("HVD_TPU_RANK", "0"))
+    if r == 0 and os.environ.get("HVD_TPU_AXON_SAVED"):
+        # Rank 0 re-engages the TPU plugin; the launcher scrubbed it
+        # for everyone (N workers on one tunnel chip deadlock).
+        os.environ["PALLAS_AXON_POOL_IPS"] = \
+            os.environ["HVD_TPU_AXON_SAVED"]
+        os.environ.pop("JAX_PLATFORM_NAME", None)
+        os.environ.pop("JAX_PLATFORMS", None)
+
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    backend = jax.default_backend()
+    print("rank %d backend=%s" % (r, backend), flush=True)
+
+    # Small-but-real model: 3-layer MLP classifier, grads jitted on
+    # this rank's backend (TPU for rank 0).
+    rng = np.random.RandomState(0)
+    params = [jnp.asarray(rng.randn(256, 256).astype(np.float32) * 0.05)
+              for _ in range(3)]
+    x = jnp.asarray(rng.randn(64, 256).astype(np.float32))
+    y = jnp.asarray(rng.randn(64, 256).astype(np.float32))
+
+    def loss_fn(ps):
+        h = x
+        for w in ps:
+            h = jnp.tanh(h @ w)
+        return jnp.mean((h - y) ** 2)
+
+    grads_fn = jax.jit(jax.grad(loss_fn))
+
+    lr = 0.1
+    for step in range(6):
+        grads = grads_fn(params)
+        host_grads = [np.asarray(g, np.float32) for g in grads]
+        if r == 1 and step == 3:
+            # Straggle past HVD_TPU_STALL_CHECK_TIME_SECONDS: the
+            # coordinator reports this rank missing from the step's
+            # negotiation while rank 0 (chip-attached) waits.
+            time.sleep(4)
+        reduced = [hvd.allreduce(g, "grad.layer%d" % i)
+                   for i, g in enumerate(host_grads)]
+        params = [p - lr * jnp.asarray(g)
+                  for p, g in zip(params, reduced)]
+
+    final = float(loss_fn(params))
+    print("rank %d final loss %.5f (backend=%s)" % (r, final, backend),
+          flush=True)
+    if r == 0:
+        print("CHIP_BACKEND %s" % backend, flush=True)
+    print("rank %d done" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
