@@ -34,9 +34,11 @@ import numpy as np
 
 
 def synthetic_mnist(n, seed, num_classes=10):
-    """Separable MNIST-shaped data: per-class spatial template + noise."""
+    """Separable MNIST-shaped data: ONE fixed set of per-class spatial
+    templates (train and eval must share the task) + seeded noise."""
+    templates = np.random.RandomState(42).randn(
+        num_classes, 784).astype(np.float32)
     rng = np.random.RandomState(seed)
-    templates = rng.randn(num_classes, 784).astype(np.float32)
     labels = rng.randint(0, num_classes, size=n).astype(np.int32)
     x = templates[labels] + 0.7 * rng.randn(n, 784).astype(np.float32)
     return x.astype(np.float32), labels
@@ -56,16 +58,33 @@ def main():
     hvd.init()
     r, size = hvd.rank(), hvd.size()
 
+    def conv_relu(x, filters, name):
+        in_ch = int(x.shape[-1])
+        w = v1.get_variable(name + "_w", [5, 5, in_ch, filters],
+                            initializer=v1.glorot_uniform_initializer())
+        b = v1.get_variable(name + "_b", [filters],
+                            initializer=v1.zeros_initializer())
+        return tf.nn.relu(tf.nn.conv2d(x, w, strides=1,
+                                       padding="SAME") + b)
+
+    def dense(x, units, name, activation=None):
+        w = v1.get_variable(name + "_w", [int(x.shape[-1]), units],
+                            initializer=v1.glorot_uniform_initializer())
+        b = v1.get_variable(name + "_b", [units],
+                            initializer=v1.zeros_initializer())
+        y = x @ w + b
+        return activation(y) if activation else y
+
     def model_fn(features, labels, mode):
         """EstimatorSpec-shaped: the reference's cnn_model_fn (ref
-        :32-132), shrunk to run fast on CPU."""
+        :32-132), shrunk to run fast on CPU and built from raw v1 ops
+        (tf.compat.v1.layers is gone under Keras 3)."""
         x = tf.reshape(features, [-1, 28, 28, 1])
-        h = v1.layers.conv2d(x, 8, [5, 5], padding="same",
-                             activation=tf.nn.relu, name="conv1")
-        h = v1.layers.max_pooling2d(h, [4, 4], strides=4)
+        h = conv_relu(x, 8, "conv1")
+        h = tf.nn.max_pool2d(h, ksize=4, strides=4, padding="SAME")
         h = tf.reshape(h, [-1, 7 * 7 * 8])
-        h = v1.layers.dense(h, 64, activation=tf.nn.relu, name="dense")
-        logits = v1.layers.dense(h, 10, name="logits")
+        h = dense(h, 64, "dense", activation=tf.nn.relu)
+        logits = dense(h, 10, "logits")
         preds = tf.argmax(logits, axis=1, output_type=tf.int32)
         if mode == "train":
             loss = tf.reduce_mean(
@@ -99,13 +118,16 @@ def main():
         with v1.variable_scope("model", reuse=True):
             eval_spec = model_fn(x_ph, y_ph, "eval")
         bcast_hook = hvd.BroadcastGlobalVariablesHook(0)
-        saver = v1.train.Saver() if r == 0 else None
 
         # steps // size (ref :198-201).
         steps = max(10, args.steps // size)
         rng = np.random.RandomState(1234 + r)
         losses = []
-        with v1.train.MonitoredTrainingSession(hooks=[bcast_hook]) as sess:
+        # checkpoint_dir on rank 0 ONLY: MonitoredTrainingSession's
+        # own CheckpointSaverHook writes the checkpoint (exactly how
+        # an Estimator with model_dir checkpoints; ref :169-176).
+        with v1.train.MonitoredTrainingSession(
+                hooks=[bcast_hook], checkpoint_dir=model_dir) as sess:
             for _ in range(steps):
                 idx = rng.randint(0, len(train_x), size=args.batch_size)
                 loss, _ = sess.run(
@@ -114,21 +136,22 @@ def main():
                 losses.append(float(loss))
             acc = float(sess.run(eval_spec["accuracy"],
                                  feed_dict={x_ph: eval_x, y_ph: eval_y}))
-            if saver is not None:
-                saver.save(sess.raw_session(),  # MonitoredSession wraps
-                           os.path.join(model_dir, "model.ckpt"))
 
     first, last = np.mean(losses[:10]), np.mean(losses[-10:])
     assert last < first, (first, last)
     assert acc > 0.2, acc  # 10-class chance = 0.1
     # Post-broadcast agreement: every rank evaluated the SAME model, so
-    # accuracies must match bit-for-bit.
-    gathered = hvd.allgather(np.asarray([acc], np.float64),
-                             name="estimator_eval_acc")
+    # accuracies must match bit-for-bit. (The numpy host-plane
+    # allgather — the TF binding's op is symbolic under the disabled-
+    # eager graph mode this example runs in.)
+    import horovod_tpu as hvd_np
+    gathered = hvd_np.allgather(np.asarray([acc], np.float64),
+                                name="estimator_eval_acc")
     assert np.allclose(np.asarray(gathered), acc, atol=1e-12), gathered
     if r == 0:
         assert model_dir and any(
-            f.startswith("model.ckpt") for f in os.listdir(model_dir))
+            f.startswith("model.ckpt") for f in os.listdir(model_dir)), \
+            os.listdir(model_dir)
         print("eval accuracy %.3f (loss %.3f -> %.3f over %d steps x "
               "%d ranks)" % (acc, first, last, steps, size))
         print("PASS estimator_equivalent")

@@ -12,22 +12,25 @@
 
 namespace hvdtpu {
 
-// Blocking control/ring poll window. 60 s is generous for any real
-// deployment; a heavily oversubscribed localhost fleet (the 1024-rank
-// protocol sweep runs 1024 processes on one core) can starve the
-// coordinator past it mid-gather — raise via env there.
-static int ControlPollMs() {
-  static int ms = [] {
-    const char* v = std::getenv("HVD_TPU_CONTROL_POLL_TIMEOUT_SECONDS");
-    int s = v ? std::atoi(v) : 60;
-    return (s > 0 ? s : 60) * 1000;
-  }();
-  return ms;
-}
-
 static int EnvInt(const char* name, int dflt) {
   const char* v = std::getenv(name);
   return v == nullptr ? dflt : std::atoi(v);
+}
+
+// Blocking control/ring poll window. 60 s is generous for any real
+// deployment; a heavily oversubscribed localhost fleet (the 1024-rank
+// protocol sweep runs 1024 processes on one core) can starve the
+// coordinator past it mid-gather — raise via env there. Clamped so
+// seconds*1000 cannot overflow int (poll(2) treats negative timeouts
+// as INFINITE — a dead peer would hang forever, silently).
+static int ControlPollMs() {
+  static int ms = [] {
+    long long s = EnvInt("HVD_TPU_CONTROL_POLL_TIMEOUT_SECONDS", 60);
+    if (s <= 0) s = 60;
+    if (s > 2147483) s = 2147483;
+    return static_cast<int>(s * 1000);
+  }();
+  return ms;
 }
 
 static constexpr uint32_t kTagGather = 0x11;

@@ -199,9 +199,11 @@ def ssh_preflight(hostnames, ssh_port=None, timeout=5, fn_cache=None):
     rule)."""
     import concurrent.futures
 
+    CACHED = "cached"
+
     def probe(host):
         if fn_cache is not None and fn_cache.get("ssh://" + host):
-            return host, 0, ""
+            return host, 0, CACHED
         cmd = _ssh_base_cmd(
             ["-o", "BatchMode=yes", "-o", "ConnectTimeout=%d" % timeout],
             ssh_port=ssh_port)
@@ -219,7 +221,11 @@ def ssh_preflight(hostnames, ssh_port=None, timeout=5, fn_cache=None):
         for host, rc, err in pool.map(probe, hostnames):
             if rc != 0:
                 failures.append((host, err))
-            elif fn_cache is not None:
+            elif fn_cache is not None and err is not CACHED:
+                # Record REAL probes only: re-putting a cache hit would
+                # slide the entry's timestamp forever and the 60-minute
+                # staleness window would never re-probe a frequently
+                # used host.
                 fn_cache.put("ssh://" + host, True)
     if failures:
         detail = "\n".join("  %s: %s" % (h, e or "ssh exited nonzero")
