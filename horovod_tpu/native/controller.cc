@@ -373,7 +373,17 @@ ResponseList Controller::ComputeResponseList(
     for (uint32_t bit : invalid) response_cache_.erase_response(bit);
     response_cache_.update_cache_bits();
 
-    all_cached = !cache_coordinator.uncached_in_queue();
+    // A cycle that invalidated bits anywhere must run the FULL
+    // negotiation: invalidated local hits were just moved into
+    // non_cached_messages to renegotiate, and the fast-path return
+    // below would silently DROP them — the op's rank never reaches
+    // the coordinator's count and the job livelocks with a permanent
+    // "missing ranks" stall (hit live: a stall-inspector cache
+    // invalidation during a straggler wait; reference analogue of the
+    // invalid_in_queue gate in common/response_cache.cc's
+    // CoordinateCacheAndState flow).
+    all_cached = !cache_coordinator.uncached_in_queue() &&
+                 !cache_coordinator.invalid_in_queue();
   }
 
   if (cache_on && all_cached) {

@@ -94,6 +94,7 @@ class CacheCoordinator {
   const std::set<uint32_t>& timeline_bits() const { return timeline_bits_; }
   bool should_shut_down() const { return should_shut_down_; }
   bool uncached_in_queue() const { return uncached_in_queue_; }
+  bool invalid_in_queue() const { return invalid_in_queue_; }
 
   // Performs the cross-rank sync through the controller's bit-allreduce.
   // After this call, cache_hits() is the global intersection, and

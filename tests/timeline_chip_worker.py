@@ -17,18 +17,32 @@ import os
 import sys
 import time
 
+# Rank 0 re-engages the TPU plugin; the launcher scrubbed it for
+# everyone (N workers on one tunnel chip deadlock). The plugin
+# registers from sitecustomize at INTERPRETER BOOT, so setting the
+# pool pointer inside main() is too late — re-exec once with the env
+# prepared.
+if (os.environ.get("HVD_TPU_RANK", "0") == "0"
+        and os.environ.get("HVD_TPU_AXON_SAVED")
+        and not os.environ.get("HVD_TPU_TL_REEXECED")):
+    os.environ["HVD_TPU_TL_REEXECED"] = "1"
+    os.environ["PALLAS_AXON_POOL_IPS"] = os.environ["HVD_TPU_AXON_SAVED"]
+    os.environ.pop("JAX_PLATFORM_NAME", None)
+    os.environ.pop("JAX_PLATFORMS", None)
+    # Its OWN persistent-jit-cache namespace: the tunnel's
+    # remote-compile service builds AOT artifacts on a host with
+    # different CPU features, and a CPU-backend worker loading them
+    # from a SHARED cache dir hangs/SIGILLs (hit live: the first
+    # capture run poisoned the common cache for rank 1).
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        os.environ["JAX_COMPILATION_CACHE_DIR"] += "_axon"
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
 import numpy as np
 
 
 def main():
     r = int(os.environ.get("HVD_TPU_RANK", "0"))
-    if r == 0 and os.environ.get("HVD_TPU_AXON_SAVED"):
-        # Rank 0 re-engages the TPU plugin; the launcher scrubbed it
-        # for everyone (N workers on one tunnel chip deadlock).
-        os.environ["PALLAS_AXON_POOL_IPS"] = \
-            os.environ["HVD_TPU_AXON_SAVED"]
-        os.environ.pop("JAX_PLATFORM_NAME", None)
-        os.environ.pop("JAX_PLATFORMS", None)
 
     import jax
     import jax.numpy as jnp
@@ -60,10 +74,16 @@ def main():
         grads = grads_fn(params)
         host_grads = [np.asarray(g, np.float32) for g in grads]
         if r == 1 and step == 3:
-            # Straggle past HVD_TPU_STALL_CHECK_TIME_SECONDS: the
-            # coordinator reports this rank missing from the step's
-            # negotiation while rank 0 (chip-attached) waits.
-            time.sleep(4)
+            # Straggle WELL past HVD_TPU_STALL_CHECK_TIME_SECONDS. Two
+            # things must happen on the coordinator while rank 0
+            # waits: the stalled CACHED tensor is invalidated and
+            # renegotiated (the path whose fast-path drop once
+            # livelocked this exact workload — controller.cc
+            # invalid_in_queue gate), and the renegotiated tensor then
+            # crosses the threshold again so the stall WARNING names
+            # this rank.
+            time.sleep(float(os.environ.get("HVD_TPU_TL_STRAGGLE",
+                                            "7")))
         reduced = [hvd.allreduce(g, "grad.layer%d" % i)
                    for i, g in enumerate(host_grads)]
         params = [p - lr * jnp.asarray(g)
