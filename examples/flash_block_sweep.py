@@ -7,9 +7,10 @@ single-dispatch lax.scan recipe and prints a table.
 
 Usage: python examples/flash_block_sweep.py [--B 8 --L 2048 --H 12 --D 64]
 GQA/MQA (--G < --H) sweeps the grouped-rows layout: the q-block
-candidates become bqp*group rows (the `_grouped_blocks` policy was
-tuned from this sweep at B2 H6 G2 L8192 D128 — grouped layouts want
-bigger row blocks and bk=512).
+candidates become bqp*group rows. The `_grouped_blocks` policy was
+tuned from this sweep at two points — B2 H6 G2 L8192 D128 (1536/512)
+and B2 H12 G3 L8192 D64 (2048/512; 2048/1024 overflows VMEM) —
+grouped layouts want bigger row blocks and bk=512 at long L.
 """
 
 import argparse
@@ -33,7 +34,10 @@ def timed(fn, args, iters=30):
         out = fn(*carry)
         if isinstance(out, tuple):
             out = out[0]
-        return (carry[0] + 1e-30 * out,) + carry[1:], ()
+        # Cast: fwd returns a bf16 tensor but the fwd+bwd probe
+        # returns an f32 scalar, which would promote the carry.
+        return (carry[0] + (1e-30 * out).astype(carry[0].dtype),) \
+            + carry[1:], ()
 
     def run(*args):
         carry, _ = lax.scan(body, args, None, length=iters)

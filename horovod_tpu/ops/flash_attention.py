@@ -243,19 +243,21 @@ def _pick_rows_block(L, preferred, group):
 
 
 def _grouped_blocks(D, L, group, backward=False):
-    """(rows_cap, block_k) for grouped-GQA layouts. v5e sweep at the
-    h6/G=2 shape (group=3, D=128, L=8192; reproducible via
-    examples/flash_block_sweep.py --G 2): grouped blocks want MORE rows
-    and a NARROWER k block than the plain policy — fwd 1536/512 beats
-    the plain-cap 384/512 by 10% AND plain MHA itself by 1.4%; bwd
-    1536/512 is 22% under the plain-cap pick and 19% under plain MHA
-    (the in-kernel dK/dV group reduction writes G instead of H heads).
-    Shapes without sweep data (D<=64 or short L) keep the conservative
-    plain-preference cap."""
+    """(rows_cap, block_k) for grouped-GQA layouts. v5e sweeps
+    (examples/flash_block_sweep.py --G N) at L=8192: grouped blocks
+    want MORE rows and a NARROWER k block than the plain policy —
+    D=128 group=3: fwd 1536/512 beats the plain-cap 384/512 by 10%
+    AND plain MHA itself by 1.4%; bwd 1536/512 is 22% under the
+    plain-cap pick and 19% under plain MHA (the in-kernel dK/dV group
+    reduction writes G instead of H heads). D=64 group=4: 2048/512
+    beats the plain-cap 512/1024 by 6% fwd / 10% fwd+bwd (2048/1024
+    overflows VMEM — s alone is 8 MB f32). Shapes without sweep data
+    (short L) keep the conservative plain-preference cap."""
     pq, pk = _default_blocks(D, L, backward)
     long_seq = L is not None and L >= 4096
-    if group > 1 and D > 64 and long_seq:
-        return 1536, (512 if L % 512 == 0 else pk)
+    if group > 1 and long_seq:
+        cap = 1536 if D > 64 else 2048
+        return cap, (512 if L % 512 == 0 else pk)
     return pq, pk
 
 
