@@ -10,6 +10,9 @@ Baseline: the reference's only published absolute throughput is ResNet-101
 at 1656.82 images/sec over 16 Pascal P100s (`docs/benchmarks.rst:43`) =
 103.55 images/sec/GPU; `vs_baseline` is images/sec/chip over that number
 (cross-model when --model != resnet101 — the `baseline` field says so).
+Rows with no reference measurement at all (LM configs, word2vec, the
+zoo aggregate) emit `"vs_baseline": null` — never a literal 0.0 that an
+aggregator would read as a measured 0% delta.
 
 MFU honesty: FLOPs per step come from XLA's own cost analysis of the
 compiled train step (not a hand-count), divided by measured step time and
@@ -319,7 +322,7 @@ def _tpu_probe_or_report(timeout=240):
     if not ok:
         print(json.dumps({
             "metric": "bench_unavailable", "value": 0.0,
-            "unit": "error", "vs_baseline": 0.0,
+            "unit": "error", "vs_baseline": None,
             "baseline": "TPU backend unreachable; see PERF.md / "
                         "BENCH_ZOO_r03.json for the last good "
                         "captures", "error": err.strip()}))
@@ -372,7 +375,7 @@ def all_models_main(args):
         "metric": "model_zoo_sweep",
         "value": round(best_mfu, 3),
         "unit": "best_mfu",
-        "vs_baseline": 0.0,
+        "vs_baseline": None,
         "baseline": "per-model details in `models`",
         "models": results,
     }))
@@ -489,7 +492,7 @@ def scaling_main(args):
     print(json.dumps(out))
 
 
-def w2v_make_step(mesh, n, sparse, lr=0.5, num_iters=100):
+def w2v_make_step(mesh, n, sparse, lr=0.5, num_iters=100, donate=True):
     """Skip-gram NCE multi-step train fn over a dp mesh, sparse or
     dense gradient plane. The IndexedSlices rationale (reference
     horovod/tensorflow/__init__.py:65-76) as a measurable A/B:
@@ -562,7 +565,12 @@ def w2v_make_step(mesh, n, sparse, lr=0.5, num_iters=100):
         run, mesh=mesh,
         in_specs=(P(), P(), P(), P("dp"), P("dp"), P()),
         out_specs=(P(), P(), P(), P()), check_vma=False)
-    return jax.jit(sharded, donate_argnums=(0, 1, 2))
+    # donate=False exists for the CPU-mesh equivalence test: old jaxlib
+    # CPU runtimes intermittently reuse donated buffers before the scan
+    # reads them (garbage outputs); the benchmark itself keeps donation
+    # for the in-place table-update memory footprint.
+    return jax.jit(sharded,
+                   donate_argnums=(0, 1, 2) if donate else ())
 
 
 def word2vec_main(args):
@@ -626,7 +634,7 @@ def word2vec_main(args):
         "metric": "word2vec_sparse_steps_per_sec_per_chip",
         "value": round(sparse_sps, 1),
         "unit": "steps/sec/chip",
-        "vs_baseline": 0.0,
+        "vs_baseline": None,
         "baseline": "reference tensorflow_word2vec (BASELINE.json #4) "
                     "publishes no steps/s; the dense-equivalent A/B "
                     "of the same model rides in this row",
@@ -927,7 +935,7 @@ def main():
                       % (label, args.seq_len),
             "value": round(per_chip, 2),
             "unit": unit,
-            "vs_baseline": 0.0,
+            "vs_baseline": None,
             "baseline": "no reference LM baseline (the reference has no "
                         "long-context path); tokens/sec/chip = %.0f"
                         % (per_chip * per_item_tokens),

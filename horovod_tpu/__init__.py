@@ -94,6 +94,13 @@ def _maybe_rendezvous():
     rdv_addr = os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
     if not rdv_addr:
         return
+    if os.environ.get("HVD_TPU_ELASTIC") == "1" and \
+            "HVD_TPU_RANK" not in os.environ:
+        # Elastic worker: rank/size/generation come from the driver-
+        # published membership, not the spawn env (they change every
+        # generation; see elastic/run.py).
+        from .elastic.run import bootstrap_topology
+        bootstrap_topology()
     size = int(os.environ.get("HVD_TPU_SIZE", "1"))
     if size <= 1:
         return
@@ -104,8 +111,10 @@ def _maybe_rendezvous():
             "(check ssh env forwarding)")
     rank = int(os.environ["HVD_TPU_RANK"])
     timeout = float(os.environ.get("HVD_TPU_START_TIMEOUT", "60"))
+    generation = int(os.environ.get("HVD_TPU_GENERATION", "0") or 0)
     from .run import rendezvous as _rdv
-    os.environ.update(_rdv.resolve_topology(rank, size, rdv_addr, timeout))
+    os.environ.update(_rdv.resolve_topology(rank, size, rdv_addr, timeout,
+                                            generation=generation))
 
 
 def init(ranks=None):

@@ -98,6 +98,7 @@ class HorovodBasics:
                    "horovod_tpu_cross_rank", "horovod_tpu_size",
                    "horovod_tpu_local_size", "horovod_tpu_cross_size",
                    "horovod_tpu_initialized", "horovod_tpu_is_homogeneous",
+                   "horovod_tpu_connection_lost",
                    "horovod_tpu_tcp_built", "horovod_tpu_cpu_ops_built"):
             getattr(lib, fn).restype = ctypes.c_int
         lib.horovod_tpu_enqueue_allreduce.restype = ctypes.c_int
@@ -161,6 +162,11 @@ class HorovodBasics:
 
     def initialized(self):
         return bool(self.lib.horovod_tpu_initialized())
+
+    def connection_lost(self):
+        """True when the background loop died because a peer connection
+        was lost (elastic-recoverable), not a requested shutdown."""
+        return bool(self.lib.horovod_tpu_connection_lost())
 
     def perf_counters(self):
         """(responses_performed, tensors_performed) — fusion

@@ -1,0 +1,54 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current stable API (``jax.shard_map`` with the
+``check_vma`` flag). Older environments (jax <= 0.4.x) only ship
+``jax.experimental.shard_map.shard_map`` with the flag spelled
+``check_rep`` — without a shim every shard_map'd path (parallel train
+steps, the multichip dryrun, most distributed tests) dies at import
+time on such containers. ``ensure_jax_compat()`` installs a forwarding
+wrapper once; on current jax it is a no-op.
+"""
+
+
+def ensure_jax_compat():
+    import jax
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        if not hasattr(pltpu, "CompilerParams") and \
+                hasattr(pltpu, "TPUCompilerParams"):
+            # Renamed TPUCompilerParams -> CompilerParams in newer jax;
+            # the kernels use the current spelling.
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:
+        pass
+
+    if not hasattr(jax.distributed, "is_initialized"):
+        # Added in newer jax; the old spelling is the global_state
+        # client check (what is_initialized wraps upstream).
+        def _dist_is_initialized():
+            try:
+                from jax._src.distributed import global_state
+                return global_state.client is not None
+            except Exception:
+                return False
+        jax.distributed.is_initialized = _dist_is_initialized
+
+    if not hasattr(jax.lax, "axis_size"):
+        # psum of the literal 1 over a named axis resolves statically to
+        # the axis size on every jax version — the old-API spelling of
+        # lax.axis_size.
+        jax.lax.axis_size = lambda axis_name: jax.lax.psum(1, axis_name)
+
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = bool(check_vma)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+
+    jax.shard_map = shard_map

@@ -1,0 +1,36 @@
+"""Elastic training: survive membership changes without a job restart.
+
+Reference lineage: Horovod Elastic (the successor capability to the
+reproduced v0.17 — ``horovod/run/elastic/`` + ``horovod/common/elastic.py``
+in later releases). A running job *shrinks* when a worker dies (survivors
+roll back to the last committed state and continue at reduced size) and
+*grows* when hosts return (the driver spawns replacements that sync state
+from rank 0) — instead of the classic kill-all-on-first-exit teardown.
+
+Pieces:
+
+* :class:`ElasticState` (``state.py``) — commits/restores a pytree of
+  model + optimizer arrays, and syncs it from rank 0 after every
+  membership change.
+* :func:`run` (``run.py``) — decorator that catches
+  ``HorovodInternalError`` (peer lost mid-collective: roll back, re-init,
+  re-sync) and ``HostsUpdatedInterrupt`` (graceful membership change:
+  re-init, re-sync, no rollback).
+* ``discovery.py`` — host discovery (script-driven or fixed) plus the
+  per-host failure blacklist with exponential backoff.
+* ``driver.py`` — the launcher-side supervisor: monitors workers,
+  blacklists failing hosts, bumps the rendezvous generation, and spawns
+  replacements, keeping the world between ``--min-np`` and ``--max-np``.
+
+See docs/ELASTIC.md for the state-commit semantics, the discovery script
+contract, and the failure model.
+"""
+
+from .discovery import (  # noqa: F401
+    FixedHosts,
+    HostDiscovery,
+    HostDiscoveryScript,
+    HostManager,
+)
+from .run import HostsUpdatedInterrupt, run  # noqa: F401
+from .state import ElasticState, State  # noqa: F401

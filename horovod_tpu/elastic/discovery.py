@@ -1,0 +1,140 @@
+"""Host discovery + failure blacklisting for elastic jobs.
+
+Reference analogue: ``horovod/run/elastic/discovery.py`` (HostDiscovery /
+HostDiscoveryScript / HostManager with host blacklisting); fresh
+implementation. The discovery contract: a source of truth (usually a
+user script) reports the currently-available hosts as ``host:slots``
+lines; the driver diffs successive readings to grow or shrink the job.
+
+Blacklisting differs from the reference's permanent blacklist: failures
+here carry an **exponential backoff** (base cooldown doubling per
+consecutive failure), because on TPU pods preempted hosts routinely come
+back — a permanent blacklist would turn every transient preemption into
+a permanent capacity loss.
+"""
+
+import subprocess
+import time
+
+
+class HostDiscovery:
+    """Interface: report the currently-available hosts."""
+
+    def find_available_hosts_and_slots(self):
+        """Returns {hostname: slots}."""
+        raise NotImplementedError
+
+
+class FixedHosts(HostDiscovery):
+    """Static host set (the non-discovery case, e.g. plain ``-H``)."""
+
+    def __init__(self, hosts):
+        # hosts: {hostname: slots} or a "h1:2,h2:2" string.
+        if isinstance(hosts, str):
+            from horovod_tpu.run.util import parse_hosts
+            hosts = {h.hostname: h.slots for h in parse_hosts(hosts)}
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self):
+        return dict(self._hosts)
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs a user script that prints one ``host`` or ``host:slots`` line
+    per available host (the reference's ``--host-discovery-script``
+    contract). A non-zero exit or unparseable output reads as "no
+    change" (the previous host set is kept) — a flaky discovery script
+    must not shrink a healthy job."""
+
+    def __init__(self, script, default_slots=1, timeout=10):
+        self._script = script
+        self._default_slots = default_slots
+        self._timeout = timeout
+        self._last = {}
+
+    def find_available_hosts_and_slots(self):
+        try:
+            out = subprocess.run(
+                self._script, shell=True, capture_output=True, text=True,
+                timeout=self._timeout)
+        except (subprocess.TimeoutExpired, OSError):
+            return dict(self._last)
+        if out.returncode != 0:
+            return dict(self._last)
+        hosts = {}
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if ":" in line:
+                name, _, slots = line.rpartition(":")
+                try:
+                    hosts[name] = int(slots)
+                except ValueError:
+                    continue
+            else:
+                hosts[line] = self._default_slots
+        self._last = dict(hosts)
+        return hosts
+
+
+class HostManager:
+    """Tracks the available host set and per-host failure blacklisting.
+
+    A host that causes a worker failure is blacklisted for
+    ``cooldown * 2**(consecutive_failures - 1)`` seconds (capped at
+    ``max_backoff``); it is not retried before the backoff expires, and
+    a success (a worker on the host outliving ``success_after``) resets
+    the streak. ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, discovery, cooldown=10.0, max_backoff=600.0,
+                 clock=time.monotonic):
+        self._discovery = discovery
+        self._cooldown = cooldown
+        self._max_backoff = max_backoff
+        self._clock = clock
+        self._current = {}
+        # host -> (consecutive_failures, blacklisted_until, failed_at)
+        self._failures = {}
+
+    def refresh(self):
+        """Re-reads discovery; returns True when the raw host set (before
+        blacklist filtering) changed."""
+        hosts = self._discovery.find_available_hosts_and_slots()
+        changed = hosts != self._current
+        self._current = hosts
+        return changed
+
+    def record_failure(self, host):
+        count, _, _ = self._failures.get(host, (0, 0.0, 0.0))
+        count += 1
+        now = self._clock()
+        backoff = min(self._cooldown * (2 ** (count - 1)),
+                      self._max_backoff)
+        self._failures[host] = (count, now + backoff, now)
+
+    def record_success(self, host, started_at=None):
+        """Clears the failure streak — but only on evidence that
+        POSTDATES the last failure: a worker that was already running
+        when the host failed proves nothing about the host now (without
+        this guard, one long-lived survivor on a multi-slot host would
+        wipe a fresh blacklist entry and defeat the backoff)."""
+        ent = self._failures.get(host)
+        if ent is None:
+            return
+        if started_at is not None and started_at <= ent[2]:
+            return
+        self._failures.pop(host, None)
+
+    def is_blacklisted(self, host):
+        ent = self._failures.get(host)
+        return ent is not None and self._clock() < ent[1]
+
+    def blacklisted_until(self, host):
+        ent = self._failures.get(host)
+        return ent[1] if ent else 0.0
+
+    def available_hosts_and_slots(self):
+        """The discovered host set minus currently-blacklisted hosts."""
+        return {h: s for h, s in self._current.items()
+                if not self.is_blacklisted(h)}

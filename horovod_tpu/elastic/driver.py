@@ -1,0 +1,345 @@
+"""Elastic driver: the launcher-side supervisor loop.
+
+Reference analogue: ``horovod/run/elastic/driver.py`` (ElasticDriver:
+worker monitoring, host blacklisting, rank reassignment, respawn); fresh
+implementation over this repo's rendezvous KV and middleman-wrapped
+process tree.
+
+Replaces the static launcher's kill-all-on-first-exit behavior: a failed
+worker shrinks the job (its host goes on the backoff blacklist, the
+generation number is bumped, and survivors re-rendezvous at the reduced
+size), a recovered host grows it back (a replacement worker is spawned
+and absorbed at the next generation) — all without restarting the
+surviving worker processes.
+
+Membership is published to the driver-owned rendezvous server at scope
+``elastic`` / key ``state``::
+
+    {"generation": g, "size": n,
+     "assignment": {"<worker_id>": rank, ...},
+     "status": "running" | "shutdown"}
+
+Worker ids are stable per spawned process; ranks are reassigned every
+generation in worker-id order, so the longest-lived worker is always the
+new rank 0 (the state-sync root).
+"""
+
+import collections
+import json
+import os
+import signal
+import sys
+import time
+
+from horovod_tpu.run import rendezvous, util
+
+from .discovery import HostManager
+from .state import KEY_STATE, SCOPE_ELASTIC
+
+_Slot = collections.namedtuple("_Slot", ["hostname", "rank"])
+
+
+class _Worker:
+    def __init__(self, worker_id, hostname, proc):
+        self.worker_id = worker_id
+        self.hostname = hostname
+        self.proc = proc
+        self.started = time.monotonic()
+        self.healthy = False  # outlived the health window at least once
+
+
+class ElasticDriver:
+    """Supervises elastic workers; returns the job's exit code from
+    :meth:`run`."""
+
+    def __init__(self, command, discovery, min_np, max_np,
+                 np_initial=None, ssh_port=None, start_timeout=60,
+                 verbose=False, env=None):
+        if min_np < 1 or max_np < min_np:
+            raise ValueError("need 1 <= min_np <= max_np (got %d..%d)"
+                             % (min_np, max_np))
+        self._command = list(command)
+        self._min_np = min_np
+        self._max_np = max_np
+        self._np_initial = np_initial
+        self._ssh_port = ssh_port
+        self._start_timeout = start_timeout
+        self._verbose = verbose
+        self._base_env = dict(env if env is not None else os.environ)
+        cooldown = float(os.environ.get("HVD_TPU_ELASTIC_COOLDOWN", "10"))
+        self._hosts = HostManager(discovery, cooldown=cooldown)
+        self._discovery_interval = float(
+            os.environ.get("HVD_TPU_ELASTIC_DISCOVERY_INTERVAL", "1.0"))
+
+        self._workers = {}  # worker_id -> _Worker
+        self._next_worker_id = 0
+        self._generation = -1  # first publish makes it 0
+        self._published_at = 0.0
+        self._published_size = 0
+        self._job_done = False
+        self._late_rcs = []
+
+        self._secret = rendezvous.make_secret()
+        self._server = rendezvous.RendezvousServer(key=self._secret)
+        self._addr = None
+
+    # -- worker spawn ------------------------------------------------------
+    def _worker_env(self, worker_id):
+        env = dict(self._base_env)
+        for key in ("HVD_TPU_ADDRS", "HVD_TPU_RANK", "HVD_TPU_SIZE",
+                    "HVD_TPU_LOCAL_RANK", "HVD_TPU_LOCAL_SIZE",
+                    "HVD_TPU_CROSS_RANK", "HVD_TPU_CROSS_SIZE",
+                    "HVD_TPU_GENERATION"):
+            env.pop(key, None)
+        env.update({
+            "HVD_TPU_ELASTIC": "1",
+            "HVD_TPU_WORKER_ID": str(worker_id),
+            "HVD_TPU_RENDEZVOUS_ADDR": self._addr,
+            rendezvous.KEY_ENV: self._secret,
+        })
+        env.setdefault("HVD_TPU_START_TIMEOUT", str(self._start_timeout))
+        return env
+
+    def _spawn(self, hostname):
+        from horovod_tpu.run.run import launch
+
+        wid = self._next_worker_id
+        self._next_worker_id += 1
+        slot = _Slot(hostname=hostname, rank=wid)
+        proc = launch([slot], [self._worker_env(wid)], self._command,
+                      ssh_port=self._ssh_port, verbose=self._verbose)[0]
+        self._workers[wid] = _Worker(wid, hostname, proc)
+        if self._verbose:
+            sys.stderr.write("[elastic] spawned worker %d on %s\n"
+                             % (wid, hostname))
+        return wid
+
+    # -- membership publication --------------------------------------------
+    def _publish(self, status="running"):
+        self._generation += 1
+        assignment = {str(wid): rank for rank, wid in
+                      enumerate(sorted(self._workers))}
+        self._server.put_local(SCOPE_ELASTIC, KEY_STATE, json.dumps({
+            "generation": self._generation,
+            "size": len(assignment),
+            "assignment": assignment,
+            "status": status,
+        }))
+        self._published_at = time.monotonic()
+        self._published_size = len(assignment)
+        if self._verbose:
+            sys.stderr.write("[elastic] generation %d: %s\n"
+                             % (self._generation, assignment))
+
+    def _publish_done(self):
+        """Re-publishes the current generation with status \"done\": a
+        replacement still waiting in bootstrap/rendezvous when training
+        finishes has no generation left to join — it must exit cleanly
+        instead of timing out with a failure rc. Generation is NOT
+        bumped, so workers mid-training are not interrupted."""
+        assignment = {str(wid): rank for rank, wid in
+                      enumerate(sorted(self._workers))}
+        self._server.put_local(SCOPE_ELASTIC, KEY_STATE, json.dumps({
+            "generation": self._generation,
+            "size": len(assignment),
+            "assignment": assignment,
+            "status": "done",
+        }))
+
+    def _generation_stalled(self):
+        """True when the current generation's rendezvous has not
+        converged (no resolved table) within the start timeout — e.g. a
+        participant died mid-rendezvous without the driver noticing an
+        exit. Bumping the generation unsticks the survivors."""
+        if self._published_size <= 1:
+            return False  # size-1 generations do not rendezvous
+        if time.monotonic() - self._published_at < self._start_timeout:
+            return False
+        resolved = self._server.scope_items(
+            rendezvous.gen_scope(rendezvous.SCOPE_RESOLVED,
+                                 self._generation))
+        return "table" not in resolved
+
+    def _reinit_requested(self):
+        """True when any live worker published a reinit request for the
+        current (or a newer) generation — its core lost a peer connection
+        without any process exiting."""
+        for key, val in self._server.scope_items(SCOPE_ELASTIC).items():
+            if not key.startswith("reinit/"):
+                continue
+            try:
+                if int(val.decode()) >= self._generation:
+                    return True
+            except ValueError:
+                continue
+        return False
+
+    # -- monitoring --------------------------------------------------------
+    def _reap(self):
+        """Collects exited workers. Returns True when membership changed
+        due to a failure."""
+        changed = False
+        health_after = min(10.0, self._start_timeout)
+        now = time.monotonic()
+        for wid, w in list(self._workers.items()):
+            rc = w.proc.poll()
+            if rc is None:
+                if not w.healthy and now - w.started > health_after:
+                    w.healthy = True
+                    self._hosts.record_success(w.hostname,
+                                               started_at=w.started)
+                continue
+            del self._workers[wid]
+            if rc == 0:
+                if not self._job_done:
+                    self._job_done = True
+                    self._publish_done()
+                if self._verbose:
+                    sys.stderr.write(
+                        "[elastic] worker %d finished\n" % wid)
+            elif self._job_done:
+                self._late_rcs.append(rc)
+            else:
+                sys.stderr.write(
+                    "[elastic] worker %d on %s failed (rc=%d); "
+                    "blacklisting host with backoff\n"
+                    % (wid, w.hostname, rc))
+                self._hosts.record_failure(w.hostname)
+                changed = True
+        return changed
+
+    def _plan_growth(self):
+        """Hosts with free, non-blacklisted slots to spawn on (one entry
+        per new worker), capped at max_np."""
+        room = self._max_np - len(self._workers)
+        if room <= 0 or self._job_done:
+            return []
+        live_per_host = collections.Counter(
+            w.hostname for w in self._workers.values())
+        plan = []
+        for host, slots in sorted(
+                self._hosts.available_hosts_and_slots().items()):
+            free = slots - live_per_host.get(host, 0)
+            for _ in range(max(0, free)):
+                if len(plan) >= room:
+                    return plan
+                plan.append(host)
+        return plan
+
+    def _kill_all(self):
+        for w in self._workers.values():
+            try:
+                os.killpg(os.getpgid(w.proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    # -- main loop ---------------------------------------------------------
+    def run(self):
+        local_addr = self._base_env.get("HVD_TPU_RENDEZVOUS_HOST")
+        self._hosts.refresh()
+        hosts = self._hosts.available_hosts_and_slots()
+        if local_addr is None:
+            remote = [h for h in hosts if not util.is_local_host(h)]
+            local_addr = (rendezvous.routable_ip(remote[0]) if remote
+                          else "127.0.0.1")
+        self._addr = "%s:%d" % (local_addr, self._server.start())
+
+        def on_signal(signum, frame):
+            self._publish(status="shutdown")
+            self._kill_all()
+            sys.exit(1)
+
+        old_int = signal.signal(signal.SIGINT, on_signal)
+        old_term = signal.signal(signal.SIGTERM, on_signal)
+        try:
+            return self._run_loop()
+        finally:
+            signal.signal(signal.SIGINT, old_int)
+            signal.signal(signal.SIGTERM, old_term)
+            self._server.stop()
+
+    def _run_loop(self):
+        # Initial cohort: -np (clamped to capacity and max_np); spawning
+        # less than min_np up front is a hard error — elasticity begins
+        # once a valid job exists.
+        capacity = sum(self._hosts.available_hosts_and_slots().values())
+        target = min(self._np_initial or capacity, self._max_np, capacity)
+        if target < self._min_np:
+            raise RuntimeError(
+                "elastic launch needs at least --min-np=%d slots but "
+                "discovery found %d" % (self._min_np, capacity))
+        plan = self._plan_growth()[:target]
+        below_min_since = None
+        last_discovery = 0.0
+        while True:
+            if plan and self._job_done:
+                plan = []  # completion won the race against a planned grow
+            if plan:
+                # Spawn first (allocating the new worker ids), then
+                # publish one assignment covering old + new workers.
+                # Ordering is race-free either way: starting workers
+                # poll the assignment until their id appears, and live
+                # workers notice the bumped generation at their next
+                # commit.
+                for host in plan:
+                    self._spawn(host)
+                self._publish()
+                plan = []
+            time.sleep(0.1)
+            changed = self._reap()
+            if self._job_done:
+                if not self._workers:
+                    return max(self._late_rcs, default=0)
+                continue  # let the rest finish; no more respawns
+            if not changed and self._reinit_requested():
+                sys.stderr.write("[elastic] reinit requested by a worker; "
+                                 "bumping generation\n")
+                changed = True
+            if not changed and self._generation_stalled():
+                sys.stderr.write("[elastic] generation %d stalled; "
+                                 "bumping\n" % self._generation)
+                changed = True
+
+            now = time.monotonic()
+            if now - last_discovery > self._discovery_interval:
+                last_discovery = now
+                self._hosts.refresh()
+            plan = self._plan_growth()
+
+            if len(self._workers) + len(plan) < self._min_np:
+                plan = []
+                if not self._workers:
+                    self._publish(status="shutdown")
+                    sys.stderr.write(
+                        "[elastic] no workers left and no spawnable "
+                        "hosts; failing the job\n")
+                    return 1
+                if below_min_since is None:
+                    below_min_since = now
+                elif now - below_min_since > self._start_timeout:
+                    sys.stderr.write(
+                        "[elastic] stuck below --min-np=%d for %ds; "
+                        "tearing down\n"
+                        % (self._min_np, int(self._start_timeout)))
+                    self._publish(status="shutdown")
+                    self._kill_all()
+                    return 1
+                continue
+            below_min_since = None
+            if changed and not plan:
+                self._publish()
+            # When plan is non-empty (with or without a membership
+            # change), the top of the next iteration spawns the new
+            # workers first — allocating their worker ids — and then
+            # publishes one combined assignment.
+
+
+def run_elastic(np_, discovery, command, min_np, max_np, ssh_port=None,
+                start_timeout=60, verbose=False, env=None):
+    """Launcher entry: supervise `command` elastically. Returns exit
+    code."""
+    driver = ElasticDriver(command, discovery, min_np, max_np,
+                           np_initial=np_, ssh_port=ssh_port,
+                           start_timeout=start_timeout, verbose=verbose,
+                           env=env)
+    return driver.run()

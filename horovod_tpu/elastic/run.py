@@ -1,0 +1,222 @@
+"""The ``@hvd.elastic.run`` decorator + worker-side elastic plumbing.
+
+Reference analogue: ``horovod/common/elastic.py::run_fn`` (catch
+HorovodInternalError -> restore committed state -> reinitialize -> resync;
+catch HostsUpdatedInterrupt -> reinitialize without rollback); fresh
+implementation over this repo's generation-numbered rendezvous.
+
+Worker-side protocol (driver side in ``elastic/driver.py``):
+
+* The driver publishes the current membership to the rendezvous KV at
+  scope ``elastic`` key ``state``:
+  ``{"generation": g, "size": n, "assignment": {worker_id: rank},
+  "status": "running"|"shutdown"}``.
+* ``bootstrap_topology()`` (called from ``hvd.init()`` when
+  ``HVD_TPU_ELASTIC=1`` and no rank env is present) polls that key until
+  this worker's id appears, then sets ``HVD_TPU_RANK/SIZE/GENERATION``;
+  the normal dynamic rendezvous then runs in the generation's own scope.
+* On ``HorovodInternalError`` the wrapper publishes a reinit request
+  (scope ``elastic``, key ``reinit/<worker_id>``) so the driver bumps the
+  generation promptly even when no process exited (e.g. a transport
+  error), then waits for a generation NEWER than the one that failed.
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+from horovod_tpu.common.ops import HorovodInternalError
+
+from .state import KEY_STATE, SCOPE_ELASTIC, HostsUpdatedInterrupt
+
+# Env keys owned by a single generation's topology; scrubbed before
+# re-rendezvous so nothing stale leaks into the next generation.
+_GENERATION_ENV_KEYS = (
+    "HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_LOCAL_RANK",
+    "HVD_TPU_LOCAL_SIZE", "HVD_TPU_CROSS_RANK", "HVD_TPU_CROSS_SIZE",
+    "HVD_TPU_ADDRS",
+)
+
+
+def _log(msg):
+    sys.stderr.write("[elastic] %s\n" % msg)
+    sys.stderr.flush()
+
+
+class JobCompleted(Exception):
+    """The driver published status \"done\" (another worker finished the
+    training) while this worker was waiting to (re)join a generation —
+    there is nothing left to join. The ``@run`` wrapper treats it as a
+    clean exit and returns None from the wrapped function."""
+
+
+def _is_elastic():
+    return os.environ.get("HVD_TPU_ELASTIC") == "1" and \
+        os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+
+
+def current_generation():
+    return int(os.environ.get("HVD_TPU_GENERATION", "0") or 0)
+
+
+def _elastic_timeout():
+    return float(os.environ.get(
+        "HVD_TPU_ELASTIC_TIMEOUT",
+        os.environ.get("HVD_TPU_START_TIMEOUT", "120")))
+
+
+def fetch_assignment(addr, timeout, min_generation=0, worker_id=None):
+    """Polls the driver-published membership until its generation reaches
+    `min_generation` (and, when given, `worker_id` is assigned a rank).
+    Raises RuntimeError on driver shutdown, TimeoutError on expiry."""
+    from horovod_tpu.run import rendezvous
+
+    deadline = time.monotonic() + timeout
+    while True:
+        info = None
+        try:
+            raw = rendezvous.get(addr, SCOPE_ELASTIC, KEY_STATE)
+            if raw is not None:
+                info = json.loads(raw.decode())
+        except Exception:
+            info = None
+        if info is not None:
+            if info.get("status") == "shutdown":
+                raise RuntimeError(
+                    "elastic driver is shutting down the job")
+            if info.get("status") == "done":
+                raise JobCompleted(
+                    "training finished while waiting for generation "
+                    ">= %d" % min_generation)
+            if int(info["generation"]) >= min_generation and (
+                    worker_id is None or
+                    str(worker_id) in info["assignment"]):
+                return info
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                "timed out after %.0fs waiting for elastic generation "
+                ">= %d (worker %s)" % (timeout, min_generation, worker_id))
+        time.sleep(0.1)
+
+
+def bootstrap_topology(min_generation=0, timeout=None):
+    """Sets HVD_TPU_RANK/SIZE/GENERATION from the driver-published
+    assignment (this worker identified by HVD_TPU_WORKER_ID). The
+    subsequent dynamic rendezvous then runs in the generation's scope."""
+    addr = os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+    wid = os.environ.get("HVD_TPU_WORKER_ID")
+    if not addr or wid is None:
+        raise RuntimeError(
+            "HVD_TPU_ELASTIC=1 requires HVD_TPU_RENDEZVOUS_ADDR and "
+            "HVD_TPU_WORKER_ID (spawn workers through the elastic "
+            "launcher: horovodrun_tpu --min-np ...)")
+    info = fetch_assignment(
+        addr, _elastic_timeout() if timeout is None else timeout,
+        min_generation=min_generation, worker_id=wid)
+    for key in _GENERATION_ENV_KEYS:
+        os.environ.pop(key, None)
+    os.environ["HVD_TPU_RANK"] = str(info["assignment"][str(wid)])
+    os.environ["HVD_TPU_SIZE"] = str(info["size"])
+    os.environ["HVD_TPU_GENERATION"] = str(info["generation"])
+    return info
+
+
+def _request_reinit(failed_generation):
+    """Tells the driver this worker's core hit a connection loss in
+    `failed_generation`, so it bumps the generation even when no process
+    exit was observed. Best-effort."""
+    addr = os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+    wid = os.environ.get("HVD_TPU_WORKER_ID", "?")
+    if not addr:
+        return
+    from horovod_tpu.run import rendezvous
+    try:
+        rendezvous.put(addr, SCOPE_ELASTIC, "reinit/%s" % wid,
+                       str(failed_generation), timeout=5)
+    except Exception:
+        pass
+
+
+def _reinitialize(min_generation):
+    """Tears the core down and re-initializes for a new generation,
+    retrying with ever-newer generations until the elastic timeout."""
+    import horovod_tpu as hvd
+
+    deadline = time.monotonic() + _elastic_timeout()
+    while True:
+        hvd.shutdown()
+        if not _is_elastic():
+            # Same-topology restart (size-1 tests / manual recovery).
+            hvd.init()
+            return
+        try:
+            bootstrap_topology(min_generation=min_generation,
+                               timeout=max(1.0,
+                                           deadline - time.monotonic()))
+            hvd.init()
+            return
+        except JobCompleted:
+            raise
+        except (TimeoutError, RuntimeError, OSError) as e:
+            if time.monotonic() > deadline:
+                raise
+            # The generation we tried may itself have failed (e.g. the
+            # replacement died during startup). Require a newer one.
+            min_generation = max(min_generation, current_generation() + 1)
+            _log("re-init failed (%s); waiting for generation >= %d"
+                 % (e, min_generation))
+
+
+def run(func):
+    """Decorator making ``func(state, *args, **kwargs)`` elastic:
+
+    * ``HorovodInternalError`` (peer lost mid-collective): restore the
+      last committed state, re-initialize at the next generation, re-sync
+      from the new rank 0, and call ``func`` again.
+    * ``HostsUpdatedInterrupt`` (graceful membership change noticed at a
+      ``state.commit()``): re-initialize and re-sync WITHOUT rollback.
+
+    ``func`` must be resumable: it should read its progress (step/epoch)
+    from the state object, which survives across retries."""
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        import horovod_tpu as hvd
+
+        reset = None  # None = first entry, else "error" | "update"
+        min_generation = 0
+        while True:
+            try:
+                if reset is None:
+                    if not hvd.is_initialized():
+                        hvd.init()
+                else:
+                    _reinitialize(min_generation)
+                    if reset == "error":
+                        state.restore()
+                    _log("resuming at generation %d size %d (rank %d)"
+                         % (current_generation(), hvd.size(), hvd.rank()))
+                reset = None
+                state.sync()
+                return func(state, *args, **kwargs)
+            except HorovodInternalError as e:
+                _log("collective failed (%s); rolling back to last commit"
+                     % e)
+                reset = "error"
+                min_generation = current_generation() + 1
+                _request_reinit(current_generation())
+            except HostsUpdatedInterrupt as e:
+                _log("membership changed (generation %d); re-initializing"
+                     % e.generation)
+                reset = "update"
+                min_generation = e.generation
+            except JobCompleted as e:
+                # A replacement spawned just before the job finished has
+                # no generation left to join — that is success elsewhere,
+                # not a failure here.
+                _log(str(e))
+                return None
+
+    return wrapper

@@ -1,0 +1,220 @@
+"""Elastic state: committable, restorable, rank-0-syncable training state.
+
+Reference analogue: ``horovod/common/elastic.py`` (``State`` /
+``ObjectState`` and the framework TensorState subclasses); fresh
+implementation over host numpy so it works for every binding (the JAX
+flagship hands in pytrees of arrays; jnp arrays round-trip through
+``np.asarray``).
+
+Semantics (see docs/ELASTIC.md):
+
+* ``commit()`` — snapshot every registered attribute to host memory
+  (deep copy), then check for a pending membership change. A commit is
+  the rollback point: after a peer failure the job resumes from the
+  LAST COMMIT, so commit frequency trades checkpoint cost against lost
+  work (exactly the reference's contract).
+* ``restore()`` — load the last committed snapshot back into the
+  attributes (called by the ``@run`` wrapper on ``HorovodInternalError``).
+* ``sync()`` — broadcast every attribute from rank 0 over the host core
+  (called after every (re)initialization so rejoining or fresh workers
+  adopt the survivors' state, and survivors agree bit-for-bit).
+"""
+
+import copy
+import json
+import os
+import time
+
+import numpy as np
+
+SCOPE_ELASTIC = "elastic"
+KEY_STATE = "state"
+
+
+def _tree_flatten(obj, path=""):
+    """Flattens nested dict/list/tuple containers to [(path, leaf)] with a
+    deterministic order (dict keys sorted) so every rank names leaves
+    identically during sync broadcasts."""
+    if isinstance(obj, dict):
+        out = []
+        for k in sorted(obj, key=str):
+            out.extend(_tree_flatten(obj[k], "%s.%s" % (path, k)))
+        return out
+    if isinstance(obj, (list, tuple)):
+        out = []
+        for i, v in enumerate(obj):
+            out.extend(_tree_flatten(v, "%s.%d" % (path, i)))
+        return out
+    return [(path, obj)]
+
+
+def _tree_map_leaves(obj, leaves_iter):
+    """Rebuilds `obj`'s structure taking leaves from `leaves_iter` in the
+    same deterministic order _tree_flatten produces."""
+    if isinstance(obj, dict):
+        items = {k: _tree_map_leaves(obj[k], leaves_iter)
+                 for k in sorted(obj, key=str)}
+        return {k: items[k] for k in obj}  # preserve original key order
+    if isinstance(obj, (list, tuple)):
+        vals = [_tree_map_leaves(v, leaves_iter) for v in obj]
+        if isinstance(obj, tuple):
+            # NamedTuples (optax optimizer states, flax structs) take
+            # positional fields, not an iterable.
+            return type(obj)(*vals) if hasattr(obj, "_fields") \
+                else tuple(vals)
+        return vals
+    return next(leaves_iter)
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Raised from ``commit()``/``check_host_updates()`` when the driver
+    published a newer generation (a host joined or was removed
+    gracefully). The ``@run`` wrapper catches it and re-initializes
+    WITHOUT rolling back (current state is still globally consistent)."""
+
+    def __init__(self, generation):
+        super().__init__("membership changed: generation %d" % generation)
+        self.generation = generation
+
+
+def _poll_published_generation():
+    """The driver-published generation number, or None outside elastic
+    mode / on any rendezvous hiccup (a missed poll must never take down
+    a healthy training loop)."""
+    addr = os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+    if os.environ.get("HVD_TPU_ELASTIC") != "1" or not addr:
+        return None
+    from horovod_tpu.run import rendezvous
+    try:
+        raw = rendezvous.get(addr, SCOPE_ELASTIC, KEY_STATE)
+        if raw is None:
+            return None
+        return int(json.loads(raw.decode())["generation"])
+    except Exception:
+        return None
+
+
+class State:
+    """Base: non-underscore attributes set on the object are elastic
+    state (underscore names are reserved for the machinery)."""
+
+    def __init__(self, **kwargs):
+        self._committed = None
+        self._last_check = 0.0
+        self._check_interval = float(
+            os.environ.get("HVD_TPU_ELASTIC_CHECK_INTERVAL", "0.5"))
+        for k, v in kwargs.items():
+            if k.startswith("_"):
+                raise ValueError(
+                    "elastic state attribute %r: underscore names are "
+                    "reserved" % k)
+            setattr(self, k, v)
+
+    def _public(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+    # -- commit / restore --------------------------------------------------
+    def save(self):
+        """Snapshots the current attribute values (host deep copy)."""
+        self._committed = {
+            k: copy.deepcopy(self._to_host(v))
+            for k, v in self._public().items()}
+
+    def commit(self):
+        """save() + check_host_updates() — the reference's commit contract:
+        the snapshot lands first, so a membership interrupt raised here
+        still resumes from the state just committed."""
+        self.save()
+        self.check_host_updates()
+
+    def restore(self):
+        """Loads the last committed snapshot back into the attributes."""
+        if self._committed is None:
+            return
+        for k, v in self._committed.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    @staticmethod
+    def _to_host(value):
+        """Materializes device arrays (jnp etc.) as host numpy; leaves
+        plain containers/scalars untouched."""
+        def conv(leaf):
+            if hasattr(leaf, "__array__") and not isinstance(
+                    leaf, np.ndarray):
+                return np.asarray(leaf)
+            return leaf
+        leaves = iter([conv(l) for _, l in _tree_flatten(value)])
+        return _tree_map_leaves(value, leaves)
+
+    # -- membership-change polling ----------------------------------------
+    def check_host_updates(self):
+        """Raises HostsUpdatedInterrupt when the driver published a newer
+        generation than the one this process initialized under.
+        Rate-limited (HVD_TPU_ELASTIC_CHECK_INTERVAL seconds) so the
+        per-step cost is one monotonic-clock read."""
+        now = time.monotonic()
+        if now - self._last_check < self._check_interval:
+            return
+        self._last_check = now
+        published = _poll_published_generation()
+        if published is None:
+            return
+        current = int(os.environ.get("HVD_TPU_GENERATION", "0") or 0)
+        if published > current:
+            raise HostsUpdatedInterrupt(published)
+
+    # -- cross-rank sync ---------------------------------------------------
+    def sync(self, root_rank=0):
+        """Broadcasts every registered attribute from `root_rank` over the
+        host core. No-op at size 1. All ranks must hold structurally
+        identical state (same tree, same leaf shapes/dtypes) — true by
+        construction when every worker builds the state the same way."""
+        import horovod_tpu as hvd
+        from horovod_tpu.common import ops as _ops
+
+        if not hvd.is_initialized() or hvd.size() <= 1:
+            return
+        state = self._public()
+        flat = _tree_flatten(state)
+        handles = []
+        for path, leaf in flat:
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            handles.append((path, leaf, arr, _ops.broadcast_async(
+                arr, root_rank, "elastic_sync%s" % path)))
+        synced = []
+        for path, leaf, arr, h in handles:
+            out = _ops.synchronize(h)
+            if isinstance(leaf, np.ndarray) or (
+                    hasattr(leaf, "__array__")
+                    and not np.isscalar(leaf)):
+                synced.append(np.asarray(out).reshape(np.shape(leaf)))
+            elif isinstance(leaf, bool):
+                synced.append(bool(np.asarray(out).reshape(())))
+            elif isinstance(leaf, int):
+                synced.append(int(np.asarray(out).reshape(())))
+            elif isinstance(leaf, float):
+                synced.append(float(np.asarray(out).reshape(())))
+            else:
+                synced.append(out)
+        rebuilt = _tree_map_leaves(state, iter(synced))
+        for k, v in rebuilt.items():
+            setattr(self, k, v)
+
+
+class ElasticState(State):
+    """The concrete state users hand to ``@hvd.elastic.run``: any pytree
+    of numpy/JAX arrays and python scalars passed as keyword arguments
+    becomes a committable attribute, e.g.::
+
+        state = hvd.elastic.ElasticState(params=params,
+                                         opt_state=opt_state, step=0)
+
+        @hvd.elastic.run
+        def train(state):
+            while state.step < total_steps:
+                ...
+                state.step += 1
+                if state.step % 10 == 0:
+                    state.commit()
+    """

@@ -22,6 +22,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from horovod_tpu.compat import ensure_jax_compat as _ensure_jax_compat
+
+_ensure_jax_compat()
+
 import horovod_tpu as _hvd
 from horovod_tpu import (  # noqa: F401
     init, shutdown, is_initialized, rank, local_rank, cross_rank, size,
@@ -29,6 +33,9 @@ from horovod_tpu import (  # noqa: F401
     mpi_threads_supported, mpi_enabled, mpi_built, gloo_enabled,
     gloo_built, nccl_built, ddl_built, mlsl_built,
 )
+# Elastic API: hvd.elastic.run / hvd.elastic.ElasticState (reference
+# analogue: horovod.tensorflow.elastic).
+from horovod_tpu import elastic  # noqa: F401
 from horovod_tpu.common import ops as _ops
 
 # Default mapped-axis name for the in-jit data plane.

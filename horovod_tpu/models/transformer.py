@@ -184,6 +184,11 @@ class Block(nn.Module):
         cfg = self.cfg
         norm = lambda name: nn.RMSNorm(  # noqa: E731
             dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+        # Under rope_fused=True with a kernel attention (flash/ring/
+        # ulysses), `positions` is IGNORED: the kernels apply rotary
+        # in-kernel from global row offsets, which assumes the standard
+        # contiguous 0..L-1 layout. Custom position ids (packing, shifted
+        # windows) require rope_fused=False.
         x = x + Attention(cfg, name="attn")(norm("norm1")(x), positions)
         h = norm("norm2")(x)
         if self.moe:

@@ -14,6 +14,12 @@ const std::string DUPLICATE_NAME_ERROR =
     "is currently being processed. If you want to request another tensor, "
     "use a different tensor name.";
 
+const std::string CONNECTION_LOST_ERROR =
+    "Horovod-TPU connection to a peer was lost (a worker likely failed or "
+    "was preempted). The job can recover elastically: roll back to the "
+    "last committed state and re-initialize (hvd.elastic.run does this "
+    "automatically).";
+
 std::string TensorShape::DebugString() const {
   std::ostringstream os;
   os << "[";

@@ -48,6 +48,7 @@ constexpr int32_t HOST_DEVICE_ID = -1;
 
 extern const std::string SHUT_DOWN_ERROR;
 extern const std::string DUPLICATE_NAME_ERROR;
+extern const std::string CONNECTION_LOST_ERROR;
 
 class Status {
  public:

@@ -24,6 +24,7 @@
 #include <cstdint>
 #include <deque>
 #include <memory>
+#include <stdexcept>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -37,6 +38,17 @@
 namespace hvdtpu {
 
 class ParameterManager;
+
+// Thrown by transport-backed controllers when a cross-rank primitive fails
+// mid-protocol (peer EOF / reset). The background loop catches it and fails
+// outstanding handles with a RECOVERABLE connection-lost status — the
+// process survives and can re-initialize for a new generation (elastic
+// membership change), instead of aborting the whole job.
+class ConnectionLostError : public std::runtime_error {
+ public:
+  explicit ConnectionLostError(const std::string& what)
+      : std::runtime_error(what) {}
+};
 
 class Controller {
  public:

@@ -28,6 +28,11 @@ struct HorovodGlobalState {
   std::atomic<bool> initialization_done{false};
   std::atomic<bool> initialization_failed{false};
   std::atomic<bool> shut_down{false};
+  // Set when the background loop died because a peer connection was lost
+  // (vs a requested shutdown) — outstanding and future work then fails
+  // with the recoverable CONNECTION_LOST_ERROR so Python can roll back
+  // and re-initialize (elastic recovery).
+  std::atomic<bool> connection_lost{false};
 
   // Fusion diagnostics (see PerformOperation).
   std::atomic<int64_t> responses_performed{0};

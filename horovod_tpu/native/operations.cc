@@ -228,6 +228,16 @@ bool RunLoopOnce(HorovodGlobalState& state,
 }
 
 void BackgroundThreadLoop(HorovodGlobalState& state) {
+  // Generation reset: a re-init after an elastic membership change (or a
+  // plain shutdown/init cycle) must not carry over negotiation state from
+  // the previous communicator — cached responses reference the old size
+  // and bit layout, and the protocol counters would mix generations.
+  state.connection_lost.store(false);
+  state.response_cache.clear();
+  state.tcp_context.ResetProtocolCounters();
+  state.responses_performed.store(0);
+  state.tensors_performed.store(0);
+
   if (!state.tcp_context.Initialize()) {
     state.tcp_context.Finalize();  // release sockets for a re-init retry
     state.initialization_failed.store(true);
@@ -309,14 +319,23 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
   try {
     while (RunLoopOnce(state, last_cycle_start)) {
     }
+  } catch (const ConnectionLostError& ex) {
+    // A peer died mid-protocol. Recoverable: the process survives, and a
+    // later shutdown()+init() joins the next elastic generation.
+    LOG(ERROR) << "peer connection lost: " << ex.what();
+    state.connection_lost.store(true);
   } catch (const std::exception& ex) {
     LOG(ERROR) << "background loop terminated: " << ex.what();
   }
 
   LOG(DEBUG) << "background loop shutting down";
   state.shut_down.store(true);
-  state.tensor_queue.FinalizeTensorQueue(Status::Aborted(SHUT_DOWN_ERROR));
-  g_handles.FailAll(Status::Aborted(SHUT_DOWN_ERROR));
+  const Status fail_status =
+      state.connection_lost.load()
+          ? Status::UnknownError(CONNECTION_LOST_ERROR)
+          : Status::Aborted(SHUT_DOWN_ERROR);
+  state.tensor_queue.FinalizeTensorQueue(fail_status);
+  g_handles.FailAll(fail_status);
   state.timeline.Shutdown();
   state.tcp_context.Finalize();
 }
@@ -355,7 +374,12 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
     return Status::PreconditionError("Horovod-TPU has not been initialized.");
   }
   if (g_state.shut_down.load()) {
-    return Status::Aborted(SHUT_DOWN_ERROR);
+    // After a peer loss the queue is closed but the condition is
+    // recoverable — report it as such so callers roll back instead of
+    // treating it like a requested shutdown.
+    return g_state.connection_lost.load()
+               ? Status::UnknownError(CONNECTION_LOST_ERROR)
+               : Status::Aborted(SHUT_DOWN_ERROR);
   }
   TensorShape tensor_shape;
   for (int i = 0; i < ndim; ++i) tensor_shape.AddDim(shape[i]);
@@ -422,6 +446,14 @@ int horovod_tpu_initialized() {
                  !g_state.initialization_failed.load()
              ? 1
              : 0;
+}
+
+// True when the background loop died because a peer connection was lost
+// (elastic-recoverable), as opposed to a requested shutdown. Python's
+// elastic layer uses this to decide between rollback-and-reinit and a
+// plain teardown.
+int horovod_tpu_connection_lost() {
+  return g_state.connection_lost.load() ? 1 : 0;
 }
 
 int horovod_tpu_rank() {

@@ -18,6 +18,14 @@ uint32_t ResponseCache::num_active_bits() const {
   return static_cast<uint32_t>(cache_.size());
 }
 
+void ResponseCache::clear() {
+  cache_.clear();
+  cache_iters_.clear();
+  lru_.clear();
+  name_to_bit_.clear();
+  bits_outdated_ = false;
+}
+
 ResponseCache::CacheState ResponseCache::cached(const Request& request) const {
   auto it = name_to_bit_.find(request.tensor_name());
   if (it == name_to_bit_.end()) return CacheState::MISS;

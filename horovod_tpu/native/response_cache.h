@@ -34,6 +34,11 @@ class ResponseCache {
   uint32_t capacity() const { return capacity_; }
   uint32_t num_active_bits() const;
 
+  // Drops every cached entry and bit assignment. Called on re-init so a new
+  // elastic generation (different size/topology) never executes a response
+  // negotiated under the old membership.
+  void clear();
+
   // MISS if never seen; HIT if cached with identical params; INVALID if the
   // name is cached but shape/dtype/op params changed (entry must be dropped
   // and renegotiated).

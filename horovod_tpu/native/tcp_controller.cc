@@ -48,34 +48,40 @@ void TcpController::Initialize() {
              << " cross " << cross_rank_ << "/" << cross_size_;
 }
 
+// Control-plane failures mean a peer went away mid-protocol (EOF/reset on
+// the star). Throwing ConnectionLostError (instead of the previous
+// LOG(FATAL) abort) lets the background loop fail outstanding work with a
+// recoverable status so Python can roll back and re-initialize for a new
+// generation — the core of elastic fault tolerance.
+
 void TcpController::GatherBlobs(const std::string& mine,
                                 std::vector<std::string>* all) {
   if (!tcp_context_.GatherBlobs(mine, all)) {
-    LOG(FATAL) << "control-plane gather failed";
+    throw ConnectionLostError("control-plane gather failed");
   }
 }
 
 void TcpController::BroadcastBlob(std::string* blob) {
   if (!tcp_context_.BroadcastBlob(blob)) {
-    LOG(FATAL) << "control-plane broadcast failed";
+    throw ConnectionLostError("control-plane broadcast failed");
   }
 }
 
 void TcpController::CrossRankBitwiseAnd(std::vector<uint64_t>& bits) {
   if (!tcp_context_.BitwiseSync(bits, /*is_or=*/false)) {
-    LOG(FATAL) << "bitwise AND sync failed";
+    throw ConnectionLostError("bitwise AND sync failed");
   }
 }
 
 void TcpController::CrossRankBitwiseOr(std::vector<uint64_t>& bits) {
   if (!tcp_context_.BitwiseSync(bits, /*is_or=*/true)) {
-    LOG(FATAL) << "bitwise OR sync failed";
+    throw ConnectionLostError("bitwise OR sync failed");
   }
 }
 
 void TcpController::Barrier() {
   if (!tcp_context_.Barrier()) {
-    LOG(FATAL) << "barrier failed";
+    throw ConnectionLostError("barrier failed");
   }
 }
 

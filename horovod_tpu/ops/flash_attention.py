@@ -252,7 +252,18 @@ def _grouped_blocks(D, L, group, backward=False):
     reduction writes G instead of H heads). D=64 group=4: 2048/512
     beats the plain-cap 512/1024 by 6% fwd / 10% fwd+bwd (2048/1024
     overflows VMEM — s alone is 8 MB f32). Shapes without sweep data
-    (short L) keep the conservative plain-preference cap."""
+    (short L) keep the conservative plain-preference cap.
+
+    Interpolation caveat for UNSWEPT group sizes: the caps above were
+    measured at group=4 (D<=64 -> 2048 rows) and group=3 (D>64 -> 1536
+    rows) only, and are applied to every group>1 at long L. For other
+    groups the power-of-two bqp search in _pick_rows_block then lands
+    on smaller row blocks than the cap suggests (e.g. group=2, D=64:
+    bqp=512 -> 1024 rows, not 2048) — a performance-only divergence
+    from a hypothetical per-group optimum, never a correctness issue
+    (_check_blocks still enforces exact tiling). Extend the sweep
+    (examples/flash_block_sweep.py --G N) before trusting these caps
+    for a new production group size."""
     pq, pk = _default_blocks(D, L, backward)
     long_seq = L is not None and L >= 4096
     if group > 1 and long_seq:

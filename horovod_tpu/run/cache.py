@@ -5,8 +5,10 @@ staleness window over an on-disk store keyed by the run parameters,
 used so repeated `horovodrun` invocations skip re-probing every host
 (`horovod/run/run.py:421-424`). TPU-native differences: JSON instead
 of cloudpickle (stdlib-only, human-inspectable, no code execution on
-load), atomic replace writes, and corrupt/stale-format files self-heal
-to empty instead of raising.
+load), atomic replace writes that merge with the on-disk state and
+prune expired entries, best-effort I/O (an unwritable cache never
+breaks a launch), and corrupt/stale-format files self-heal to empty
+instead of raising.
 """
 
 import json
@@ -51,9 +53,41 @@ class Cache:
         return None
 
     def put(self, key, val):
+        """Best-effort write-through: merges with whatever is on disk
+        (another launcher may have written since we loaded), prunes
+        expired entries, and never raises on I/O failure — a read-only
+        or vanished cache directory must not break a launch (the cache
+        only saves re-probing)."""
+        now = time.time()
         with self._lock:
-            self._content["entries"][key] = (time.time(), val)
+            self._content["entries"][key] = (now, val)
+            # Merge: keep the newer timestamp per key so concurrent
+            # launchers don't clobber each other's fresh probes.
+            try:
+                with open(self._file) as f:
+                    disk = json.load(f)
+                if isinstance(disk, dict) and \
+                        disk.get("parameters_hash") == \
+                        self._content["parameters_hash"]:
+                    ours = self._content["entries"]
+                    for k, ent in disk.get("entries", {}).items():
+                        try:
+                            ts = float(ent[0])
+                        except (TypeError, ValueError, IndexError):
+                            continue
+                        if k not in ours or ts > ours[k][0]:
+                            ours[k] = (ts, ent[1])
+            except (OSError, ValueError):
+                pass
+            # Prune: expired entries only grow the file; they already
+            # read as misses.
+            self._content["entries"] = {
+                k: ent for k, ent in self._content["entries"].items()
+                if now - ent[0] <= self._ttl}
             tmp = self._file + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(self._content, f)
-            os.replace(tmp, self._file)
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(self._content, f)
+                os.replace(tmp, self._file)
+            except OSError:
+                pass

@@ -43,6 +43,14 @@ SCOPE_ADDRS = "addrs"
 # diverge and split the local/cross topology).
 SCOPE_RESOLVED = "resolved"
 
+
+def gen_scope(base, generation):
+    """Scope name for one elastic generation. Generation 0 keeps the bare
+    name (static jobs never re-register); later generations get their own
+    scope so a re-rendezvous never reads stale entries from the previous
+    membership (e.g. an old size-3 table during a size-2 restart)."""
+    return base if not generation else "%s@g%d" % (base, generation)
+
 PROBE_CONNECT_TIMEOUT = 2.0
 
 AUTH_HEADER = "X-Hvd-Auth"
@@ -149,6 +157,19 @@ class RendezvousServer:
     @property
     def port(self):
         return self._httpd.server_address[1]
+
+    # Same-process access for the elastic driver (which owns the server):
+    # no HTTP round trip, no signing.
+    def put_local(self, scope, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        with self._lock:
+            self._store[(scope, str(key))] = value
+
+    def scope_items(self, scope):
+        """{key: bytes} snapshot of one scope."""
+        with self._lock:
+            return {k: v for (s, k), v in self._store.items() if s == scope}
 
     def start(self):
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -445,13 +466,16 @@ def _resolve_table(table, size, my_rank):
         return list(pool.map(pick, range(size)))
 
 
-def resolve_topology(rank, size, rendezvous_addr, timeout=60):
+def resolve_topology(rank, size, rendezvous_addr, timeout=60, generation=0):
     """Worker-side rendezvous: publish my candidate addresses + chosen
     port, let rank 0 probe reachability and publish ONE resolved table
     (globally consistent — per-rank interface choices could split the
-    derived local/cross topology), derive the HVD_TPU_* env from it."""
+    derived local/cross topology), derive the HVD_TPU_* env from it.
+    `generation` scopes the exchange to one elastic membership epoch."""
     from .util import topology_env
 
+    scope_addrs = gen_scope(SCOPE_ADDRS, generation)
+    scope_resolved = gen_scope(SCOPE_RESOLVED, generation)
     host = rendezvous_addr.rsplit(":", 1)[0]
     port = int(rendezvous_addr.rsplit(":", 1)[1])
     cands = candidate_ips(host, port)
@@ -465,27 +489,27 @@ def resolve_topology(rank, size, rendezvous_addr, timeout=60):
         # socket). Only ever set on kernel-allocated ephemeral ports, so
         # the static fixed-port path keeps strict EADDRINUSE semantics.
         env["HVD_TPU_LISTEN_REUSEPORT"] = "1"
-    put(rendezvous_addr, SCOPE_ADDRS, str(rank),
+    put(rendezvous_addr, scope_addrs, str(rank),
         json.dumps({"cands": cands, "port": my_port, "probe": probe.port}))
     deadline = time.monotonic() + timeout
     if rank == 0:
-        table = wait_all(rendezvous_addr, SCOPE_ADDRS, range(size),
+        table = wait_all(rendezvous_addr, scope_addrs, range(size),
                          timeout)
         try:
             addrs = _resolve_table(table, size, my_rank=0)
         except RuntimeError as e:
             # Publish the failure so waiting ranks fail fast with the
             # actionable message instead of a generic timeout.
-            put(rendezvous_addr, SCOPE_RESOLVED, "table",
+            put(rendezvous_addr, scope_resolved, "table",
                 json.dumps({"error": str(e)}))
             raise
-        put(rendezvous_addr, SCOPE_RESOLVED, "table", json.dumps(addrs))
+        put(rendezvous_addr, scope_resolved, "table", json.dumps(addrs))
     else:
         # Wait out the shared publish deadline PLUS a probing allowance
         # (rank 0 starts probing only after the last publish, and each
         # unreachable candidate burns PROBE_CONNECT_TIMEOUT).
         resolved = wait_all(
-            rendezvous_addr, SCOPE_RESOLVED, ["table"],
+            rendezvous_addr, scope_resolved, ["table"],
             max(30.0, deadline - time.monotonic() + 30.0))
         addrs = json.loads(resolved["table"])
         if isinstance(addrs, dict):

@@ -12,6 +12,11 @@ injects only HVD_TPU_RANK / HVD_TPU_SIZE / HVD_TPU_RENDEZVOUS_ADDR;
 every worker binds its own free port, publishes it, and derives the
 local/cross topology from the published peer table (see rendezvous.py).
 ``--start-port`` switches to a static pre-assigned port table.
+
+``--min-np`` / ``--max-np`` / ``--host-discovery-script`` switch to the
+ELASTIC supervisor (horovod_tpu/elastic/driver.py): a failing worker
+shrinks the job instead of tearing it down, recovered hosts grow it
+back, and failing hosts are blacklisted with exponential backoff.
 """
 
 import argparse
@@ -128,6 +133,16 @@ def make_parser():
                         help="auto-discover hosts from TPU pod metadata")
     parser.add_argument("--start-port", type=int, default=0,
                         help="base port for rendezvous (0 = auto for local)")
+    parser.add_argument("--min-np", type=int, default=None,
+                        help="elastic mode: minimum world size the job "
+                             "may shrink to before the driver gives up")
+    parser.add_argument("--max-np", type=int, default=None,
+                        help="elastic mode: maximum world size to grow "
+                             "to (default: -np)")
+    parser.add_argument("--host-discovery-script", default=None,
+                        help="elastic mode: executable printing one "
+                             "'host' or 'host:slots' line per available "
+                             "host; polled to grow/shrink the job")
     parser.add_argument("--ssh-port", type=int, default=None)
     parser.add_argument("--start-timeout", type=int, default=60,
                         help="seconds to wait for all ranks to connect")
@@ -456,6 +471,36 @@ def main(argv=None):
             args.num_proc = sum(h.slots for h in hosts)
     else:
         hosts = args.hosts or "localhost:%d" % (args.num_proc or 1)
+    if args.min_np or args.max_np or args.host_discovery_script:
+        # Elastic mode: a supervisor loop (shrink on failure, grow on
+        # recovery, host blacklisting) replaces the static
+        # kill-all-on-first-exit behavior. See docs/ELASTIC.md.
+        from horovod_tpu.elastic.discovery import (FixedHosts,
+                                                   HostDiscoveryScript)
+        from horovod_tpu.elastic.driver import run_elastic
+        if args.start_port:
+            parser.error("--start-port (static port table) is "
+                         "incompatible with elastic mode")
+        if args.host_discovery_script:
+            discovery = HostDiscoveryScript(args.host_discovery_script)
+        else:
+            if isinstance(hosts, str):
+                discovery = FixedHosts(hosts)
+            else:
+                discovery = FixedHosts({h.hostname: h.slots
+                                        for h in hosts})
+        capacity = sum(
+            discovery.find_available_hosts_and_slots().values())
+        np_ = args.num_proc or capacity
+        if not np_:
+            parser.error("elastic launch found no hosts (discovery "
+                         "script returned nothing and no -np given)")
+        return run_elastic(np_, discovery, command,
+                           min_np=args.min_np or 1,
+                           max_np=args.max_np or np_,
+                           ssh_port=args.ssh_port,
+                           start_timeout=args.start_timeout,
+                           verbose=args.verbose)
     if args.num_proc is None:
         parser.error("-np is required")
     return run_command(args.num_proc, hosts, command,

@@ -132,10 +132,20 @@ def cpu_worker_env(base_env=None, extra_env=None, repo_root=None):
       interpreter boot whenever it is set and dials its relay in an
       unbounded retry loop; a dead relay hangs the worker before main()
       runs (JAX_PLATFORMS=cpu alone does NOT prevent the boot dial);
-    * pop ``JAX_PLATFORMS`` and pin ``JAX_PLATFORM_NAME=cpu`` — the
-      NAME form demotes an (alive) accelerator plugin's default-backend
-      priority without forbidding it, so N workers can't fight over one
-      tunnel chip;
+    * pin ``JAX_PLATFORMS=cpu`` (and ``JAX_PLATFORM_NAME=cpu`` for
+      older jax) — these are CPU workers by definition, and a soft
+      NAME-only demotion still lets jax CREATE the accelerator client:
+      with libtpu installed and its backing service dead, that client
+      init blocks on cloud-metadata queries and the worker hangs
+      mid-test (observed: workers wedged in ESTABLISHED connections to
+      169.254.169.254:80 while the fixture timed out);
+    * pop ``PYTHONUNBUFFERED`` — with it set, every text write is its
+      own raw write, so ``print(line)`` becomes TWO pipe writes
+      (payload, then newline) and N workers sharing the launcher's
+      stdout pipe interleave mid-line, corrupting line-oriented test
+      protocols (observed: two COUNTERS JSON lines merged into one).
+      Buffered stdout flushes a whole line atomically; workers that
+      need promptness use ``print(..., flush=True)``;
     * default a persistent compile cache so identical worker jit
       programs compile once across the fleet.
     """
@@ -144,8 +154,9 @@ def cpu_worker_env(base_env=None, extra_env=None, repo_root=None):
     if repo_root:
         env["PYTHONPATH"] = repo_root + _os.pathsep + \
             env.get("PYTHONPATH", "")
-    env.pop("JAX_PLATFORMS", None)
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PYTHONUNBUFFERED", None)
+    env["JAX_PLATFORMS"] = "cpu"
     env["JAX_PLATFORM_NAME"] = "cpu"
     env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/hvd_tpu_jax_cache")
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")

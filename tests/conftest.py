@@ -34,6 +34,12 @@ REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
+# Older jax (<= 0.4.x) lacks jax.shard_map / check_vma; install the
+# forwarding shim once so every test file can use the current API.
+from horovod_tpu.compat import ensure_jax_compat  # noqa: E402
+
+ensure_jax_compat()
+
 
 def cpu_devices():
     import jax

@@ -1,0 +1,68 @@
+"""Elastic e2e worker: deterministic quadratic training with commits.
+
+Run under the elastic launcher (`-np 3 --min-np 1`). Worker id 1 kills
+itself mid-generation-0; the survivors must roll back to the last commit
+and continue at size 2, and a respawned worker must be absorbed later
+(size 3 again) — all without the surviving processes restarting.
+
+Training: gradient descent on ||w - target||^2 with the gradient
+allreduce-averaged across ranks (every rank computes the same gradient,
+so the averaged step is identical and the loss decreases strictly —
+letting the test assert "loss keeps decreasing" across membership
+changes).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+TOTAL_STEPS = int(os.environ.get("ELASTIC_TEST_TOTAL_STEPS", "30"))
+COMMIT_EVERY = int(os.environ.get("ELASTIC_TEST_COMMIT_EVERY", "5"))
+CRASH_STEP = int(os.environ.get("ELASTIC_TEST_CRASH_STEP", "7"))
+STEP_SLEEP = float(os.environ.get("ELASTIC_TEST_STEP_SLEEP", "0.25"))
+LR = 0.05
+TARGET = 3.0
+
+WID = os.environ.get("HVD_TPU_WORKER_ID", "?")
+
+
+@elastic.run
+def train(state):
+    while state.step < TOTAL_STEPS:
+        gen = int(os.environ.get("HVD_TPU_GENERATION", "0") or 0)
+        grad_local = 2.0 * (state.w - TARGET)
+        grad = np.asarray(hvd.allreduce(grad_local, "grad",
+                                        average=True))
+        state.w = state.w - LR * grad
+        state.step += 1
+        loss = float(np.sum((state.w - TARGET) ** 2))
+        print("worker %s gen %d step %d size %d loss %.6f"
+              % (WID, gen, state.step, hvd.size(), loss), flush=True)
+        if WID == "1" and gen == 0 and state.step == CRASH_STEP:
+            print("worker 1 crashing now", flush=True)
+            os._exit(23)
+        if state.step % COMMIT_EVERY == 0:
+            state.commit()
+        time.sleep(STEP_SLEEP)
+    return float(np.sum((state.w - TARGET) ** 2))
+
+
+def main():
+    state = elastic.ElasticState(w=np.zeros(4, np.float64), step=0)
+    final_loss = train(state)
+    if final_loss is None:  # job finished before this worker could join
+        print("worker %s superseded (job already complete)" % WID,
+              flush=True)
+        return 0
+    print("worker %s train done step %d loss %.6f"
+          % (WID, state.step, final_loss), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,268 @@
+"""Elastic training subsystem tests (ISSUE 1 tentpole).
+
+Unit layer: host-manager blacklist backoff, discovery-script contract,
+state commit/restore semantics, and the ``@elastic.run`` rollback loop at
+size 1 (exercises the real native shutdown/re-init cycle).
+
+E2E layer (``e2e`` marker, launcher-driven): kill one worker mid-training
+-> survivors roll back to the last commit and continue at reduced size
+within one generation; after the blacklist backoff expires a replacement
+worker is spawned and absorbed back — the job's process tree is never
+restarted and the loss keeps decreasing across membership changes.
+"""
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.elastic.discovery import (FixedHosts, HostDiscoveryScript,
+                                           HostManager)
+from horovod_tpu.elastic.state import ElasticState, _tree_flatten
+
+
+# ---------------------------------------------------------------------------
+# Host manager / blacklisting
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_blacklist_not_retried_before_backoff_expires():
+    clock = _FakeClock()
+    mgr = HostManager(FixedHosts({"a": 2, "b": 2}), cooldown=10.0,
+                      clock=clock)
+    mgr.refresh()
+    assert mgr.available_hosts_and_slots() == {"a": 2, "b": 2}
+    mgr.record_failure("a")
+    assert mgr.is_blacklisted("a")
+    assert mgr.available_hosts_and_slots() == {"b": 2}
+    clock.t = 9.9  # backoff not yet expired: still excluded
+    assert mgr.is_blacklisted("a")
+    clock.t = 10.1  # expired: retried again
+    assert not mgr.is_blacklisted("a")
+    assert mgr.available_hosts_and_slots() == {"a": 2, "b": 2}
+
+
+def test_blacklist_backoff_doubles_and_success_resets():
+    clock = _FakeClock()
+    mgr = HostManager(FixedHosts({"a": 1}), cooldown=10.0, clock=clock)
+    mgr.refresh()
+    mgr.record_failure("a")
+    assert mgr.blacklisted_until("a") == pytest.approx(10.0)
+    clock.t = 20.0
+    mgr.record_failure("a")  # second consecutive failure: 2x backoff
+    assert mgr.blacklisted_until("a") == pytest.approx(40.0)
+    clock.t = 100.0
+    mgr.record_failure("a")  # third: 4x
+    assert mgr.blacklisted_until("a") == pytest.approx(140.0)
+    mgr.record_success("a")  # healthy worker resets the streak
+    mgr.record_failure("a")
+    assert mgr.blacklisted_until("a") == pytest.approx(100.0 + 10.0)
+
+
+def test_blacklist_ignores_success_of_pre_failure_worker():
+    """A worker that was already running when the host failed must not
+    clear the blacklist — only post-failure evidence counts (otherwise
+    one long-lived survivor on a multi-slot host defeats the backoff)."""
+    clock = _FakeClock()
+    mgr = HostManager(FixedHosts({"a": 2}), cooldown=10.0, clock=clock)
+    mgr.refresh()
+    clock.t = 50.0
+    mgr.record_failure("a")
+    mgr.record_success("a", started_at=5.0)  # survivor predates failure
+    assert mgr.is_blacklisted("a")
+    mgr.record_success("a", started_at=55.0)  # post-failure worker
+    assert not mgr.is_blacklisted("a")
+
+
+def test_blacklist_backoff_capped():
+    clock = _FakeClock()
+    mgr = HostManager(FixedHosts({"a": 1}), cooldown=10.0,
+                      max_backoff=25.0, clock=clock)
+    mgr.refresh()
+    for _ in range(5):
+        mgr.record_failure("a")
+    assert mgr.blacklisted_until("a") == pytest.approx(25.0)
+
+
+def test_host_discovery_script(tmp_path):
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho hosta:4\necho '# comment'\n"
+                      "echo hostb\n")
+    script.chmod(0o755)
+    disc = HostDiscoveryScript(str(script), default_slots=2)
+    assert disc.find_available_hosts_and_slots() == {"hosta": 4,
+                                                     "hostb": 2}
+
+
+def test_host_discovery_script_failure_keeps_last(tmp_path):
+    flag = tmp_path / "fail"
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\nif [ -e %s ]; then exit 3; fi\n"
+                      "echo hosta:2\n" % flag)
+    script.chmod(0o755)
+    disc = HostDiscoveryScript(str(script))
+    assert disc.find_available_hosts_and_slots() == {"hosta": 2}
+    flag.write_text("")  # script now fails: previous host set is kept
+    assert disc.find_available_hosts_and_slots() == {"hosta": 2}
+
+
+# ---------------------------------------------------------------------------
+# State commit/restore
+
+def test_state_commit_restore_roundtrip():
+    state = ElasticState(w=np.arange(4.0), step=3,
+                         nested={"a": np.ones(2), "b": [1, 2.5]})
+    state.save()
+    state.w += 100.0
+    state.step = 9
+    state.nested["a"][0] = -1.0
+    state.nested["b"][1] = 7.0
+    state.restore()
+    assert np.allclose(state.w, np.arange(4.0))
+    assert state.step == 3
+    assert np.allclose(state.nested["a"], 1.0)
+    assert state.nested["b"] == [1, 2.5]
+
+
+def test_state_namedtuple_roundtrip():
+    """Optax-style optimizer state is a NamedTuple pytree; commit/
+    restore must rebuild it with positional fields, not an iterable."""
+    import collections
+
+    NT = collections.namedtuple("ScaleState", ["mu", "nu"])
+    state = ElasticState(opt=NT(mu=np.zeros(2), nu=np.ones(2)), step=1)
+    state.save()
+    state.opt = NT(mu=state.opt.mu + 5.0, nu=state.opt.nu * 3.0)
+    state.restore()
+    assert isinstance(state.opt, NT)
+    assert np.allclose(state.opt.mu, 0.0)
+    assert np.allclose(state.opt.nu, 1.0)
+
+
+def test_state_restore_without_commit_is_noop():
+    state = ElasticState(step=5)
+    state.restore()
+    assert state.step == 5
+
+
+def test_tree_flatten_deterministic_order():
+    tree = {"b": [np.zeros(1), 2], "a": {"y": 1, "x": 0}}
+    paths = [p for p, _ in _tree_flatten(tree)]
+    assert paths == [".a.x", ".a.y", ".b.0", ".b.1"]
+
+
+def test_state_rejects_underscore_attrs():
+    with pytest.raises(ValueError):
+        ElasticState(_committed=1)
+
+
+# ---------------------------------------------------------------------------
+# The @elastic.run rollback loop (size-1: real native shutdown/re-init)
+
+def test_run_decorator_rolls_back_to_last_commit():
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+    from horovod_tpu.common.ops import HorovodInternalError
+
+    hvd.init()
+    state = elastic.ElasticState(w=np.zeros(2), step=0)
+    attempts = []
+
+    @elastic.run
+    def train(st):
+        attempts.append(st.step)
+        while st.step < 4:
+            st.w = st.w + 1.0
+            st.step += 1
+            if st.step == 2:
+                st.commit()
+            if st.step == 3 and len(attempts) == 1:
+                # Simulate a peer loss mid-collective: the wrapper must
+                # restore the step-2 commit and re-enter func.
+                raise HorovodInternalError("simulated peer loss")
+        return st.step
+
+    assert train(state) == 4
+    # Second attempt resumed from the commit (step 2), not from 0 and
+    # not from the failed step-3 state.
+    assert attempts == [0, 2]
+    assert np.allclose(state.w, 4.0)
+    assert hvd.is_initialized()  # re-init happened, job never died
+
+
+# ---------------------------------------------------------------------------
+# E2E: launcher-driven shrink + rollback + grow (acceptance criterion)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LINE = re.compile(r"worker (\d+) gen (\d+) step (\d+) size (\d+) "
+                  r"loss ([0-9.]+)")
+
+
+@pytest.mark.e2e
+def test_elastic_shrink_rollback_and_grow():
+    from tests.conftest import clean_worker_env
+
+    env = clean_worker_env({
+        # Fast cadence so failure detection, blacklist expiry and regrowth
+        # all happen within seconds.
+        "HVD_TPU_ELASTIC_COOLDOWN": "2",
+        "HVD_TPU_ELASTIC_DISCOVERY_INTERVAL": "0.3",
+        "HVD_TPU_START_TIMEOUT": "30",
+        "ELASTIC_TEST_STEP_SLEEP": "0.25",
+    })
+    t0 = time.monotonic()
+    result = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run.run", "-np", "3",
+         "--min-np", "1", "--",
+         sys.executable, os.path.join(REPO_ROOT, "tests",
+                                      "elastic_worker.py")],
+        env=env, timeout=240, capture_output=True, text=True)
+    elapsed = time.monotonic() - t0
+    out = result.stdout
+    assert result.returncode == 0, (out, result.stderr)
+    assert "worker 1 crashing now" in out
+
+    rows = [(int(w), int(g), int(s), int(n), float(l))
+            for w, g, s, n, l in LINE.findall(out)]
+    gen0 = [r for r in rows if r[1] == 0]
+    gen1 = [r for r in rows if r[1] == 1]
+    grown = [r for r in rows if r[1] >= 2]
+    assert gen0 and gen1 and grown, rows
+
+    # Shrink: generation 1 runs at size 2 and RESUMES FROM THE LAST
+    # COMMIT (step 5 committed -> first gen-1 step is 6, re-doing the
+    # uncommitted steps 6-7 the crash wiped).
+    assert all(r[3] == 2 for r in gen1)
+    assert min(r[2] for r in gen1) == 6
+    # The crash happened at step 7, so steps 6-7 were rolled back and
+    # re-run under the new membership.
+    assert max(r[2] for r in gen0) >= 7
+
+    # Grow: a later generation runs at size 3 again, including the
+    # respawned worker (a worker id not in the original cohort).
+    assert any(r[3] == 3 for r in grown)
+    assert any(r[0] > 2 for r in grown), "replacement worker not absorbed"
+
+    # Loss keeps decreasing across the membership changes: the final
+    # loss beats everything generation 0 reached, and training ran to
+    # completion on every surviving worker.
+    done = re.findall(r"train done step (\d+) loss ([0-9.]+)", out)
+    assert len(done) == 3, out
+    assert all(int(s) == 30 for s, _ in done)
+    final_loss = float(done[0][1])
+    assert final_loss < min(r[4] for r in gen0)
+    assert final_loss < 0.5
+    # The whole dance (crash, rollback, regrow, finish) stays well under
+    # the classic full-restart cost envelope.
+    assert elapsed < 180, "elastic recovery took %.0fs" % elapsed

@@ -12,7 +12,9 @@ import pytest
 
 # Slow tier: each test launches a 2-process training job (see pytest.ini;
 # run with `pytest tests/ -m examples`).
-pytestmark = pytest.mark.examples
+# Both markers: "examples" is the historical opt-in name, "slow" is what
+# the tier-1 verify selection (-m "not slow") excludes.
+pytestmark = [pytest.mark.examples, pytest.mark.slow]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

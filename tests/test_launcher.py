@@ -151,6 +151,61 @@ def test_preflight_cache_roundtrip(tmp_path):
     assert c5.get("ssh://a") is True
 
 
+def test_preflight_cache_put_merges_concurrent_writers(tmp_path):
+    """Two launchers sharing one cache file: a put() merges the on-disk
+    entries written since load instead of clobbering them."""
+    from horovod_tpu.run.cache import Cache
+
+    c1 = Cache(str(tmp_path), staleness_minutes=60, parameters_hash="p")
+    c2 = Cache(str(tmp_path), staleness_minutes=60, parameters_hash="p")
+    c1.put("ssh://a", True)
+    c2.put("ssh://b", True)  # must not wipe c1's entry
+    c3 = Cache(str(tmp_path), staleness_minutes=60, parameters_hash="p")
+    assert c3.get("ssh://a") is True
+    assert c3.get("ssh://b") is True
+
+
+def test_preflight_cache_put_prunes_expired(tmp_path):
+    """Expired entries are dropped at write time (they already read as
+    misses; pruning keeps the file from growing forever)."""
+    import json
+
+    from horovod_tpu.run.cache import Cache
+
+    c = Cache(str(tmp_path), staleness_minutes=60, parameters_hash="p")
+    c.put("ssh://old", True)
+    # Age the entry on disk beyond the TTL, then trigger a new put.
+    path = tmp_path / "cache.json"
+    content = json.loads(path.read_text())
+    content["entries"]["ssh://old"][0] -= 3601.0
+    path.write_text(json.dumps(content))
+    c2 = Cache(str(tmp_path), staleness_minutes=60, parameters_hash="p")
+    c2.put("ssh://new", True)
+    stored = json.loads(path.read_text())["entries"]
+    assert "ssh://new" in stored and "ssh://old" not in stored
+
+
+def test_preflight_cache_put_best_effort(tmp_path):
+    """A cache directory that turns unwritable after construction must
+    not raise from put() — the cache only saves re-probing."""
+    import os
+    import stat
+
+    from horovod_tpu.run.cache import Cache
+
+    c = Cache(str(tmp_path), staleness_minutes=60, parameters_hash="p")
+    if os.geteuid() == 0:
+        # Root ignores mode bits; simulate the failure by replacing the
+        # folder with a file so open(tmp) raises instead.
+        import shutil
+        shutil.rmtree(str(tmp_path))
+        (tmp_path.parent / tmp_path.name).write_text("not a dir")
+    else:
+        os.chmod(str(tmp_path), stat.S_IRUSR | stat.S_IXUSR)
+    c.put("ssh://a", True)  # must not raise
+    assert c.get("ssh://a") is True  # still served from memory
+
+
 def test_ssh_preflight_uses_cache(tmp_path, monkeypatch):
     """A cached success skips the probe subprocess entirely; a cache
     miss probes and records the success (only successes are stored —

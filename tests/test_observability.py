@@ -100,9 +100,14 @@ def test_stall_warn_then_recover_with_cache(run_launcher):
     without it the renegotiated request is dropped by the all-cached
     fast path and the job deadlocks with a permanent "missing ranks"
     stall (found live during the round-5 timeline capture)."""
+    # Straggle must comfortably outlast BOTH stall clocks in sequence
+    # (cached-entry invalidation after ~2s, then the renegotiated
+    # tensor's own 2s warning window) plus scheduler slop on a loaded
+    # single-core host — at 7s the warning intermittently lost the race
+    # against the straggler's return and the assert below flaked.
     proc = run_launcher(2, "timeline_chip_worker.py", extra_env={
         "HVD_TPU_STALL_CHECK_TIME_SECONDS": "2",
-        "HVD_TPU_TL_STRAGGLE": "7",
+        "HVD_TPU_TL_STRAGGLE": "12",
     }, timeout=300)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out
