@@ -29,6 +29,18 @@ __version__ = "0.4.0"
 _initialized_here = False
 _world_env = None  # launcher-injected env saved before a rank-subset remap
 
+# Callbacks invoked after every successful init() — including elastic
+# re-inits. Framework bindings use this for per-generation state that must
+# restart identically on every member (e.g. the jax binding's auto-name
+# counter: a survivor of an elastic shrink/regrow and a freshly spawned
+# worker must generate the same collective names).
+_init_callbacks = []
+
+
+def register_init_callback(fn):
+    """Registers `fn()` to run after every successful init()."""
+    _init_callbacks.append(fn)
+
 _TOPOLOGY_KEYS = ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_LOCAL_RANK",
                   "HVD_TPU_LOCAL_SIZE", "HVD_TPU_CROSS_RANK",
                   "HVD_TPU_CROSS_SIZE", "HVD_TPU_ADDRS")
@@ -149,6 +161,8 @@ def init(ranks=None):
     # reservation held across init (see rendezvous.reserve_port).
     from .run.rendezvous import release_held_ports
     release_held_ports()
+    for cb in _init_callbacks:
+        cb()
     if not _initialized_here:
         _atexit.register(shutdown)
         _initialized_here = True

@@ -143,6 +143,9 @@ class HorovodBasics:
             ctypes.POINTER(ctypes.c_uint64)]
         lib.horovod_tpu_protocol_counters_reset.restype = None
         lib.horovod_tpu_protocol_counters_reset.argtypes = []
+        lib.horovod_tpu_call_digest.restype = None
+        lib.horovod_tpu_call_digest.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
         lib.horovod_tpu_autotune_params.restype = None
         lib.horovod_tpu_autotune_params.argtypes = [
             ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
@@ -202,6 +205,18 @@ class HorovodBasics:
 
     def protocol_counters_reset(self):
         self.lib.horovod_tpu_protocol_counters_reset()
+
+    def call_digest(self):
+        """(seq, digest) of this rank's collective call sequence since
+        init: seq counts enqueued collectives, digest is a rolling
+        FNV-1a over each call's (op, dtype, shape-rank, name). Ranks
+        that executed identical call sequences report identical values
+        (the runtime divergence assertion compares them)."""
+        seq = ctypes.c_uint64()
+        digest = ctypes.c_uint64()
+        self.lib.horovod_tpu_call_digest(ctypes.byref(seq),
+                                         ctypes.byref(digest))
+        return seq.value, digest.value
 
     def autotune_params(self):
         """Current synchronized knob values (autotune introspection):

@@ -74,6 +74,13 @@ class HostDiscoveryScript(HostDiscovery):
                     continue
             else:
                 hosts[line] = self._default_slots
+        if not hosts:
+            # Exit 0 with empty/unparseable output gets the same benefit
+            # of the doubt as a crash: "no hosts at all" would shrink a
+            # healthy job below --min-np and tear it down, and a flaky
+            # script racing its data source must not cause that. A truly
+            # empty fleet surfaces through worker failures instead.
+            return dict(self._last)
         self._last = dict(hosts)
         return hosts
 

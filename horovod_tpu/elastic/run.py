@@ -202,6 +202,12 @@ def run(func):
                 state.sync()
                 return func(state, *args, **kwargs)
             except HorovodInternalError as e:
+                if "protocol divergence" in str(e):
+                    # Not a fault but a program bug (rank-conditional
+                    # collective etc., see docs/LINT.md): deterministic,
+                    # so rollback+retry would loop until the elastic
+                    # timeout reproducing it every generation. Surface it.
+                    raise
                 _log("collective failed (%s); rolling back to last commit"
                      % e)
                 reset = "error"

@@ -116,10 +116,24 @@ class State:
 
     # -- commit / restore --------------------------------------------------
     def save(self):
-        """Snapshots the current attribute values (host deep copy)."""
+        """Snapshots the current attribute values (host copy).
+
+        One owned copy per leaf: array-likes (device or numpy) land in a
+        fresh host buffer via np.array, everything else is deepcopied —
+        and the containers are rebuilt fresh by _tree_map_leaves, so the
+        commit hot path pays a single pass over the state instead of the
+        asarray+deepcopy double copy it used to."""
+        def conv(leaf):
+            if hasattr(leaf, "__array__"):
+                return np.array(leaf)
+            return copy.deepcopy(leaf)
+
+        def snapshot(value):
+            leaves = iter([conv(l) for _, l in _tree_flatten(value)])
+            return _tree_map_leaves(value, leaves)
+
         self._committed = {
-            k: copy.deepcopy(self._to_host(v))
-            for k, v in self._public().items()}
+            k: snapshot(v) for k, v in self._public().items()}
 
     def commit(self):
         """save() + check_host_updates() — the reference's commit contract:

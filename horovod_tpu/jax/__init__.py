@@ -49,6 +49,20 @@ def _auto_name(prefix):
     return "%s.%d" % (prefix, _name_counter[0])
 
 
+def _reset_auto_names():
+    """Generation reset: auto-generated collective names must restart
+    from the same counter on every member after (re-)init. Without this,
+    a survivor of an elastic shrink/regrow keeps its old count while a
+    freshly spawned worker starts at zero — the two negotiate different
+    names for the same call site and the job hangs (the divergence
+    cross-check reports it; this removes the cause)."""
+    _name_counter[0] = 0
+    _assert_counter[0] = 0
+
+
+_hvd.register_init_callback(_reset_auto_names)
+
+
 def _is_traced(x):
     return isinstance(x, jax.core.Tracer)
 
@@ -360,3 +374,60 @@ def metric_average(value, name=None):
     arr = np.asarray(value, dtype=np.float64)
     return float(_ops.allreduce(arr, name or _auto_name("metric"),
                                 average=True))
+
+
+def collective_digest():
+    """This rank's collective call fingerprint: ``(seq, digest)``.
+
+    ``seq`` counts host-plane collectives enqueued since init; ``digest``
+    is a rolling FNV-1a over each call's (op, dtype, shape-rank, name).
+    Two ranks that executed identical call sequences report identical
+    values. (In-jit psum/all_gather collectives ride XLA, not the host
+    core, and are not counted — XLA already guarantees their cross-rank
+    consistency by construction.)"""
+    return _hvd.get_basics().call_digest()
+
+
+class DivergenceError(RuntimeError):
+    """Raised by :func:`assert_synchronized` when ranks' collective call
+    sequences have diverged."""
+
+
+_assert_counter = [0]
+
+
+def assert_synchronized(name=None):
+    """Runtime divergence assertion: verifies every rank has executed the
+    same collective call sequence up to this point.
+
+    Snapshots this rank's :func:`collective_digest`, allgathers the
+    per-rank (rank, seq, digest) triples, and raises
+    :class:`DivergenceError` naming the disagreeing ranks when they
+    differ. Call it at natural barriers — after the initial
+    ``broadcast_parameters``, at epoch ends, before checkpointing —
+    wherever all ranks are structurally in the same place. Cost: one
+    24-byte allgather.
+
+    Every rank must call it the same number of times at the same points
+    (it is itself a collective); a rank-conditional ``assert_synchronized``
+    is exactly the bug it exists to catch — hvd-lint flags it like any
+    other collective.
+    """
+    seq, digest = collective_digest()
+    _assert_counter[0] += 1
+    op_name = name or "hvd_assert_sync.%d" % _assert_counter[0]
+    # int64 transport (the core's dtype table has no uint64); the digest
+    # round-trips bit-exactly through the signed view.
+    mine = np.array([[_hvd.rank(), seq, digest]],
+                    dtype=np.uint64).view(np.int64)
+    all_rows = np.asarray(_ops.allgather(mine, op_name)).view(np.uint64)
+    rows = sorted((int(r[0]), int(r[1]), int(r[2])) for r in all_rows)
+    if len({(s, d) for _, s, d in rows}) <= 1:
+        return
+    detail = "; ".join("rank %d: seq=%d digest=%016x" % row for row in rows)
+    raise DivergenceError(
+        "collective call sequences diverged across ranks (%s). Some rank "
+        "executed extra, missing, or reordered collectives since init — "
+        "typically a rank-conditional collective or unordered name "
+        "iteration; run hvd-lint on the training script (docs/LINT.md)."
+        % detail)

@@ -1,0 +1,85 @@
+"""hvd-lint — collective-consistency static analysis for horovod_tpu
+training scripts.
+
+The hardest failure mode of an allreduce-negotiated framework is not a
+crash but a silent hang: one rank submits a collective the others never
+will. The stall inspector catches that *reactively* after a timeout; this
+package catches the pattern *statically, before launch*:
+
+* ``lint_source`` / ``lint_paths`` — library API (also used by the
+  ``horovodrun_tpu --lint`` preflight and the repo's self-lint test);
+* ``horovod_tpu.lint.cli`` / ``bin/hvd-lint`` — the CLI;
+* rules and suppression keys are documented in docs/LINT.md, each with
+  its runtime counterpart (the digest cross-check error message the same
+  bug produces after launch).
+
+Suppress a finding inline with ``# hvd-lint: disable=<rule>`` on the
+offending line (or alone on the line above); bare ``disable`` silences
+every rule for that line.
+"""
+
+import os
+
+from . import checkers as _checkers  # noqa: F401  (registers rules)
+from .rules import CHECKERS, ERROR, INFO, RULES, WARNING, Finding
+from .walker import build_model
+
+__all__ = [
+    "CHECKERS", "ERROR", "Finding", "INFO", "RULES", "WARNING",
+    "lint_paths", "lint_source",
+]
+
+
+def lint_source(source, path="<string>", rules=None):
+    """Lints one source string; returns a list of Findings (suppressions
+    applied, sorted by line). A syntax error yields a single
+    ``parse-error`` finding rather than raising."""
+    try:
+        model = build_model(path, source)
+    except SyntaxError as e:
+        return [Finding(path=path, line=e.lineno or 1, col=(e.offset or 0),
+                        rule="parse-error", severity=ERROR,
+                        message="could not parse: %s" % e.msg,
+                        end_line=e.lineno or 1)]
+    findings = []
+    for rule_id, checker in CHECKERS.items():
+        if rules is not None and rule_id not in rules:
+            continue
+        for finding in checker(model):
+            if not model.is_suppressed(finding.line, finding.rule,
+                                       finding.end_line):
+                findings.append(finding)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(paths):
+    """Expands files/directories into .py files (dirs walked recursively,
+    sorted for stable output)."""
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs.sort()
+                for fname in sorted(files):
+                    if fname.endswith(".py"):
+                        yield os.path.join(root, fname)
+        else:
+            yield path
+
+
+def lint_paths(paths, rules=None):
+    """Lints files/directories; returns (findings, files_checked)."""
+    findings = []
+    files_checked = 0
+    for fpath in iter_python_files(paths):
+        try:
+            with open(fpath, "r", encoding="utf-8", errors="replace") as fh:
+                source = fh.read()
+        except OSError as e:
+            findings.append(Finding(
+                path=fpath, line=1, col=1, rule="io-error", severity=ERROR,
+                message="cannot read: %s" % e, end_line=1))
+            continue
+        files_checked += 1
+        findings.extend(lint_source(source, path=fpath, rules=rules))
+    return findings, files_checked

@@ -1,0 +1,220 @@
+"""Per-rule checkers. Each consumes the walker's Model and yields Findings.
+
+Rule ids are the stable suppression keys; docs/LINT.md documents each with
+a minimal failing example and the runtime error it corresponds to.
+"""
+
+import collections
+
+from . import walker
+from .rules import ERROR, WARNING, make_finding, register
+from .walker import (COLLECTIVES, INITIAL_BROADCASTS, PREFIX_NAMED,
+                     TRAIN_MARKERS, describe_expr, expr_nondeterministic,
+                     expr_rank_dependent, literal_name)
+
+
+@register("rank-conditional-collective", ERROR,
+          "collective reachable only under rank-dependent control flow")
+def check_rank_conditional(model):
+    for site in model.call_sites:
+        if site.func in TRAIN_MARKERS and site.func != "allreduce_gradients":
+            continue  # wrapping an optimizer is not itself a collective
+        for cond in site.conditions:
+            if cond.rank_dependent:
+                kind = "elastic commit point" if site.is_commit \
+                    else "collective"
+                yield make_finding(
+                    model, site.node, "rank-conditional-collective",
+                    "%s `%s` is only reachable under the rank-dependent "
+                    "condition `%s`; ranks that skip this branch never "
+                    "submit it and the job hangs in negotiation "
+                    "(runtime: divergence cross-check / stall inspector)"
+                    % (kind, site.func, cond.source))
+                break
+
+
+@register("missing-initial-broadcast", WARNING,
+          "gradient averaging without an initial parameter broadcast")
+def check_missing_initial_broadcast(model):
+    markers = [s for s in model.call_sites if s.func in TRAIN_MARKERS]
+    if not markers:
+        return
+    if any(s.func in INITIAL_BROADCASTS for s in model.call_sites):
+        return
+    site = markers[0]
+    yield make_finding(
+        model, site.node, "missing-initial-broadcast",
+        "`%s` is used but no initial broadcast_parameters / "
+        "broadcast_optimizer_state (or BroadcastGlobalVariables hook/"
+        "callback) is reachable: ranks start averaging gradients from "
+        "different initial weights and silently train unsynchronized"
+        % site.func)
+
+
+@register("unordered-name-iteration", ERROR,
+          "collective name derived from unordered set/dict iteration")
+def check_unordered_iteration(model):
+    for site in model.call_sites:
+        loop = _unordered_loop_feeding_name(site)
+        if loop is None:
+            continue
+        if loop.unordered_kind == "set":
+            yield make_finding(
+                model, site.node, "unordered-name-iteration",
+                "collective `%s` named from iteration over a set: set "
+                "order depends on per-process string hashing "
+                "(PYTHONHASHSEED), so ranks negotiate names in different "
+                "orders and deadlock; iterate `sorted(...)` instead"
+                % site.func)
+        else:
+            yield make_finding(
+                model, site.node, "unordered-name-iteration",
+                "collective `%s` named from dict iteration: dict order "
+                "follows insertion order, which silently diverges across "
+                "ranks when the dicts were built differently; iterate "
+                "`sorted(...)` to make the negotiation order explicit"
+                % site.func, severity=WARNING)
+
+
+def _unordered_loop_feeding_name(site):
+    """The innermost unordered enclosing loop whose target feeds the
+    site's name (or an auto-generated name), else None."""
+    for loop in reversed(site.loops):
+        if not loop.unordered:
+            continue
+        if site.name_node is None:
+            return loop
+        import ast
+        for sub in ast.walk(site.name_node):
+            if isinstance(sub, ast.Name) and sub.id in loop.target_names:
+                return loop
+    return None
+
+
+@register("rank-dependent-name", ERROR,
+          "collective name derived from rank / host / time / random")
+def check_rank_dependent_name(model):
+    for site in model.call_sites:
+        if site.name_node is None:
+            continue
+        if expr_rank_dependent(model, site.name_node):
+            yield make_finding(
+                model, site.node, "rank-dependent-name",
+                "collective `%s` name `%s` depends on a per-rank value "
+                "(rank/local_rank/cross_rank/local_size): every rank "
+                "negotiates a different tensor name, so no name ever "
+                "completes and the job hangs"
+                % (site.func, describe_expr(model, site.name_node)))
+        elif expr_nondeterministic(model, site.name_node):
+            yield make_finding(
+                model, site.node, "rank-dependent-name",
+                "collective `%s` name `%s` draws on per-process entropy "
+                "(time/random/uuid/pid/hostname): ranks cannot agree on "
+                "the name and the negotiation never matches"
+                % (site.func, describe_expr(model, site.name_node)))
+
+
+@register("loop-auto-name", WARNING,
+          "auto-named collective inside a loop")
+def check_loop_auto_name(model):
+    for site in model.call_sites:
+        if site.func in PREFIX_NAMED or site.func in TRAIN_MARKERS or \
+                site.is_commit or site.func in INITIAL_BROADCASTS:
+            continue
+        if site.func not in COLLECTIVES:
+            continue
+        if site.name_node is not None or not site.loops:
+            continue
+        yield make_finding(
+            model, site.node, "loop-auto-name",
+            "collective `%s` inside a loop without an explicit name=: "
+            "every iteration auto-generates a fresh name, so the response "
+            "cache grows without bound and never hits, and after an "
+            "elastic restart surviving and fresh ranks disagree on the "
+            "counter; pass a name stable across iterations (include the "
+            "step only if each step's tensor is distinct)" % site.func)
+
+
+@register("duplicate-collective-name", WARNING,
+          "one literal name used by several collective call sites")
+def check_duplicate_name(model):
+    by_name = _sites_by_literal_name(model)
+    for name, sites in sorted(by_name.items()):
+        if len(sites) < 2:
+            continue
+        if _attrs_mismatch(sites):
+            continue  # escalated by name-attr-mismatch instead
+        first = sites[0]
+        for site in sites[1:]:
+            yield make_finding(
+                model, site.node, "duplicate-collective-name",
+                "collective name '%s' is also used at line %d: distinct "
+                "call sites sharing one name alias the same response-"
+                "cache entry and negotiate as the same tensor; make the "
+                "names unique" % (name, first.node.lineno))
+
+
+@register("name-attr-mismatch", ERROR,
+          "call sites sharing a name disagree on op/average/root")
+def check_name_attr_mismatch(model):
+    by_name = _sites_by_literal_name(model)
+    for name, sites in sorted(by_name.items()):
+        if len(sites) < 2 or not _attrs_mismatch(sites):
+            continue
+        kinds = sorted({_op_kind(s) for s in sites})
+        averages = sorted({repr(_average_literal(s)) for s in sites
+                           if _average_literal(s) is not None})
+        detail = []
+        if len(kinds) > 1:
+            detail.append("ops %s" % "/".join(kinds))
+        if len(averages) > 1:
+            detail.append("average= values %s" % "/".join(averages))
+        yield make_finding(
+            model, sites[1].node, "name-attr-mismatch",
+            "collective name '%s' is used with mismatched %s across call "
+            "sites (first at line %d): whichever rank reaches the other "
+            "site negotiates incompatible metadata for the same tensor "
+            "name and the coordinator rejects or mis-caches it"
+            % (name, " and ".join(detail), sites[0].node.lineno))
+
+
+def _sites_by_literal_name(model):
+    by_name = collections.OrderedDict()
+    for site in model.call_sites:
+        if site.func in TRAIN_MARKERS and site.func != "allreduce_gradients":
+            continue
+        name = literal_name(site)
+        if name is None:
+            continue
+        by_name.setdefault(name, []).append(site)
+    return by_name
+
+
+def _op_kind(site):
+    f = site.func
+    for kind in ("allreduce", "allgather", "broadcast", "alltoall"):
+        if f.startswith(kind) or f == "metric_average" and kind == "allreduce":
+            return kind
+    return f
+
+
+def _average_literal(site):
+    """The site's explicit average= literal, or None when absent/dynamic.
+
+    An absent average= is NOT resolved to a default: the default differs
+    by layer (the framework bindings average, the host-ops layer sums),
+    so guessing would flag two identical default-calls as mismatched.
+    Only explicit, differing literals count as evidence."""
+    import ast
+    node = site.kwargs.get("average")
+    if isinstance(node, ast.Constant):
+        return node.value
+    return None
+
+
+def _attrs_mismatch(sites):
+    if len({_op_kind(s) for s in sites}) > 1:
+        return True
+    averages = {repr(_average_literal(s)) for s in sites
+                if _average_literal(s) is not None}
+    return len(averages) > 1

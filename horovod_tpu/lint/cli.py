@@ -1,0 +1,77 @@
+"""The ``hvd-lint`` command line.
+
+Exit codes:
+  0 — no findings at or above the --fail-on severity (clean);
+  1 — findings at or above the --fail-on severity;
+  2 — usage error (no such path, unknown rule).
+
+``--format json`` emits a stable machine-readable report for CI; the
+human format is ``path:line:col: severity [rule] message``.
+"""
+
+import argparse
+import os
+import sys
+
+from . import RULES, lint_paths
+from .report import format_human, format_json, summarize_human
+from .rules import severity_at_least
+
+
+def make_parser():
+    parser = argparse.ArgumentParser(
+        prog="hvd-lint",
+        description="Static collective-consistency analysis for "
+                    "horovod_tpu training scripts (see docs/LINT.md).")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to lint")
+    parser.add_argument("--format", choices=("human", "json"),
+                        default="human")
+    parser.add_argument("--fail-on", choices=("warning", "error"),
+                        default="warning",
+                        help="lowest severity that causes exit code 1 "
+                             "(default: warning — any finding fails)")
+    parser.add_argument("--disable", default="",
+                        help="comma-separated rule ids to skip globally")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule registry and exit")
+    return parser
+
+
+def main(argv=None):
+    parser = make_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES.values():
+            sys.stdout.write("%-28s %-8s %s\n" %
+                             (rule.id, rule.default_severity, rule.summary))
+        return 0
+
+    if not args.paths:
+        parser.error("no paths given (or use --list-rules)")
+
+    disabled = {r.strip() for r in args.disable.split(",") if r.strip()}
+    unknown = disabled - set(RULES)
+    if unknown:
+        parser.error("unknown rule id(s): %s" % ", ".join(sorted(unknown)))
+    for path in args.paths:
+        if not os.path.exists(path):
+            parser.error("no such file or directory: %s" % path)
+
+    enabled = set(RULES) - disabled
+    findings, files_checked = lint_paths(args.paths, rules=enabled)
+
+    if args.format == "json":
+        format_json(findings, files_checked, sys.stdout)
+    else:
+        format_human(findings, sys.stdout)
+        summarize_human(findings, files_checked, sys.stderr)
+
+    failing = [f for f in findings
+               if severity_at_least(f.severity, args.fail_on)]
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,39 @@
+"""Finding reporters: human (path:line:col, grep/editor-friendly) and JSON
+(stable schema for CI and the launcher preflight)."""
+
+import json
+
+
+def format_human(findings, out):
+    for f in findings:
+        out.write("%s:%d:%d: %s [%s] %s\n" %
+                  (f.path, f.line, f.col, f.severity, f.rule, f.message))
+
+
+def summarize_human(findings, files_checked, out):
+    errors = sum(1 for f in findings if f.severity == "error")
+    warnings = sum(1 for f in findings if f.severity == "warning")
+    if findings:
+        out.write("hvd-lint: %d error(s), %d warning(s) in %d file(s)\n"
+                  % (errors, warnings, files_checked))
+    else:
+        out.write("hvd-lint: %d file(s) clean\n" % files_checked)
+
+
+def format_json(findings, files_checked, out):
+    payload = {
+        "files_checked": files_checked,
+        "findings": [
+            {
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "rule": f.rule,
+                "severity": f.severity,
+                "message": f.message,
+            }
+            for f in findings
+        ],
+    }
+    json.dump(payload, out, indent=2, sort_keys=True)
+    out.write("\n")

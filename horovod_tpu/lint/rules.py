@@ -1,0 +1,63 @@
+"""Rule registry for hvd-lint.
+
+Every rule is a cross-rank divergence hazard class: a static pattern that
+can make one rank submit a collective the others never will (silent hang —
+the failure mode the stall inspector and the runtime digest cross-check
+catch only *after* launch; see docs/LINT.md for the mapping between each
+rule and its runtime error message).
+"""
+
+import collections
+
+# Severities, ordered weakest to strongest.
+INFO = "info"
+WARNING = "warning"
+ERROR = "error"
+
+_SEVERITY_ORDER = {INFO: 0, WARNING: 1, ERROR: 2}
+
+
+def severity_at_least(severity, floor):
+    return _SEVERITY_ORDER[severity] >= _SEVERITY_ORDER[floor]
+
+
+Rule = collections.namedtuple("Rule", ["id", "default_severity", "summary"])
+
+# Registry: rule id -> Rule. Checkers register themselves in checkers.py;
+# the ids here are the public, stable suppression keys
+# (`# hvd-lint: disable=<id>`).
+RULES = collections.OrderedDict()
+# rule id -> checker callable(Model) -> iterable of Finding.
+CHECKERS = {}
+
+
+def register(rule_id, default_severity, summary):
+    """Decorator: registers `fn(model)` as the checker for `rule_id`."""
+    RULES[rule_id] = Rule(rule_id, default_severity, summary)
+
+    def deco(fn):
+        CHECKERS[rule_id] = fn
+        return fn
+
+    return deco
+
+
+# `end_line` exists so suppression comments work on multi-line statements
+# (a trailing `# hvd-lint: disable=...` on the closing line of a wrapped
+# call must suppress the finding anchored at its first line).
+Finding = collections.namedtuple(
+    "Finding", ["path", "line", "col", "rule", "severity", "message",
+                "end_line"])
+
+
+def make_finding(model, node, rule_id, message, severity=None):
+    line = getattr(node, "lineno", 1)
+    return Finding(
+        path=model.path,
+        line=line,
+        col=getattr(node, "col_offset", 0) + 1,
+        rule=rule_id,
+        severity=severity or RULES[rule_id].default_severity,
+        message=message,
+        end_line=getattr(node, "end_lineno", None) or line,
+    )

@@ -1,0 +1,532 @@
+"""AST walker: builds the semantic model the per-rule checkers consume.
+
+One pass over the tree collects, with full lexical context:
+
+* every horovod collective call site (resolved through import aliases, so
+  ``import horovod_tpu.jax as hx; hx.allreduce(...)`` and
+  ``from horovod_tpu.jax import allreduce`` both count);
+* the stack of enclosing conditionals/loops each site sits under, with
+  each condition classified as rank-dependent or uniform;
+* a one-level dataflow of variables assigned from ``rank()``-like calls
+  (so ``r = hvd.rank(); if r == 0:`` is recognized) and of unordered
+  iterables;
+* inline suppressions (``# hvd-lint: disable=<rule>[,<rule>...]``) from
+  the token stream, applying to their own line, or to the next code line
+  when the comment stands alone.
+
+The model is purely lexical: collectives reached through helper-function
+*calls* under a rank conditional are not traced inter-procedurally (the
+runtime digest cross-check is the backstop for those — docs/LINT.md).
+"""
+
+import ast
+import io
+import tokenize
+
+# --- what counts as a collective -------------------------------------------
+
+# callable name -> candidate positional indices of the `name`/`name_prefix`
+# argument (keyword always wins). Positions cover both the framework-level
+# APIs (horovod_tpu.jax etc.: allreduce(tensor, average, name)) and the
+# host-ops layer (common.ops: allreduce(tensor, name)).
+COLLECTIVES = {
+    "allreduce": (1, 2),
+    "allreduce_async": (1,),
+    "allreduce_gradients": (2,),
+    "allreduce_sparse": (2,),
+    "grouped_allreduce": (1,),
+    "allgather": (1,),
+    "allgather_async": (1,),
+    "alltoall": (1,),
+    "broadcast": (2,),
+    "broadcast_async": (2,),
+    "broadcast_object": (2,),
+    "broadcast_parameters": (2,),
+    "broadcast_optimizer_state": (2,),
+    "broadcast_variables": (2,),
+    "metric_average": (1,),
+}
+
+# Collectives whose names are derived from a prefix + stable pytree order;
+# calling these in a loop re-negotiates the SAME names (cache-friendly), so
+# the loop-auto-name rule must not fire on them.
+PREFIX_NAMED = {
+    "allreduce_gradients", "allreduce_sparse", "broadcast_object",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "broadcast_variables",
+}
+
+# Presence of any of these marks a script as "training with gradient
+# averaging" for the missing-initial-broadcast rule...
+TRAIN_MARKERS = {
+    "DistributedOptimizer", "DistributedGradientTape", "allreduce_gradients",
+}
+# ...and any of these satisfies it.
+INITIAL_BROADCASTS = {
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "broadcast_variables", "broadcast_object", "broadcast_global_variables",
+    "BroadcastGlobalVariablesHook", "BroadcastGlobalVariablesCallback",
+}
+
+# hvd.elastic commit points: divergence hazards under rank conditionals
+# exactly like collectives (state.commit()/sync() run coordinated
+# collectives internally).
+ELASTIC_COMMITS = {"commit", "sync"}
+
+# Calls returning per-rank values: conditions and collective names derived
+# from these diverge across ranks. (size()/cross_size() are uniform;
+# local_size() differs on heterogeneous hosts, so it is included.)
+RANK_FUNCS = {"rank", "local_rank", "cross_rank", "local_size"}
+
+# Nondeterministic / per-process name sources for the rank-dependent-name
+# rule: (module-ish base, attr) pairs matched loosely on the call chain.
+NONDET_CALLS = {
+    ("socket", "gethostname"), ("platform", "node"), ("os", "getpid"),
+    ("time", "time"), ("time", "monotonic"), ("time", "perf_counter"),
+    ("time", "time_ns"), ("uuid", "uuid1"), ("uuid", "uuid4"),
+}
+NONDET_BASES = {"random"}  # random.random(), np.random.*, ...
+
+HOROVOD_ROOT = "horovod_tpu"
+# Module names whose attributes we also accept when imported without an
+# alias map hit (plain `horovod` scripts being migrated).
+_HVD_FALLBACK_PREFIXES = ("horovod",)
+
+
+class Condition(object):
+    """One enclosing `if`/`while` test (or a boolean guard)."""
+
+    __slots__ = ("node", "rank_dependent", "source")
+
+    def __init__(self, node, rank_dependent, source):
+        self.node = node
+        self.rank_dependent = rank_dependent
+        self.source = source  # short human description, e.g. "rank() == 0"
+
+
+class Loop(object):
+    """One enclosing `for`/`while` loop."""
+
+    __slots__ = ("node", "target_names", "unordered", "unordered_kind")
+
+    def __init__(self, node, target_names=(), unordered=False,
+                 unordered_kind=None):
+        self.node = node
+        self.target_names = set(target_names)
+        self.unordered = unordered
+        self.unordered_kind = unordered_kind  # "set" | "dict"
+
+
+class CallSite(object):
+    """A collective (or elastic-commit) call with its lexical context."""
+
+    __slots__ = ("node", "func", "is_commit", "name_node", "conditions",
+                 "loops", "kwargs", "args")
+
+    def __init__(self, node, func, is_commit, name_node, conditions, loops,
+                 args, kwargs):
+        self.node = node
+        self.func = func                # canonical collective name
+        self.is_commit = is_commit
+        self.name_node = name_node      # AST expr of name/name_prefix or None
+        self.conditions = conditions    # tuple of Condition (outermost first)
+        self.loops = loops              # tuple of Loop (outermost first)
+        self.args = args
+        self.kwargs = kwargs            # dict name -> AST expr
+
+
+class Model(object):
+    def __init__(self, path, source, tree):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.hvd_aliases = set()        # names bound to horovod modules
+        self.hvd_members = set()        # collective names imported directly
+        self.rank_vars = set()          # variables holding rank-like values
+        self.unordered_vars = {}        # var -> "set"|"dict"
+        self.call_sites = []
+        self.suppressed = {}            # line -> set of rule ids ({"*"}=all)
+        self.uses_elastic = False
+
+    # -- suppression queries -------------------------------------------
+
+    def is_suppressed(self, line, rule_id, end_line=None):
+        """True when any line of [line, end_line] carries a suppression
+        for `rule_id` (multi-line statements accept the comment on any
+        of their lines, e.g. after the closing paren)."""
+        for ln in range(line, (end_line or line) + 1):
+            rules = self.suppressed.get(ln)
+            if rules is not None and ("*" in rules or rule_id in rules):
+                return True
+        return False
+
+
+# --- suppression comments ---------------------------------------------------
+
+def _scan_suppressions(source, model):
+    """Fills model.suppressed from `# hvd-lint: disable=...` comments.
+
+    A trailing comment suppresses its own line; a comment-only line
+    suppresses the next non-blank, non-comment line.
+    """
+    pending = set()  # rules from standalone comments awaiting a code line
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT:
+            rules = _parse_suppression(tok.string)
+            if rules is None:
+                continue
+            line_text = model.lines[tok.start[0] - 1] \
+                if tok.start[0] - 1 < len(model.lines) else ""
+            if line_text.strip().startswith("#"):
+                pending.update(rules)  # stacked comments accumulate
+            else:
+                model.suppressed.setdefault(tok.start[0], set()).update(rules)
+        elif tok.type in (tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+                          tokenize.DEDENT):
+            continue
+        elif pending and tok.type not in (tokenize.ENDMARKER,):
+            model.suppressed.setdefault(tok.start[0], set()).update(pending)
+            pending = set()
+
+
+def _parse_suppression(comment):
+    """Returns the rule-id set for a `# hvd-lint: disable[=...]` comment,
+    or None when the comment is not a suppression."""
+    text = comment.lstrip("#").strip()
+    if not text.startswith("hvd-lint:"):
+        return None
+    text = text[len("hvd-lint:"):].strip()
+    if not text.startswith("disable"):
+        return None
+    rest = text[len("disable"):].strip()
+    if not rest:
+        return {"*"}
+    if rest.startswith("="):
+        ids = [r.strip() for r in rest[1:].split("#")[0].split(",")]
+        return {r for r in ids if r} or {"*"}
+    return None
+
+
+# --- expression classification ----------------------------------------------
+
+def _dotted(node):
+    """'a.b.c' for an attribute/name chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_base_attr(func):
+    """For a call's func node, returns (base_name_or_None, attr_name)."""
+    if isinstance(func, ast.Name):
+        return None, func.id
+    if isinstance(func, ast.Attribute):
+        base = _dotted(func.value)
+        return base, func.attr
+    return None, None
+
+
+def _is_hvd_base(model, base):
+    if base is None:
+        return False
+    root = base.split(".")[0]
+    if root in model.hvd_aliases:
+        return True
+    return base.startswith((HOROVOD_ROOT,) + _HVD_FALLBACK_PREFIXES)
+
+
+def is_rank_call(model, node):
+    """True when `node` is a call like hvd.rank() / local_rank()."""
+    if not isinstance(node, ast.Call):
+        return False
+    base, attr = _call_base_attr(node.func)
+    if attr not in RANK_FUNCS:
+        return False
+    if base is None:
+        return attr in model.hvd_members
+    return _is_hvd_base(model, base)
+
+
+def expr_rank_dependent(model, node):
+    """True when any subexpression derives from a per-rank value."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and is_rank_call(model, sub):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in model.rank_vars:
+            return True
+    return False
+
+
+def expr_nondeterministic(model, node):
+    """True when the expression draws on per-process entropy (time,
+    random, uuid, pid, hostname) — unusable in a collective name."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        base, attr = _call_base_attr(sub.func)
+        if base is None:
+            continue
+        root = base.split(".")[0]
+        tail = base.split(".")[-1]
+        if (root, attr) in NONDET_CALLS or (tail, attr) in NONDET_CALLS:
+            return True
+        if root in NONDET_BASES or tail in NONDET_BASES:
+            return True
+    return False
+
+
+def describe_expr(model, node):
+    """Short source snippet for messages."""
+    try:
+        text = ast.get_source_segment(model.source, node)
+    except Exception:  # pragma: no cover - ancient ast
+        text = None
+    if text is None:
+        return "<expr>"
+    text = " ".join(text.split())
+    return text if len(text) <= 60 else text[:57] + "..."
+
+
+def _unordered_iter_kind(model, node):
+    """Classifies a `for` iterable: returns "set"/"dict" when iteration
+    order is process-dependent (set hashing) or construction-dependent
+    (dict), None when ordered. `sorted(...)` launders anything."""
+    if isinstance(node, ast.Call):
+        base, attr = _call_base_attr(node.func)
+        if attr in ("sorted",) or (base is None and attr == "sorted"):
+            return None
+        if base is None and attr in ("set", "frozenset"):
+            return "set"
+        if attr in ("keys", "values", "items"):
+            return "dict"
+        if base is None and attr == "enumerate" and node.args:
+            return _unordered_iter_kind(model, node.args[0])
+    if isinstance(node, ast.Set):
+        return "set"
+    if isinstance(node, ast.Name):
+        return model.unordered_vars.get(node.id)
+    return None
+
+
+def _target_names(target):
+    names = []
+    for sub in ast.walk(target):
+        if isinstance(sub, ast.Name):
+            names.append(sub.id)
+    return names
+
+
+# --- the visitor ------------------------------------------------------------
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, model):
+        self.m = model
+        self.conditions = []
+        self.loops = []
+
+    # imports ---------------------------------------------------------------
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            mod = alias.name
+            if mod.split(".")[0] in (HOROVOD_ROOT,) or \
+                    mod.startswith(_HVD_FALLBACK_PREFIXES):
+                self.m.hvd_aliases.add(alias.asname or mod.split(".")[0])
+                if ".elastic" in mod or mod.endswith("elastic"):
+                    self.m.uses_elastic = True
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        mod = node.module or ""
+        if mod.split(".")[0] in (HOROVOD_ROOT,) or \
+                mod.startswith(_HVD_FALLBACK_PREFIXES):
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                if alias.name in COLLECTIVES or \
+                        alias.name in TRAIN_MARKERS or \
+                        alias.name in INITIAL_BROADCASTS or \
+                        alias.name in RANK_FUNCS:
+                    self.m.hvd_members.add(bound)
+                else:
+                    # `from horovod_tpu import jax as hvd_jax` binds a module
+                    self.m.hvd_aliases.add(bound)
+                if alias.name == "elastic":
+                    self.m.uses_elastic = True
+        self.generic_visit(node)
+
+    # dataflow --------------------------------------------------------------
+
+    def visit_Assign(self, node):
+        self._track_assign(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None and node.target is not None:
+            self._track_assign([node.target], node.value)
+        self.generic_visit(node)
+
+    def _track_assign(self, targets, value):
+        pairs = []
+        for target in targets:
+            if isinstance(target, ast.Name):
+                pairs.append((target, value))
+            elif isinstance(target, (ast.Tuple, ast.List)) and \
+                    isinstance(value, (ast.Tuple, ast.List)) and \
+                    len(target.elts) == len(value.elts):
+                pairs.extend(zip(target.elts, value.elts))
+        for tgt, val in pairs:
+            if not isinstance(tgt, ast.Name):
+                continue
+            if expr_rank_dependent(self.m, val) or \
+                    expr_nondeterministic(self.m, val):
+                self.m.rank_vars.add(tgt.id)
+            else:
+                self.m.rank_vars.discard(tgt.id)
+            kind = None
+            if isinstance(val, (ast.Set, ast.SetComp)):
+                kind = "set"
+            elif isinstance(val, (ast.Dict, ast.DictComp)):
+                kind = "dict"
+            elif isinstance(val, ast.Call):
+                _, attr = _call_base_attr(val.func)
+                if attr in ("set", "frozenset"):
+                    kind = "set"
+                elif attr in ("dict",):
+                    kind = "dict"
+            if kind is not None:
+                self.m.unordered_vars[tgt.id] = kind
+            else:
+                self.m.unordered_vars.pop(tgt.id, None)
+
+    # control flow ----------------------------------------------------------
+
+    def visit_If(self, node):
+        cond = Condition(node, expr_rank_dependent(self.m, node.test),
+                         describe_expr(self.m, node.test))
+        self.visit(node.test)
+        self.conditions.append(cond)
+        for child in node.body:
+            self.visit(child)
+        for child in node.orelse:
+            self.visit(child)
+        self.conditions.pop()
+
+    def visit_IfExp(self, node):
+        cond = Condition(node, expr_rank_dependent(self.m, node.test),
+                         describe_expr(self.m, node.test))
+        self.visit(node.test)
+        self.conditions.append(cond)
+        self.visit(node.body)
+        self.visit(node.orelse)
+        self.conditions.pop()
+
+    def visit_While(self, node):
+        cond = Condition(node, expr_rank_dependent(self.m, node.test),
+                         describe_expr(self.m, node.test))
+        self.conditions.append(cond)
+        self.loops.append(Loop(node))
+        self.generic_visit(node)
+        self.loops.pop()
+        self.conditions.pop()
+
+    def visit_For(self, node):
+        kind = _unordered_iter_kind(self.m, node.iter)
+        loop = Loop(node, _target_names(node.target), kind is not None, kind)
+        self.visit(node.iter)
+        self.loops.append(loop)
+        for child in node.body:
+            self.visit(child)
+        for child in node.orelse:
+            self.visit(child)
+        self.loops.pop()
+
+    # call sites ------------------------------------------------------------
+
+    def visit_Call(self, node):
+        func = self._collective_name(node)
+        if func is not None:
+            name_node = self._name_argument(node, func)
+            self.m.call_sites.append(CallSite(
+                node, func, func in ELASTIC_COMMITS, name_node,
+                tuple(self.conditions), tuple(self.loops),
+                list(node.args),
+                {kw.arg: kw.value for kw in node.keywords if kw.arg}))
+        self.generic_visit(node)
+
+    def _collective_name(self, node):
+        base, attr = _call_base_attr(node.func)
+        if attr is None:
+            return None
+        interesting = (attr in COLLECTIVES or attr in TRAIN_MARKERS or
+                       attr in INITIAL_BROADCASTS)
+        if interesting:
+            if base is None:
+                if attr in self.m.hvd_members or attr in INITIAL_BROADCASTS \
+                        and attr[0].isupper():
+                    return attr
+                return None
+            if _is_hvd_base(self.m, base):
+                return attr
+            return None
+        # elastic commit points: state.commit()/state.sync() — only when the
+        # file actually uses hvd.elastic (keeps `dict.sync()`-ish code on
+        # unrelated objects out).
+        if attr in ELASTIC_COMMITS and self.m.uses_elastic and \
+                base is not None:
+            return attr
+        return None
+
+    def _name_argument(self, node, func):
+        for kw in node.keywords:
+            if kw.arg in ("name", "name_prefix"):
+                return kw.value
+        for pos in COLLECTIVES.get(func, ()):
+            if pos < len(node.args):
+                arg = node.args[pos]
+                if _looks_like_name(arg):
+                    return arg
+        return None
+
+
+def _looks_like_name(node):
+    """Heuristic: positional args only count as the name when string-ish."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, str)
+    if isinstance(node, ast.JoinedStr):
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Mod, ast.Add)):
+        return _looks_like_name(node.left) or _looks_like_name(node.right)
+    if isinstance(node, ast.Call):
+        _, attr = _call_base_attr(node.func)
+        return attr in ("format", "join", "str")
+    return False
+
+
+def literal_name(site):
+    """The constant string value of a site's name argument, or None."""
+    node = site.name_node
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def build_model(path, source):
+    """Parses `source` and returns the populated Model.
+
+    Raises SyntaxError (with filename set) when the source does not parse.
+    """
+    tree = ast.parse(source, filename=path)
+    model = Model(path, source, tree)
+    _scan_suppressions(source, model)
+    _Walker(model).visit(tree)
+    return model

@@ -30,6 +30,8 @@ namespace hvdtpu {
 #define HVD_TPU_AUTOTUNE_LOG "HVD_TPU_AUTOTUNE_LOG"
 #define HVD_TPU_STALL_CHECK_TIME "HVD_TPU_STALL_CHECK_TIME_SECONDS"
 #define HVD_TPU_STALL_SHUTDOWN_TIME "HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS"
+#define HVD_TPU_DIVERGENCE_CALLS "HVD_TPU_DIVERGENCE_CALLS"
+#define HVD_TPU_DIVERGENCE_GRACE "HVD_TPU_DIVERGENCE_GRACE_SECONDS"
 #define HVD_TPU_HIERARCHICAL_ALLREDUCE "HVD_TPU_HIERARCHICAL_ALLREDUCE"
 #define HVD_TPU_HIERARCHICAL_ALLGATHER "HVD_TPU_HIERARCHICAL_ALLGATHER"
 

@@ -237,6 +237,14 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
         ready_names.push_back(msg.tensor_name());
       }
     }
+    // The coordinator's own call stream enters the detector directly (its
+    // RequestList is never serialized).
+    if (call_tracker_ != nullptr) {
+      divergence_.Observe(rank_, cycle_call_seq_, cycle_call_digest_,
+                          call_tracker_->RecordsSince(reported_call_seq_, 32,
+                                                      cycle_call_seq_));
+      reported_call_seq_ = cycle_call_seq_;
+    }
     // Gather worker RequestLists (rank 0's own slot is unused).
     std::vector<std::string> blobs;
     GatherBlobs(std::string(), &blobs);
@@ -247,6 +255,8 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
         continue;
       }
       if (list.shutdown()) should_shut_down = true;
+      divergence_.Observe(r, list.call_seq(), list.call_digest(),
+                          list.recent_calls());
       for (const auto& msg : list.requests()) {
         if (IncrementTensorCount(msg, r)) {
           ready_names.push_back(msg.tensor_name());
@@ -256,6 +266,20 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
     for (const auto& name : ready_names) {
       responses.push_back(ConstructResponse(name));
     }
+    // Divergence cross-check: fail provably diverged pending tensors NOW
+    // with a named call site, instead of letting them hang to the stall
+    // timeout (divergence.h documents the two proof rules).
+    for (const auto& diag : divergence_.Check(message_table_)) {
+      LOG(ERROR) << diag.message;
+      message_table_.erase(diag.tensor_name);
+      stall_inspector_.RemoveUncachedTensor(diag.tensor_name);
+      timeline_.NegotiateEnd(diag.tensor_name);
+      Response error;
+      error.add_tensor_name(diag.tensor_name);
+      error.set_response_type(Response::ERROR);
+      error.set_error_message(diag.message);
+      responses.push_back(std::move(error));
+    }
     response_list.set_shutdown(should_shut_down);
     FuseResponses(responses, response_list);
     std::string blob;
@@ -264,6 +288,14 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
   } else {
     RequestList message_list;
     message_list.set_shutdown(should_shut_down);
+    if (call_tracker_ != nullptr) {
+      message_list.set_call_seq(cycle_call_seq_);
+      message_list.set_call_digest(cycle_call_digest_);
+      message_list.set_recent_calls(
+          call_tracker_->RecordsSince(reported_call_seq_, 32,
+                                      cycle_call_seq_));
+      reported_call_seq_ = cycle_call_seq_;
+    }
     for (auto& msg : non_cached_messages) {
       message_list.add_request(msg);
     }
@@ -288,6 +320,12 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
 ResponseList Controller::ComputeResponseList(
     bool this_process_requested_shutdown) {
   CacheCoordinator cache_coordinator(response_cache_.num_active_bits());
+
+  // Snapshot BEFORE the queue pop (see cycle_call_seq_ in controller.h:
+  // the pop then provably contains every call the snapshot counts).
+  if (call_tracker_ != nullptr) {
+    call_tracker_->Snapshot(&cycle_call_seq_, &cycle_call_digest_);
+  }
 
   std::deque<Request> message_queue_tmp;
   tensor_queue_.PopMessagesFromQueue(message_queue_tmp);
@@ -335,6 +373,18 @@ ResponseList Controller::ComputeResponseList(
     }
     stall_inspector_.UpdateCheckTime();
   }
+  // Quiescent-stall escape hatch: when every rank is blocked waiting, no
+  // rank has uncached work, so cycles ride the fast bit-sync and the
+  // coordinator would never see fresh seq/digest reports to cross-check.
+  // An aged pending tensor makes the coordinator force a full round trip
+  // (the flag is OR-synced, so all ranks follow); workers then ship their
+  // call-tracker state on otherwise-empty RequestLists. Rate-limited
+  // inside ShouldForceFullCycle.
+  if (is_coordinator() &&
+      divergence_.ShouldForceFullCycle(message_table_)) {
+    cache_coordinator.set_uncached_in_queue(true);
+  }
+
   cache_coordinator.set_should_shut_down(this_process_requested_shutdown);
 
   bool should_shut_down = this_process_requested_shutdown;

@@ -29,6 +29,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "divergence.h"
 #include "message.h"
 #include "response_cache.h"
 #include "stall_inspector.h"
@@ -81,6 +82,18 @@ class Controller {
   void SynchronizeParameters();
 
   StallInspector& stall_inspector() { return stall_inspector_; }
+
+  // --- divergence cross-check (divergence.h) ---
+  // The process-wide call tracker feeds each cycle's RequestList with this
+  // rank's (seq, digest, recent calls); on the coordinator the detector
+  // cross-checks them against the pending table and fails provably
+  // diverged tensors with ERROR responses naming the offending call site.
+  void SetCallTracker(CallTracker* tracker) { call_tracker_ = tracker; }
+  // Call after Initialize() (needs size_). progress_calls==0 and
+  // grace_seconds<=0 disable the respective rules.
+  void ConfigureDivergence(int64_t progress_calls, double grace_seconds) {
+    divergence_.Configure(size_, progress_calls, grace_seconds);
+  }
 
   // --- negotiation-cycle accounting (fast path vs full round trip) ---
   // fast  = all-cached cycles that produced work from the bit-vector
@@ -138,6 +151,20 @@ class Controller {
   Timeline& timeline_;
   ParameterManager& parameter_manager_;
   StallInspector stall_inspector_;
+  CallTracker* call_tracker_ = nullptr;
+  DivergenceDetector divergence_;
+  // Highest tracker seq already shipped (worker) / self-observed
+  // (coordinator); records above it ride the next RequestList.
+  uint64_t reported_call_seq_ = 0;
+  // Tracker snapshot taken at the TOP of ComputeResponseList, BEFORE the
+  // message-queue pop. Ordering invariant for the progress rule: a call
+  // enters the tracker only after its Request is queued, so every call
+  // counted by this snapshot has its Request in this cycle's pop (or an
+  // earlier one) — the reported seq can never run ahead of the shipped
+  // requests, which is what made a mid-burst rank look "provably past"
+  // a tensor it was still about to submit.
+  uint64_t cycle_call_seq_ = 0;
+  uint64_t cycle_call_digest_ = 0;
 
   std::atomic<uint64_t> cycles_fast_{0};
   std::atomic<uint64_t> cycles_full_{0};

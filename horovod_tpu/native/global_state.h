@@ -9,6 +9,7 @@
 #include <memory>
 #include <thread>
 
+#include "divergence.h"
 #include "fusion_buffer_manager.h"
 #include "parameter_manager.h"
 #include "response_cache.h"
@@ -44,6 +45,10 @@ struct HorovodGlobalState {
   bool mark_cycles_in_timeline = false;
   ParameterManager parameter_manager;
   ResponseCache response_cache;
+  // Every rank's collective call sequence (seq / rolling digest / recent
+  // ring) — fed by EnqueueTensor, cross-checked by the coordinator's
+  // DivergenceDetector and exposed to Python via horovod_tpu_call_digest.
+  CallTracker call_tracker;
   FusionBufferManager fusion_buffer;
   std::unique_ptr<Controller> controller;
   std::unique_ptr<OperationManager> op_manager;

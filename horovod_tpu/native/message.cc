@@ -171,6 +171,16 @@ void RequestList::SerializeTo(std::string* out) const {
   PutU8(out, shutdown_ ? 1 : 0);
   PutU32(out, static_cast<uint32_t>(requests_.size()));
   for (const auto& req : requests_) req.SerializeTo(out);
+  PutI64(out, static_cast<int64_t>(call_seq_));
+  PutI64(out, static_cast<int64_t>(call_digest_));
+  PutU32(out, static_cast<uint32_t>(recent_calls_.size()));
+  for (const auto& rec : recent_calls_) {
+    PutI64(out, static_cast<int64_t>(rec.seq));
+    PutU8(out, rec.op);
+    PutU8(out, rec.dtype);
+    PutU8(out, rec.ndim);
+    PutStr(out, rec.name);
+  }
 }
 
 bool RequestList::ParseFrom(const char* data, std::size_t len) {
@@ -187,6 +197,24 @@ bool RequestList::ParseFrom(const char* data, std::size_t len) {
     if (used == 0) return false;
     off += used;
     requests_.push_back(std::move(req));
+  }
+  Reader tail(data + off, len - off);
+  int64_t seq, digest;
+  uint32_t nrec;
+  if (!tail.GetI64(&seq) || !tail.GetI64(&digest) || !tail.GetU32(&nrec))
+    return false;
+  call_seq_ = static_cast<uint64_t>(seq);
+  call_digest_ = static_cast<uint64_t>(digest);
+  recent_calls_.clear();
+  for (uint32_t i = 0; i < nrec; ++i) {
+    CallRecord rec;
+    int64_t rseq;
+    if (!tail.GetI64(&rseq) || !tail.GetU8(&rec.op) ||
+        !tail.GetU8(&rec.dtype) || !tail.GetU8(&rec.ndim) ||
+        !tail.GetStr(&rec.name))
+      return false;
+    rec.seq = static_cast<uint64_t>(rseq);
+    recent_calls_.push_back(std::move(rec));
   }
   return true;
 }

@@ -86,6 +86,17 @@ class Request {
   double postscale_factor_ = 1.0;
 };
 
+// One entry of a rank's collective call history (divergence.h): enough to
+// name the call site in a cross-rank divergence report without shipping
+// the full Request.
+struct CallRecord {
+  uint64_t seq = 0;   // 1-based position in the rank's call sequence
+  uint8_t op = 0;     // Request::RequestType
+  uint8_t dtype = 0;  // DataType
+  uint8_t ndim = 0;   // shape rank
+  std::string name;
+};
+
 class RequestList {
  public:
   const std::vector<Request>& requests() const { return requests_; }
@@ -94,12 +105,28 @@ class RequestList {
   bool shutdown() const { return shutdown_; }
   void set_shutdown(bool v) { shutdown_ = v; }
 
+  // Divergence-tracker piggyback (divergence.h): the sending rank's call
+  // sequence position, rolling digest, and records since its last report.
+  uint64_t call_seq() const { return call_seq_; }
+  void set_call_seq(uint64_t v) { call_seq_ = v; }
+  uint64_t call_digest() const { return call_digest_; }
+  void set_call_digest(uint64_t v) { call_digest_ = v; }
+  const std::vector<CallRecord>& recent_calls() const {
+    return recent_calls_;
+  }
+  void set_recent_calls(std::vector<CallRecord> v) {
+    recent_calls_ = std::move(v);
+  }
+
   void SerializeTo(std::string* out) const;
   bool ParseFrom(const char* data, std::size_t len);
 
  private:
   std::vector<Request> requests_;
   bool shutdown_ = false;
+  uint64_t call_seq_ = 0;
+  uint64_t call_digest_ = 0;
+  std::vector<CallRecord> recent_calls_;
 };
 
 // A Response is the coordinator's verdict: do this (possibly fused) op now,

@@ -237,6 +237,9 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
   state.tcp_context.ResetProtocolCounters();
   state.responses_performed.store(0);
   state.tensors_performed.store(0);
+  // Call-sequence tracking restarts with the generation: survivors of an
+  // elastic shrink/regrow and fresh workers must agree on seq 0.
+  state.call_tracker.Reset();
 
   if (!state.tcp_context.Initialize()) {
     state.tcp_context.Finalize();  // release sockets for a re-init retry
@@ -277,6 +280,17 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
       static_cast<int>(EnvInt64(HVD_TPU_STALL_CHECK_TIME, 60)));
   state.controller->stall_inspector().SetStallShutdownTimeSeconds(
       static_cast<int>(EnvInt64(HVD_TPU_STALL_SHUTDOWN_TIME, 0)));
+
+  // Divergence cross-check (divergence.h): progress rule fires after a
+  // missing rank advances this many calls past a pending tensor (0 = off);
+  // the cross-stall rule after a pending tensor ages this many seconds
+  // with every missing rank waiting elsewhere (<=0 = off). Both default
+  // on — they only trigger on protocol-divergent programs, which would
+  // otherwise hang to the stall timeout.
+  state.controller->SetCallTracker(&state.call_tracker);
+  state.controller->ConfigureDivergence(
+      EnvInt64(HVD_TPU_DIVERGENCE_CALLS, 64),
+      EnvDouble(HVD_TPU_DIVERGENCE_GRACE, 5.0));
 
   const char* timeline_path = std::getenv(HVD_TPU_TIMELINE);
   if (timeline_path != nullptr) {
@@ -412,8 +426,17 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
                        done_entry.gathered_sizes);
   };
   LOG(TRACE) << "enqueue " << name << " handle " << handle;
-  return g_state.tensor_queue.AddToTensorQueue(std::move(entry),
-                                               std::move(message));
+  Status status = g_state.tensor_queue.AddToTensorQueue(std::move(entry),
+                                                        std::move(message));
+  // Only ADMITTED calls enter the fingerprint: a rejected enqueue (e.g.
+  // DUPLICATE_NAME while the prior async op is in flight) never reaches
+  // negotiation, and counting it would diverge this rank's seq/digest
+  // from peers on a protocol-consistent program.
+  if (status.ok()) {
+    g_state.call_tracker.Record(static_cast<uint8_t>(type),
+                                static_cast<uint8_t>(dtype), ndim, name);
+  }
+  return status;
 }
 
 }  // namespace
@@ -510,6 +533,15 @@ void horovod_tpu_protocol_counters(uint64_t* out) {
   out[2] = g_state.tcp_context.ctrl_msgs();
   out[3] = g_state.controller ? g_state.controller->cycles_fast() : 0;
   out[4] = g_state.controller ? g_state.controller->cycles_full() : 0;
+}
+
+// This rank's collective call-sequence fingerprint: seq = number of
+// collectives enqueued since init, digest = rolling FNV-1a over each
+// call's (op, dtype, shape-rank, name). Ranks that executed identical
+// call sequences have identical (seq, digest) — the runtime divergence
+// assertion (hvd.jax.assert_synchronized) compares them across ranks.
+void horovod_tpu_call_digest(uint64_t* seq, uint64_t* digest) {
+  g_state.call_tracker.Snapshot(seq, digest);
 }
 
 void horovod_tpu_protocol_counters_reset() {

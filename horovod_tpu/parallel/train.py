@@ -93,6 +93,10 @@ def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
         raise ValueError("zero1 and gradient compression are mutually "
                          "exclusive (the scatter path is uncompressed)")
     compression = compression or hvd_jax.Compression.none
+    # Library helper, not a training script: the caller owns the initial
+    # parameter sync (place() replicates params over the mesh, and host
+    # checkpoint restore broadcasts before entering the step).
+    # hvd-lint: disable=missing-initial-broadcast
     dist_opt = hvd_jax.DistributedOptimizer(
         optimizer, compression=compression, axis_name=axis_name)
     n_shards = int(mesh.shape[axis_name])

@@ -147,6 +147,14 @@ def make_parser():
     parser.add_argument("--start-timeout", type=int, default=60,
                         help="seconds to wait for all ranks to connect")
     parser.add_argument("--check-build", action="store_true")
+    parser.add_argument("--lint", nargs="?", const="warn",
+                        choices=("warn", "strict"), default=None,
+                        help="hvd-lint preflight: statically check the "
+                             "training script for cross-rank divergence "
+                             "hazards before spawning workers; 'warn' "
+                             "(default when the flag is bare) reports and "
+                             "launches anyway, '--lint=strict' refuses to "
+                             "launch on any finding (see docs/LINT.md)")
     parser.add_argument("--disable-cache", action="store_true",
                         help="re-run host checks even if cached "
                              "(reference: horovodrun --disable-cache; "
@@ -446,6 +454,37 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
             server.stop()
 
 
+def lint_preflight(command, mode, out=sys.stderr):
+    """Statically checks the training script(s) in `command` for
+    cross-rank divergence hazards before any worker spawns (the silent
+    hangs the stall inspector and digest cross-check can only catch
+    after launch — docs/LINT.md). Returns True when the launch may
+    proceed: always in 'warn' mode, only on a clean report in 'strict'."""
+    from horovod_tpu.lint import lint_paths
+    from horovod_tpu.lint.report import format_human
+
+    targets = [arg for arg in command
+               if arg.endswith(".py") and os.path.isfile(arg)]
+    if not targets:
+        out.write("[hvd-lint] no .py file found in the command to lint; "
+                  "skipping preflight\n")
+        return True
+    findings, _ = lint_paths(targets)
+    if not findings:
+        out.write("[hvd-lint] %s: clean\n" % ", ".join(targets))
+        return True
+    format_human(findings, out)
+    if mode == "strict":
+        out.write("[hvd-lint] %d finding(s); refusing to launch "
+                  "(--lint=strict). Fix them or suppress intentional "
+                  "patterns with `# hvd-lint: disable=<rule>`.\n"
+                  % len(findings))
+        return False
+    out.write("[hvd-lint] %d finding(s); launching anyway (use "
+              "--lint=strict to fail instead)\n" % len(findings))
+    return True
+
+
 def main(argv=None):
     parser = make_parser()
     args = parser.parse_args(argv)
@@ -459,6 +498,8 @@ def main(argv=None):
         command = command[1:]
     if not command:
         parser.error("no command given")
+    if args.lint and not lint_preflight(command, args.lint):
+        return 1
     if args.tpu_pod:
         hosts = discover_tpu_pod()
         if hosts is None:

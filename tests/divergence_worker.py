@@ -1,0 +1,95 @@
+"""Worker for the runtime divergence cross-check e2e (test_divergence.py).
+
+Modes (DIVERGENCE_MODE env):
+  cross_stall — every rank sync-blocks on a rank-suffixed collective name
+      (the classic rank-divergent collective). Without the detector this
+      hangs until the stall-inspector timeout (default: forever); with it,
+      every rank gets a prompt HorovodInternalError naming BOTH sides of
+      the divergence.
+  progress — rank 0 submits an extra async collective under a rank
+      conditional, then all ranks keep training in lockstep. The progress
+      rule fails the orphan collective once rank 1 has demonstrably moved
+      past it, naming the calls rank 1 made instead; training on the
+      common path is untouched.
+  assert — all collectives complete, but ranks enqueued them in different
+      orders; hvd.jax.assert_synchronized() catches the sequence digest
+      mismatch.
+"""
+
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import ops
+from horovod_tpu.common.ops import HorovodInternalError
+
+
+def alarm(signum, frame):
+    sys.stderr.write("watchdog fired: job deadlocked\n")
+    sys.exit(3)
+
+
+signal.signal(signal.SIGALRM, alarm)
+signal.alarm(90)
+
+mode = os.environ.get("DIVERGENCE_MODE", "cross_stall")
+hvd.init()
+r = hvd.rank()
+hvd.allreduce(np.ones(4, dtype=np.float32), "warmup")
+
+if mode == "cross_stall":
+    t0 = time.time()
+    try:
+        # hvd-lint: disable=rank-dependent-name
+        hvd.allreduce(np.ones(4, dtype=np.float32), "diverged.%d" % r)
+        sys.stderr.write("rank %d: divergent collective completed?!\n" % r)
+        sys.exit(4)
+    except HorovodInternalError as e:
+        msg = str(e)
+        assert "divergence" in msg, msg
+        assert ("diverged.%d" % r) in msg, msg
+        # The report names the OTHER side's call site too.
+        assert ("diverged.%d" % (1 - r)) in msg, msg
+        print("divergence reported in %.1fs" % (time.time() - t0))
+elif mode == "progress":
+    handle = None
+    if r == 0:
+        # hvd-lint: disable=rank-conditional-collective
+        handle = ops.allreduce_async(np.ones(2, np.float32), "only_rank0")
+    for i in range(100):
+        hvd.allreduce(np.ones(4, dtype=np.float32), "step.%d" % i)
+    if r == 0:
+        try:
+            ops.synchronize(handle)
+            sys.stderr.write("orphan collective completed?!\n")
+            sys.exit(4)
+        except HorovodInternalError as e:
+            msg = str(e)
+            assert "only_rank0" in msg and "rank 1" in msg, msg
+            assert "step." in msg, msg  # names what rank 1 did instead
+        print("divergence reported")
+    else:
+        print("finished all steps")
+elif mode == "assert":
+    import horovod_tpu.jax as hvd_jax
+
+    hvd_jax.assert_synchronized()  # identical so far: must pass
+    names = ["a", "b"] if r == 0 else ["b", "a"]
+    handles = [ops.allreduce_async(np.ones(2, np.float32), n)
+               for n in names]
+    for h in handles:
+        ops.synchronize(h)
+    try:
+        hvd_jax.assert_synchronized()
+        sys.stderr.write("rank %d: digest mismatch not detected\n" % r)
+        sys.exit(4)
+    except hvd_jax.DivergenceError as e:
+        assert "diverged" in str(e)
+        print("divergence reported")
+else:
+    sys.stderr.write("unknown DIVERGENCE_MODE %r\n" % mode)
+    sys.exit(5)

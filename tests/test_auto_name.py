@@ -1,0 +1,44 @@
+"""Auto-generated collective names must be deterministic across ranks and
+generations: the jax binding's counter resets on every init(), so a
+survivor of an elastic shrink/regrow and a freshly spawned worker generate
+identical names for the same call sites (a diverged counter produces
+mismatched names — the exact hang the divergence cross-check reports)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd_core
+import horovod_tpu.jax as hvd_jax
+
+
+def test_auto_name_counter_resets_on_reinit():
+    hvd_core.init()
+    start = hvd_jax._name_counter[0]
+    hvd_jax.allreduce(jnp.ones(3), average=False)
+    assert hvd_jax._name_counter[0] == start + 1
+
+    # Simulate a surviving elastic member whose counter drifted during the
+    # failed generation (calls that newly spawned peers never made).
+    hvd_jax._name_counter[0] += 1000
+    hvd_core.shutdown()
+    hvd_core.init()
+    assert hvd_jax._name_counter[0] == 0
+
+    # First auto-named collective of the new generation: same name a
+    # fresh process would generate.
+    out = hvd_jax.allreduce(jnp.ones(3), average=False)
+    assert np.allclose(out, 1.0)
+    assert hvd_jax._name_counter[0] == 1
+
+
+def test_auto_names_deterministic_sequence():
+    hvd_core.init()
+    hvd_core.shutdown()
+    hvd_core.init()
+    assert hvd_jax._auto_name("allreduce") == "allreduce.1"
+    assert hvd_jax._auto_name("broadcast") == "broadcast.2"
+    hvd_core.shutdown()
+    hvd_core.init()
+    # Identical call pattern after re-init -> identical names.
+    assert hvd_jax._auto_name("allreduce") == "allreduce.1"
+    assert hvd_jax._auto_name("broadcast") == "broadcast.2"

@@ -1,0 +1,90 @@
+"""Runtime divergence detection: the dynamic complement of hvd-lint.
+
+e2e: a two-process job with an intentionally rank-divergent collective
+must fail promptly with an error naming the offending call site(s) —
+via the coordinator's digest/pending cross-check — instead of hanging
+until the stall-inspector timeout. Unit: the call tracker's seq/digest
+semantics and generation reset.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.mark.e2e
+def test_cross_stall_divergence_reports_call_site(run_launcher):
+    """Both ranks block on rank-suffixed names: every rank's error must
+    name both sides of the divergence, promptly (grace 2s, while the
+    stall inspector is left at its 60s default)."""
+    result = run_launcher(2, "divergence_worker.py", extra_env={
+        "DIVERGENCE_MODE": "cross_stall",
+        "HVD_TPU_DIVERGENCE_GRACE_SECONDS": "2",
+    })
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert result.stdout.count("divergence reported") == 2
+
+
+@pytest.mark.e2e
+def test_progress_divergence_names_missing_ranks_calls(run_launcher):
+    """An async rank-conditional orphan fails once the other rank has
+    moved 64 calls past it; the error lists what that rank did instead,
+    and the common training path is unaffected."""
+    result = run_launcher(2, "divergence_worker.py", extra_env={
+        "DIVERGENCE_MODE": "progress",
+        # keep the cross-stall rule out of the way so the progress rule
+        # is what fires
+        "HVD_TPU_DIVERGENCE_GRACE_SECONDS": "30",
+    })
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert result.stdout.count("divergence reported") == 1
+    assert result.stdout.count("finished all steps") == 1
+
+
+@pytest.mark.e2e
+def test_assert_synchronized_catches_reorder(run_launcher):
+    """Sequences that complete but differ in order are invisible to the
+    pending-table rules; the explicit digest assertion catches them."""
+    result = run_launcher(2, "divergence_worker.py", extra_env={
+        "DIVERGENCE_MODE": "assert",
+    })
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert result.stdout.count("divergence reported") == 2
+
+
+def test_call_digest_tracks_sequence():
+    """seq counts enqueued collectives; digest changes with each call and
+    is insensitive to nothing (same calls -> same value after re-init)."""
+    import horovod_tpu as hvd
+
+    hvd.init()
+    basics = hvd.get_basics()
+
+    def run_sequence():
+        hvd.allreduce(np.ones(3, dtype=np.float32), "digest.a")
+        hvd.allgather(np.ones(2, dtype=np.float32), "digest.b")
+        return basics.call_digest()
+
+    hvd.shutdown()
+    hvd.init()
+    seq0, digest0 = basics.call_digest()
+    assert seq0 == 0
+    seq1, digest1 = run_sequence()
+    assert seq1 == 2
+    assert digest1 != digest0
+
+    # Generation reset: the same sequence after re-init reproduces the
+    # same (seq, digest) — survivors and fresh workers agree.
+    hvd.shutdown()
+    hvd.init()
+    seq2, digest2 = basics.call_digest()
+    assert (seq2, digest2) == (0, digest0)
+    seq3, digest3 = run_sequence()
+    assert (seq3, digest3) == (seq1, digest1)
+
+
+def test_assert_synchronized_size1_passes():
+    import horovod_tpu as hvd
+    import horovod_tpu.jax as hvd_jax
+
+    hvd.init()
+    hvd_jax.assert_synchronized()  # size 1: trivially synchronized

@@ -1,0 +1,306 @@
+"""hvd-lint: corpus of known-bad / known-good snippets (one per rule),
+suppression handling, CLI exit-code semantics — and the repo self-lint:
+the shipped examples and models must stay clean, so a divergence hazard
+introduced into them fails tier-1."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from horovod_tpu.lint import RULES, lint_paths, lint_source
+from horovod_tpu.lint.cli import main as lint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(source):
+    return [f.rule for f in lint_source(textwrap.dedent(source))]
+
+
+# --- known-bad corpus: one snippet per rule ---------------------------------
+
+BAD_CORPUS = {
+    "rank-conditional-collective": """
+        import horovod_tpu as hvd
+        hvd.init()
+        if hvd.rank() == 0:
+            hvd.allreduce(x, "t")
+    """,
+    "missing-initial-broadcast": """
+        import horovod_tpu.jax as hvd_jax
+        opt = hvd_jax.DistributedOptimizer(opt)
+    """,
+    "unordered-name-iteration": """
+        import horovod_tpu as hvd
+        for key in {"w", "b"}:
+            hvd.allreduce(x, name="grad.%s" % key)
+    """,
+    "rank-dependent-name": """
+        import horovod_tpu as hvd
+        hvd.allreduce(x, name="grad.%d" % hvd.rank())
+    """,
+    "loop-auto-name": """
+        import horovod_tpu as hvd
+        for step in range(100):
+            hvd.allreduce(x)
+    """,
+    "duplicate-collective-name": """
+        import horovod_tpu as hvd
+        hvd.allreduce(x, name="g")
+        hvd.allreduce(y, name="g")
+    """,
+    "name-attr-mismatch": """
+        import horovod_tpu.jax as hj
+        hj.allreduce(x, name="g", average=True)
+        hj.allreduce(y, name="g", average=False)
+    """,
+}
+
+# --- known-good twins: the corrected version of each snippet ----------------
+
+GOOD_CORPUS = {
+    "rank-conditional-collective": """
+        import horovod_tpu as hvd
+        hvd.init()
+        loss = hvd.allreduce(x, "t")
+        if hvd.rank() == 0:
+            print(loss)
+    """,
+    "missing-initial-broadcast": """
+        import horovod_tpu.jax as hvd_jax
+        opt = hvd_jax.DistributedOptimizer(opt)
+        params = hvd_jax.broadcast_parameters(params, root_rank=0)
+    """,
+    "unordered-name-iteration": """
+        import horovod_tpu as hvd
+        for key in sorted({"w", "b"}):
+            hvd.allreduce(x, name="grad.%s" % key)
+    """,
+    "rank-dependent-name": """
+        import horovod_tpu as hvd
+        hvd.allreduce(x, name="grad.dense0")
+    """,
+    "loop-auto-name": """
+        import horovod_tpu as hvd
+        for step in range(100):
+            hvd.allreduce(x, name="grad.dense0")
+    """,
+    "duplicate-collective-name": """
+        import horovod_tpu as hvd
+        hvd.allreduce(x, name="g.x")
+        hvd.allreduce(y, name="g.y")
+    """,
+    "name-attr-mismatch": """
+        import horovod_tpu.jax as hj
+        hj.allreduce(x, name="g.sum", average=False)
+        hj.allreduce(y, name="g.mean", average=True)
+    """,
+}
+
+
+@pytest.mark.parametrize("rule", sorted(BAD_CORPUS))
+def test_known_bad_flags(rule):
+    assert rule in rules_of(BAD_CORPUS[rule])
+
+
+@pytest.mark.parametrize("rule", sorted(GOOD_CORPUS))
+def test_known_good_clean(rule):
+    assert rules_of(GOOD_CORPUS[rule]) == []
+
+
+def test_uniform_size_condition_not_flagged():
+    # size() is identical on every rank — `if size > 1` is safe.
+    assert rules_of("""
+        import horovod_tpu as hvd
+        if hvd.size() > 1:
+            hvd.allreduce(x, "t")
+    """) == []
+
+
+def test_rank_variable_dataflow():
+    # rank held in a variable (the common idiom) is still caught.
+    assert "rank-conditional-collective" in rules_of("""
+        import horovod_tpu as hvd
+        rank, world = hvd.rank(), hvd.size()
+        if rank == 0:
+            hvd.broadcast(x, 0, "t")
+    """)
+
+
+def test_dict_iteration_is_warning_set_is_error():
+    findings = lint_source(textwrap.dedent("""
+        import horovod_tpu as hvd
+        for k, v in params.items():
+            hvd.allreduce(v, name=k)
+    """))
+    assert [f.severity for f in findings
+            if f.rule == "unordered-name-iteration"] == ["warning"]
+    findings = lint_source(textwrap.dedent("""
+        import horovod_tpu as hvd
+        for k in set(names):
+            hvd.allreduce(x, name=k)
+    """))
+    assert [f.severity for f in findings
+            if f.rule == "unordered-name-iteration"] == ["error"]
+
+
+def test_elastic_commit_under_rank_conditional():
+    assert "rank-conditional-collective" in rules_of("""
+        import horovod_tpu as hvd
+        from horovod_tpu import elastic
+        state = elastic.ElasticState(params)
+        if hvd.rank() == 0:
+            state.commit()
+    """)
+
+
+def test_parse_error_is_a_finding():
+    findings = lint_source("def broken(:\n")
+    assert [f.rule for f in findings] == ["parse-error"]
+    assert findings[0].severity == "error"
+
+
+# --- suppressions -----------------------------------------------------------
+
+def test_inline_suppression_same_line():
+    assert rules_of("""
+        import horovod_tpu as hvd
+        if hvd.rank() == 0:
+            hvd.allreduce(x, "t")  # hvd-lint: disable=rank-conditional-collective
+    """) == []
+
+
+def test_inline_suppression_preceding_line():
+    assert rules_of("""
+        import horovod_tpu as hvd
+        if hvd.rank() == 0:
+            # hvd-lint: disable=rank-conditional-collective
+            hvd.allreduce(x, "t")
+    """) == []
+
+
+def test_bare_disable_suppresses_all():
+    assert rules_of("""
+        import horovod_tpu as hvd
+        if hvd.rank() == 0:
+            hvd.allreduce(x, name="g.%d" % hvd.rank())  # hvd-lint: disable
+    """) == []
+
+
+def test_stacked_standalone_suppressions_accumulate():
+    assert rules_of("""
+        import horovod_tpu as hvd
+        if hvd.rank() == 0:
+            # hvd-lint: disable=rank-conditional-collective
+            # hvd-lint: disable=rank-dependent-name
+            hvd.allreduce(x, name="g.%d" % hvd.rank())
+    """) == []
+
+
+def test_suppression_on_multiline_call_closing_line():
+    assert rules_of("""
+        import horovod_tpu as hvd
+        if hvd.rank() == 0:
+            hvd.allreduce(
+                x,
+                "t")  # hvd-lint: disable=rank-conditional-collective
+    """) == []
+
+
+def test_suppression_is_rule_scoped():
+    # Suppressing one rule must not hide another on the same line.
+    found = rules_of("""
+        import horovod_tpu as hvd
+        if hvd.rank() == 0:
+            hvd.allreduce(x, name="g.%d" % hvd.rank())  # hvd-lint: disable=rank-conditional-collective
+    """)
+    assert "rank-dependent-name" in found
+    assert "rank-conditional-collective" not in found
+
+
+# --- CLI exit codes and formats ---------------------------------------------
+
+def _write(tmp_path, name, source):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return str(path)
+
+
+def test_cli_exit_zero_on_clean(tmp_path, capsys):
+    target = _write(tmp_path, "good.py", GOOD_CORPUS["rank-dependent-name"])
+    assert lint_main([target]) == 0
+    assert "clean" in capsys.readouterr().err
+
+
+def test_cli_exit_one_on_findings(tmp_path, capsys):
+    target = _write(tmp_path, "bad.py",
+                    BAD_CORPUS["rank-conditional-collective"])
+    assert lint_main([target]) == 1
+    out = capsys.readouterr().out
+    assert "rank-conditional-collective" in out
+
+
+def test_cli_fail_on_error_ignores_warnings(tmp_path):
+    target = _write(tmp_path, "warn.py",
+                    BAD_CORPUS["missing-initial-broadcast"])
+    assert lint_main([target]) == 1  # default: warnings fail
+    assert lint_main([target, "--fail-on", "error"]) == 0
+
+
+def test_cli_disable_rule(tmp_path):
+    target = _write(tmp_path, "bad.py", BAD_CORPUS["loop-auto-name"])
+    assert lint_main([target, "--disable", "loop-auto-name"]) == 0
+
+
+def test_cli_usage_errors_exit_two(tmp_path):
+    with pytest.raises(SystemExit) as exc:
+        lint_main(["/nonexistent/path.py"])
+    assert exc.value.code == 2
+    target = _write(tmp_path, "x.py", "pass\n")
+    with pytest.raises(SystemExit) as exc:
+        lint_main([target, "--disable", "no-such-rule"])
+    assert exc.value.code == 2
+
+
+def test_cli_json_format(tmp_path, capsys):
+    target = _write(tmp_path, "bad.py", BAD_CORPUS["rank-dependent-name"])
+    assert lint_main([target, "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["files_checked"] == 1
+    assert payload["findings"][0]["rule"] == "rank-dependent-name"
+    assert payload["findings"][0]["path"] == target
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in RULES:
+        assert rule_id in out
+
+
+def test_directory_recursion(tmp_path):
+    sub = tmp_path / "pkg"
+    sub.mkdir()
+    (sub / "bad.py").write_text(textwrap.dedent(
+        BAD_CORPUS["duplicate-collective-name"]))
+    findings, checked = lint_paths([str(tmp_path)])
+    assert checked == 1
+    assert [f.rule for f in findings] == ["duplicate-collective-name"]
+
+
+# --- repo self-lint ---------------------------------------------------------
+
+def test_repo_examples_and_models_are_clean():
+    """The shipped examples and models must lint clean (intentional
+    patterns carry inline suppressions). A new hazard in them fails
+    tier-1 here before it ships."""
+    findings, checked = lint_paths([
+        os.path.join(REPO_ROOT, "examples"),
+        os.path.join(REPO_ROOT, "horovod_tpu", "models"),
+    ])
+    assert checked >= 30
+    assert findings == [], "\n".join(
+        "%s:%d %s %s" % (f.path, f.line, f.rule, f.message)
+        for f in findings)
