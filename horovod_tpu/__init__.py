@@ -163,6 +163,11 @@ def init(ranks=None):
     release_held_ports()
     for cb in _init_callbacks:
         cb()
+    # Metrics endpoint (docs/METRICS.md): serve Prometheus at
+    # HVD_TPU_METRICS_PORT + rank. After the callbacks (rank may have
+    # changed across an elastic re-init; the server follows its slot).
+    from . import _metrics
+    _metrics.on_init()
     if not _initialized_here:
         _atexit.register(shutdown)
         _initialized_here = True
@@ -171,6 +176,27 @@ def init(ranks=None):
 def shutdown():
     """Coordinated shutdown of the core runtime."""
     get_basics().shutdown()
+    from . import _metrics
+    _metrics.stop_server()
+
+
+def metrics():
+    """This worker's live metrics registry (native/metrics.h) as a
+    dict: monotonic counters (cycles, tensors/bytes executed, fusion,
+    cache hit/miss, stall warnings, divergence errors), gauges (queue
+    depth, generation), and fixed-bucket histograms (cycle duration,
+    negotiation latency, tensors/bytes per cycle, fusion fill). See
+    docs/METRICS.md for the catalog."""
+    from . import _metrics
+    return _metrics.metrics()
+
+
+def job_metrics():
+    """Rank 0 only: the job-wide view — every rank's piggybacked
+    summary plus the per-rank announce-lag table (the straggler
+    signal). Empty dict on other ranks."""
+    from . import _metrics
+    return _metrics.job_metrics()
 
 
 def is_initialized():

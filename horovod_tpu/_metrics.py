@@ -1,0 +1,248 @@
+"""Python half of the live metrics plane (docs/METRICS.md).
+
+The native core (``native/metrics.h``) keeps the registry — counters,
+gauges, fixed-bucket histograms, piggybacked per-rank summaries — and
+exposes JSON snapshots through the C API. This module turns those into:
+
+* ``hvd.metrics()`` / ``hvd.job_metrics()`` dicts,
+* Prometheus text rendering (``render_prometheus``),
+* a per-worker HTTP endpoint (``HVD_TPU_METRICS_PORT`` + rank) serving
+  ``/metrics`` (Prometheus) and, on rank 0, ``/job`` (the aggregated
+  job view ``bin/hvd-top`` polls),
+* min/max/mean aggregation across ranks (``aggregate``).
+
+The HTTP server is a plain stdlib thread: ctypes calls into the core
+release the GIL, so the endpoint keeps answering even while the main
+thread is blocked inside a hung collective — which is exactly when a
+live job view matters.
+"""
+
+import json
+import os
+import threading
+
+_PREFIX = "hvdtpu_"
+
+
+def _basics():
+    from .common.basics import get_basics
+    return get_basics()
+
+
+def metrics():
+    """This worker's live metrics registry as a dict:
+    ``{"counters": {...}, "gauges": {...}, "histograms": {name:
+    {"bounds", "counts", "sum", "count"}}, "rank_lag_seconds": [...]}``.
+    Counters are monotonic for the life of the process; callable before
+    init and after shutdown (zeros / last values)."""
+    return json.loads(_basics().metrics_json())
+
+
+def job_metrics():
+    """Rank 0's job-wide view: ``{"size", "generation", "per_rank":
+    {rank: summary}, "age_seconds": {rank: s}, "rank_lag_seconds":
+    [...]}``; ``{}`` on non-coordinator ranks."""
+    return json.loads(_basics().job_metrics_json())
+
+
+def aggregate(per_rank):
+    """min/max/mean (+ argmax rank) per summary field across the
+    ``per_rank`` dict of a job view — straggler identification for
+    free: the rank arg-maxing a latency/lag field is the one the job
+    waits on."""
+    out = {}
+    if not per_rank:
+        return out
+    fields = set()
+    for vals in per_rank.values():
+        fields.update(vals)
+    for f in sorted(fields):
+        rows = [(float(vals.get(f, 0.0)), r)
+                for r, vals in per_rank.items()]
+        values = [v for v, _ in rows]
+        vmax, argmax = max(rows)
+        out[f] = {"min": min(values), "max": vmax,
+                  "mean": sum(values) / len(values),
+                  "argmax_rank": int(argmax)}
+    return out
+
+
+def _fmt(v):
+    """Prometheus float formatting: integers stay integral."""
+    f = float(v)
+    return "%d" % f if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def render_prometheus(snapshot, labels=None):
+    """Renders a ``metrics()`` snapshot as Prometheus text exposition
+    (one ``hvdtpu_``-prefixed family per counter/gauge; histograms as
+    cumulative ``_bucket{le=...}`` + ``_sum``/``_count``). ``labels``
+    is an optional dict rendered into every sample (e.g. rank)."""
+    label_str = ""
+    if labels:
+        label_str = ",".join('%s="%s"' % (k, labels[k])
+                             for k in sorted(labels))
+    lines = []
+
+    def sample(name, value, extra=""):
+        inner = ",".join(x for x in (label_str, extra) if x)
+        label_part = "{%s}" % inner if inner else ""
+        lines.append("%s%s %s" % (_PREFIX + name, label_part, _fmt(value)))
+
+    for name, value in sorted(snapshot.get("counters", {}).items()):
+        lines.append("# TYPE %s%s counter" % (_PREFIX, name))
+        sample(name, value)
+    for name, value in sorted(snapshot.get("gauges", {}).items()):
+        lines.append("# TYPE %s%s gauge" % (_PREFIX, name))
+        sample(name, value)
+    for name, h in sorted(snapshot.get("histograms", {}).items()):
+        lines.append("# TYPE %s%s histogram" % (_PREFIX, name))
+        cumulative = 0
+        for bound, count in zip(h["bounds"], h["counts"]):
+            cumulative += count
+            sample(name + "_bucket", cumulative, 'le="%s"' % _fmt(bound))
+        cumulative += h["counts"][len(h["bounds"])]
+        sample(name + "_bucket", cumulative, 'le="+Inf"')
+        sample(name + "_sum", h["sum"])
+        sample(name + "_count", h["count"])
+    # Coordinator-only per-rank announce lag (straggler table). The rank
+    # label here names the ATTRIBUTED rank, not the serving worker, so
+    # the base labels are deliberately not applied.
+    lag = snapshot.get("rank_lag_seconds") or []
+    if any(lag):
+        lines.append("# TYPE %srank_announce_lag_seconds_total counter"
+                     % _PREFIX)
+        for r, v in enumerate(lag):
+            lines.append('%srank_announce_lag_seconds_total{rank="%d"} %s'
+                         % (_PREFIX, r, _fmt(v)))
+    return "\n".join(lines) + "\n"
+
+
+def render_job_prometheus(job):
+    """Per-rank worker-summary series from a job view, Prometheus text
+    (``hvdtpu_worker_<field>{rank=...}``) — appended to rank 0's
+    ``/metrics`` so one scrape target carries the whole job."""
+    lines = []
+    per_rank = job.get("per_rank") or {}
+    fields = set()
+    for vals in per_rank.values():
+        fields.update(vals)
+    for f in sorted(fields):
+        lines.append("# TYPE %sworker_%s gauge" % (_PREFIX, f))
+        for r in sorted(per_rank, key=int):
+            lines.append('%sworker_%s{rank="%s"} %s' % (
+                _PREFIX, f, r, _fmt(per_rank[r].get(f, 0.0))))
+    return ("\n".join(lines) + "\n") if lines else ""
+
+
+def _make_handler():
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            path = self.path.split("?")[0].rstrip("/") or "/"
+            try:
+                if path in ("/", "/metrics"):
+                    snap = metrics()
+                    rank = int(snap.get("gauges", {}).get("rank", -1))
+                    body = render_prometheus(
+                        snap, labels={"rank": rank} if rank >= 0 else None)
+                    job = job_metrics()
+                    if job:
+                        body += render_job_prometheus(job)
+                    self._reply(200, body,
+                                "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/json":
+                    self._reply(200, json.dumps(metrics()),
+                                "application/json")
+                elif path == "/job":
+                    job = job_metrics()
+                    if job:
+                        job["aggregate"] = aggregate(job.get("per_rank", {}))
+                    self._reply(200, json.dumps(job), "application/json")
+                else:
+                    self._reply(404, "not found\n", "text/plain")
+            except Exception as e:  # scrape must never kill the worker
+                self._reply(500, "error: %s\n" % e, "text/plain")
+
+        def _reply(self, code, body, ctype):
+            data = body.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, fmt, *args):
+            pass  # scrapes must not spam worker stderr
+
+    return Handler
+
+
+_server = None
+_server_port = None
+_server_lock = threading.Lock()
+
+
+def start_server(port):
+    """Starts (or moves) the metrics HTTP endpoint on `port`."""
+    global _server, _server_port
+    from http.server import ThreadingHTTPServer
+
+    with _server_lock:
+        if _server is not None and _server_port == port:
+            return _server_port
+        _stop_locked()
+        httpd = ThreadingHTTPServer(("0.0.0.0", port), _make_handler())
+        httpd.daemon_threads = True
+        thread = threading.Thread(target=httpd.serve_forever,
+                                  name="hvd-metrics-http", daemon=True)
+        thread.start()
+        _server, _server_port = httpd, port
+        return port
+
+
+def _stop_locked():
+    global _server, _server_port
+    if _server is not None:
+        _server.shutdown()
+        _server.server_close()
+        _server = None
+        _server_port = None
+
+
+def stop_server():
+    with _server_lock:
+        _stop_locked()
+
+
+def server_port():
+    return _server_port
+
+
+def on_init():
+    """Called after every successful hvd.init() (including elastic
+    re-inits, where this worker's rank — and therefore its port slot —
+    may have changed). Serves at HVD_TPU_METRICS_PORT + rank; no env,
+    no server."""
+    base = os.environ.get("HVD_TPU_METRICS_PORT")
+    if not base:
+        return
+    try:
+        base_port = int(base)
+    except ValueError:
+        return
+    if base_port <= 0:
+        return
+    from . import rank
+    try:
+        start_server(base_port + rank())
+    except OSError as e:
+        # An observability endpoint must never kill the training job: a
+        # stale worker or unrelated process squatting on the port slot
+        # costs the scrape, not the run.
+        import sys
+        sys.stderr.write(
+            "[hvd-metrics] could not bind metrics port %d (%s); "
+            "continuing WITHOUT the HTTP endpoint\n"
+            % (base_port + rank(), e))

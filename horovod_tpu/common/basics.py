@@ -146,6 +146,10 @@ class HorovodBasics:
         lib.horovod_tpu_call_digest.restype = None
         lib.horovod_tpu_call_digest.argtypes = [
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+        lib.horovod_tpu_metrics_json.restype = ctypes.c_char_p
+        lib.horovod_tpu_metrics_json.argtypes = []
+        lib.horovod_tpu_job_metrics_json.restype = ctypes.c_char_p
+        lib.horovod_tpu_job_metrics_json.argtypes = []
         lib.horovod_tpu_autotune_params.restype = None
         lib.horovod_tpu_autotune_params.argtypes = [
             ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
@@ -217,6 +221,20 @@ class HorovodBasics:
         self.lib.horovod_tpu_call_digest(ctypes.byref(seq),
                                          ctypes.byref(digest))
         return seq.value, digest.value
+
+    def metrics_json(self):
+        """This worker's live metrics registry snapshot (counters /
+        gauges / histograms / rank-lag tables) as a JSON string —
+        native/metrics.h, rendered by horovod_tpu._metrics. Callable
+        any time from any thread (the registry is process-global
+        atomics)."""
+        return self.lib.horovod_tpu_metrics_json().decode("utf-8")
+
+    def job_metrics_json(self):
+        """Rank 0's job-wide view as JSON: every rank's piggybacked
+        summary, summary staleness, and the per-rank announce-lag
+        table (straggler signal). "{}" on non-coordinator ranks."""
+        return self.lib.horovod_tpu_job_metrics_json().decode("utf-8")
 
     def autotune_params(self):
         """Current synchronized knob values (autotune introspection):

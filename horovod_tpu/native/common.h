@@ -34,6 +34,14 @@ namespace hvdtpu {
 #define HVD_TPU_DIVERGENCE_GRACE "HVD_TPU_DIVERGENCE_GRACE_SECONDS"
 #define HVD_TPU_HIERARCHICAL_ALLREDUCE "HVD_TPU_HIERARCHICAL_ALLREDUCE"
 #define HVD_TPU_HIERARCHICAL_ALLGATHER "HVD_TPU_HIERARCHICAL_ALLGATHER"
+// Metrics plane (metrics.h / docs/METRICS.md): HVD_TPU_METRICS=1 turns on
+// the wire piggyback + coordinator job view without HTTP serving;
+// HVD_TPU_METRICS_PORT additionally makes Python serve Prometheus text at
+// port+rank. SYNC bounds how often per-rank summaries ride the wire.
+#define HVD_TPU_METRICS "HVD_TPU_METRICS"
+#define HVD_TPU_METRICS_PORT "HVD_TPU_METRICS_PORT"
+#define HVD_TPU_METRICS_SYNC "HVD_TPU_METRICS_SYNC_SECONDS"
+#define HVD_TPU_GENERATION_ENV "HVD_TPU_GENERATION"
 
 enum class StatusType : int32_t {
   OK = 0,

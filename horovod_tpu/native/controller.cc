@@ -7,6 +7,7 @@
 #include <unordered_set>
 
 #include "logging.h"
+#include "metrics.h"
 #include "parameter_manager.h"
 
 namespace hvdtpu {
@@ -48,9 +49,23 @@ void Controller::SynchronizeParameters() {
 bool Controller::IncrementTensorCount(const Request& msg, int rank) {
   const std::string& name = msg.tensor_name();
   auto it = message_table_.find(name);
+  auto now = std::chrono::steady_clock::now();
   if (it == message_table_.end()) {
     timeline_.NegotiateStart(name, msg.request_type());
     it = message_table_.emplace(name, std::vector<Request>()).first;
+    negotiate_started_[name] = now;
+    if (metrics_plane_enabled_) GlobalMetrics().AddRankLag(rank, 0.0);
+  } else if (metrics_plane_enabled_) {
+    // Announce lag: how long this rank kept the tensor waiting after its
+    // first announcement. Per-rank accumulation is the straggler signal
+    // the job view surfaces (the slow rank's total dominates). Gated on
+    // the plane: AddRankLag takes the registry's rank mutex (shared with
+    // snapshot builds), which metrics-off jobs must never touch.
+    auto started = negotiate_started_.find(name);
+    if (started != negotiate_started_.end()) {
+      GlobalMetrics().AddRankLag(
+          rank, std::chrono::duration<double>(now - started->second).count());
+    }
   }
   timeline_.NegotiateRankReady(name, rank);
   stall_inspector_.RecordUncachedTensorStart(name, rank, size_);
@@ -65,6 +80,14 @@ Response Controller::ConstructResponse(const std::string& name) {
   message_table_.erase(it);
   stall_inspector_.RemoveUncachedTensor(name);
   timeline_.NegotiateEnd(name);
+  auto started = negotiate_started_.find(name);
+  if (started != negotiate_started_.end()) {
+    GlobalMetrics().negotiation_seconds.Observe(
+        std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                      started->second)
+            .count());
+    negotiate_started_.erase(started);
+  }
 
   const Request& first = requests[0];
   std::ostringstream error;
@@ -257,6 +280,9 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
       if (list.shutdown()) should_shut_down = true;
       divergence_.Observe(r, list.call_seq(), list.call_digest(),
                           list.recent_calls());
+      if (!list.metrics_summary().empty()) {
+        GlobalMetrics().SetRankSummary(r, list.metrics_summary());
+      }
       for (const auto& msg : list.requests()) {
         if (IncrementTensorCount(msg, r)) {
           ready_names.push_back(msg.tensor_name());
@@ -271,9 +297,12 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
     // timeout (divergence.h documents the two proof rules).
     for (const auto& diag : divergence_.Check(message_table_)) {
       LOG(ERROR) << diag.message;
+      GlobalMetrics().divergence_errors_total.fetch_add(
+          1, std::memory_order_relaxed);
       message_table_.erase(diag.tensor_name);
       stall_inspector_.RemoveUncachedTensor(diag.tensor_name);
       timeline_.NegotiateEnd(diag.tensor_name);
+      negotiate_started_.erase(diag.tensor_name);
       Response error;
       error.add_tensor_name(diag.tensor_name);
       error.set_response_type(Response::ERROR);
@@ -296,6 +325,14 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
                                       cycle_call_seq_));
       reported_call_seq_ = cycle_call_seq_;
     }
+    if (metrics_plane_enabled_) {
+      auto now = std::chrono::steady_clock::now();
+      if (std::chrono::duration<double>(now - last_summary_attach_).count() >=
+          metrics_sync_seconds_) {
+        message_list.set_metrics_summary(GlobalMetrics().Summary());
+        last_summary_attach_ = now;
+      }
+    }
     for (auto& msg : non_cached_messages) {
       message_list.add_request(msg);
     }
@@ -313,7 +350,10 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
   // executed a real negotiation for the ranks that had work).
   if (had_local_work || !response_list.responses().empty()) {
     cycles_full_ += 1;
+    GlobalMetrics().cycles_full_total.fetch_add(1, std::memory_order_relaxed);
   }
+  GlobalMetrics().pending_negotiation.store(
+      static_cast<int64_t>(message_table_.size()), std::memory_order_relaxed);
   return response_list;
 }
 
@@ -329,6 +369,9 @@ ResponseList Controller::ComputeResponseList(
 
   std::deque<Request> message_queue_tmp;
   tensor_queue_.PopMessagesFromQueue(message_queue_tmp);
+  Metrics& metrics = GlobalMetrics();
+  metrics.queue_depth.store(static_cast<int64_t>(message_queue_tmp.size()),
+                            std::memory_order_relaxed);
 
   std::vector<Request> non_cached_messages;
   // bit -> locally-hit message, pending global agreement.
@@ -342,6 +385,7 @@ ResponseList Controller::ComputeResponseList(
       if (state == ResponseCache::CacheState::HIT) {
         uint32_t bit = response_cache_.peek_cache_bit(message);
         cache_coordinator.record_hit(bit);
+        metrics.cache_hit_total.fetch_add(1, std::memory_order_relaxed);
         stall_inspector_.RecordCachedTensorStart(message.tensor_name());
         hit_messages.emplace(bit, std::move(message));
         continue;
@@ -349,6 +393,9 @@ ResponseList Controller::ComputeResponseList(
       if (state == ResponseCache::CacheState::INVALID) {
         uint32_t bit = response_cache_.peek_cache_bit(message);
         cache_coordinator.record_invalid_bit(bit);
+        metrics.cache_invalid_total.fetch_add(1, std::memory_order_relaxed);
+      } else {
+        metrics.cache_miss_total.fetch_add(1, std::memory_order_relaxed);
       }
     }
     cache_coordinator.set_uncached_in_queue(true);
@@ -383,6 +430,20 @@ ResponseList Controller::ComputeResponseList(
   if (is_coordinator() &&
       divergence_.ShouldForceFullCycle(message_table_)) {
     cache_coordinator.set_uncached_in_queue(true);
+  }
+  // Metrics freshness: all-cached steady state (and total quiescence)
+  // never sends RequestLists, so piggybacked summaries would freeze at
+  // their last full cycle — precisely when a live job view matters. The
+  // coordinator forces one full round trip per sync interval; the
+  // OR-synced uncached flag brings every rank along, and workers attach
+  // their summaries to the otherwise-empty lists.
+  if (is_coordinator() && metrics_plane_enabled_ && size_ > 1) {
+    auto now = std::chrono::steady_clock::now();
+    if (std::chrono::duration<double>(now - last_metrics_force_).count() >=
+        metrics_sync_seconds_) {
+      cache_coordinator.set_uncached_in_queue(true);
+      last_metrics_force_ = now;
+    }
   }
 
   cache_coordinator.set_should_shut_down(this_process_requested_shutdown);
@@ -439,7 +500,10 @@ ResponseList Controller::ComputeResponseList(
   if (cache_on && all_cached) {
     // Fast path: everything queued this cycle was globally cached; no
     // coordinator round trip. Every rank builds the identical list locally.
-    if (!cached_responses.empty()) cycles_fast_ += 1;
+    if (!cached_responses.empty()) {
+      cycles_fast_ += 1;
+      metrics.cycles_fast_total.fetch_add(1, std::memory_order_relaxed);
+    }
     ResponseList response_list;
     response_list.set_shutdown(should_shut_down);
     FuseResponses(cached_responses, response_list);

@@ -21,6 +21,7 @@
 #define HVD_TPU_CONTROLLER_H
 
 #include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <deque>
 #include <memory>
@@ -95,6 +96,17 @@ class Controller {
     divergence_.Configure(size_, progress_calls, grace_seconds);
   }
 
+  // --- metrics plane (metrics.h) ---
+  // When enabled, workers attach their compact counter summary to the
+  // RequestList at most once per `sync_seconds`, and the coordinator
+  // forces a full negotiation cycle on the same cadence so summaries
+  // keep flowing through all-cached steady state and total quiescence
+  // (the exact windows where live metrics matter most).
+  void ConfigureMetrics(bool enabled, double sync_seconds) {
+    metrics_plane_enabled_ = enabled;
+    metrics_sync_seconds_ = sync_seconds;
+  }
+
   // --- negotiation-cycle accounting (fast path vs full round trip) ---
   // fast  = all-cached cycles that produced work from the bit-vector
   //         sync alone (no coordinator round trip);
@@ -153,6 +165,16 @@ class Controller {
   StallInspector stall_inspector_;
   CallTracker* call_tracker_ = nullptr;
   DivergenceDetector divergence_;
+
+  // Metrics plane: summary-attach / forced-sync pacing and the
+  // coordinator's per-tensor first-announce clock (negotiation latency
+  // histogram + per-rank announce lag — the straggler signal).
+  bool metrics_plane_enabled_ = false;
+  double metrics_sync_seconds_ = 1.0;
+  std::chrono::steady_clock::time_point last_summary_attach_{};
+  std::chrono::steady_clock::time_point last_metrics_force_{};
+  std::unordered_map<std::string, std::chrono::steady_clock::time_point>
+      negotiate_started_;
   // Highest tracker seq already shipped (worker) / self-observed
   // (coordinator); records above it ride the next RequestList.
   uint64_t reported_call_seq_ = 0;

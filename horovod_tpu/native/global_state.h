@@ -11,6 +11,7 @@
 
 #include "divergence.h"
 #include "fusion_buffer_manager.h"
+#include "metrics.h"
 #include "parameter_manager.h"
 #include "response_cache.h"
 #include "tcp_context.h"
@@ -50,6 +51,10 @@ struct HorovodGlobalState {
   // DivergenceDetector and exposed to Python via horovod_tpu_call_digest.
   CallTracker call_tracker;
   FusionBufferManager fusion_buffer;
+  // Live metrics registry (metrics.h). A reference to the process
+  // singleton: leaf components without a state pointer (stall inspector,
+  // the C snapshot API) reach the same registry via GlobalMetrics().
+  Metrics& metrics = GlobalMetrics();
   std::unique_ptr<Controller> controller;
   std::unique_ptr<OperationManager> op_manager;
 

@@ -181,6 +181,8 @@ void RequestList::SerializeTo(std::string* out) const {
     PutU8(out, rec.ndim);
     PutStr(out, rec.name);
   }
+  PutU32(out, static_cast<uint32_t>(metrics_summary_.size()));
+  for (double v : metrics_summary_) PutF64(out, v);
 }
 
 bool RequestList::ParseFrom(const char* data, std::size_t len) {
@@ -215,6 +217,17 @@ bool RequestList::ParseFrom(const char* data, std::size_t len) {
       return false;
     rec.seq = static_cast<uint64_t>(rseq);
     recent_calls_.push_back(std::move(rec));
+  }
+  // Metrics summary tail: absent on a short (older-writer) blob — treat
+  // as "no summary attached", not a parse error.
+  metrics_summary_.clear();
+  uint32_t nsum;
+  if (tail.GetU32(&nsum)) {
+    for (uint32_t i = 0; i < nsum; ++i) {
+      double v;
+      if (!tail.GetF64(&v)) return false;
+      metrics_summary_.push_back(v);
+    }
   }
   return true;
 }

@@ -118,6 +118,17 @@ class RequestList {
     recent_calls_ = std::move(v);
   }
 
+  // Metrics-plane piggyback (metrics.h, SummaryField order): the sending
+  // rank's compact counter summary. Empty when the metrics plane is off
+  // or the attach interval hasn't elapsed — the wire carries one extra
+  // u32 (count 0) then, nothing more.
+  const std::vector<double>& metrics_summary() const {
+    return metrics_summary_;
+  }
+  void set_metrics_summary(std::vector<double> v) {
+    metrics_summary_ = std::move(v);
+  }
+
   void SerializeTo(std::string* out) const;
   bool ParseFrom(const char* data, std::size_t len);
 
@@ -127,6 +138,7 @@ class RequestList {
   uint64_t call_seq_ = 0;
   uint64_t call_digest_ = 0;
   std::vector<CallRecord> recent_calls_;
+  std::vector<double> metrics_summary_;
 };
 
 // A Response is the coordinator's verdict: do this (possibly fused) op now,

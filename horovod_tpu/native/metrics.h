@@ -1,0 +1,180 @@
+// Live metrics plane: a lock-light registry of counters, gauges, and
+// fixed-bucket histograms populated from the coordination hot paths
+// (SURVEY 5.5 names the gap: "No metrics-server/Prometheus-style
+// subsystem" in the reference — its only observability is the post-hoc
+// timeline file and log-only stall warnings).
+//
+// Concurrency model: hot-path writes are single atomic RMWs with relaxed
+// ordering (the background coordination thread and enqueue threads never
+// take a lock here); snapshot readers (the C API / the Python scraper
+// thread) read the same atomics. The only mutex guards the COLD per-rank
+// state on the coordinator: worker summaries ingested once per piggyback
+// (~1/s) and the per-rank announce-lag accumulators (once per tensor
+// completion). `make check-tsan` runs the negotiation fuzz with an active
+// scraper thread to prove the discipline.
+//
+// Counters are MONOTONIC for the life of the process (Prometheus
+// convention) — unlike the per-generation protocol counters
+// (tcp_context.h), they deliberately survive elastic re-init so a scrape
+// never sees a counter go backwards. Gauges and rank-scoped state reset
+// with each generation (Configure()).
+#ifndef HVD_TPU_METRICS_H
+#define HVD_TPU_METRICS_H
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+// Fixed upper-bound-bucket histogram (atomics only; +Inf bucket implicit
+// as counts[bounds.size()]). `scale` converts the observed double into
+// the integer units the sum accumulates in (1e6 for seconds -> the sum
+// stays exact to the microsecond without atomic<double>).
+class MetricHistogram {
+ public:
+  MetricHistogram(std::vector<double> bounds, double scale);
+
+  void Observe(double v);
+
+  struct Snapshot {
+    std::vector<double> bounds;
+    std::vector<uint64_t> counts;  // bounds.size() + 1 (last = overflow)
+    double sum = 0.0;
+    uint64_t count = 0;
+  };
+  Snapshot snapshot() const;
+  double sum() const;
+  uint64_t count() const { return count_.load(std::memory_order_relaxed); }
+
+ private:
+  std::vector<double> bounds_;
+  double scale_;
+  std::unique_ptr<std::atomic<uint64_t>[]> counts_;
+  std::atomic<int64_t> sum_scaled_{0};
+  std::atomic<uint64_t> count_{0};
+};
+
+// Compact per-rank summary piggybacked on the RequestList wire (the same
+// channel PR 2 used for call digests). Wire order == enum order; the
+// count prefix makes additions forward-compatible (an older decoder
+// ignores the tail, a newer one zero-fills).
+enum SummaryField : int {
+  SUM_CYCLES_TOTAL = 0,
+  SUM_CYCLES_FAST,
+  SUM_CYCLES_FULL,
+  SUM_CYCLE_SECONDS_SUM,
+  SUM_TENSORS_ENQUEUED,
+  SUM_TENSORS_PERFORMED,
+  SUM_RESPONSES_PERFORMED,
+  SUM_BYTES_PERFORMED,
+  SUM_FUSED_TENSORS,
+  SUM_FUSED_BYTES,
+  SUM_CACHE_HIT,
+  SUM_CACHE_MISS,
+  SUM_QUEUE_DEPTH,
+  SUM_STALL_WARNINGS,
+  SUM_DIVERGENCE_ERRORS,
+  SUM_NEGOTIATION_SECONDS_SUM,
+  SUM_NEGOTIATION_COUNT,
+  SUM_FIELD_COUNT
+};
+const char* SummaryFieldName(int field);
+
+class Metrics {
+ public:
+  Metrics();
+
+  // --- hot-path counters (background thread + enqueue threads) ---
+  std::atomic<uint64_t> cycles_total{0};
+  std::atomic<uint64_t> cycles_fast_total{0};
+  std::atomic<uint64_t> cycles_full_total{0};
+  std::atomic<uint64_t> tensors_enqueued_total{0};
+  std::atomic<uint64_t> responses_performed_total{0};
+  std::atomic<uint64_t> tensors_performed_total{0};
+  std::atomic<uint64_t> bytes_performed_total{0};
+  std::atomic<uint64_t> fused_tensors_total{0};
+  std::atomic<uint64_t> fused_bytes_total{0};
+  std::atomic<uint64_t> cache_hit_total{0};
+  std::atomic<uint64_t> cache_miss_total{0};
+  std::atomic<uint64_t> cache_invalid_total{0};
+  std::atomic<uint64_t> stall_warnings_total{0};
+  std::atomic<uint64_t> stall_missing_rank_micros_total{0};
+  std::atomic<uint64_t> divergence_errors_total{0};
+  std::atomic<uint64_t> error_responses_total{0};
+  std::atomic<uint64_t> init_total{0};
+
+  // --- gauges (instantaneous; reset per generation) ---
+  std::atomic<int64_t> queue_depth{0};
+  std::atomic<int64_t> pending_negotiation{0};
+  std::atomic<int64_t> elastic_generation{0};
+  std::atomic<int64_t> world_size{0};
+  std::atomic<int64_t> rank{-1};
+  std::atomic<int64_t> fusion_threshold_bytes{0};
+
+  // --- histograms ---
+  MetricHistogram cycle_seconds;        // background work-cycle duration
+  MetricHistogram negotiation_seconds;  // coordinator: first announce -> response
+  MetricHistogram cycle_tensors;        // tensors executed per work cycle
+  MetricHistogram cycle_bytes;          // payload bytes executed per work cycle
+  MetricHistogram fusion_fill_ratio;    // fused payload / fusion threshold
+
+  // Whether the metrics PLANE (wire piggyback, forced sync cycles, HTTP
+  // serving) is live — HVD_TPU_METRICS=1 or HVD_TPU_METRICS_PORT set.
+  // The registry itself always counts (single relaxed atomics, the same
+  // cost class as the pre-existing perf counters).
+  void set_enabled(bool v) { enabled_.store(v, std::memory_order_relaxed); }
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+
+  // Generation (re)start: sizes the per-rank state, resets gauges and
+  // rank-scoped accumulators. Counters deliberately persist.
+  void Configure(int world_size, int rank);
+
+  // Coordinator: rank announced a pending tensor `seconds` after its
+  // first announcement (0 for the first announcer). The accumulated
+  // per-rank lag is the straggler signal: the rank the job spends the
+  // most time waiting on has the largest total. Takes the rank mutex —
+  // callers gate on the metrics plane being enabled so metrics-off jobs
+  // never touch it from the negotiation path.
+  void AddRankLag(int rank, double seconds);
+
+  // This rank's compact summary (SummaryField order).
+  std::vector<double> Summary() const;
+  // Coordinator: ingest a worker's piggybacked summary.
+  void SetRankSummary(int rank, const std::vector<double>& values);
+
+  // Full registry snapshot of THIS worker, as JSON (consumed by
+  // hvd.metrics() and the Prometheus renderer in Python).
+  std::string SnapshotJson() const;
+  // Coordinator job view: per-rank summaries (+ own, fresh), summary
+  // staleness, and the per-rank announce-lag table. "{}" off-coordinator.
+  std::string JobJson() const;
+
+ private:
+  using Clock = std::chrono::steady_clock;
+
+  std::atomic<bool> enabled_{false};
+
+  mutable std::mutex rank_mutex_;
+  // Announce-lag accumulators, indexed by rank (coordinator only).
+  std::vector<double> rank_lag_seconds_;
+  std::vector<uint64_t> rank_lag_count_;
+  // Latest ingested summary per rank + receive time (coordinator only).
+  std::vector<std::vector<double>> rank_summaries_;
+  std::vector<Clock::time_point> rank_summary_time_;
+  bool is_coordinator_ = false;
+};
+
+// Process-wide registry. A singleton (not a HorovodGlobalState member
+// value) so leaf components without a state pointer — the stall
+// inspector, the C snapshot API — reach it directly; global_state.h
+// holds a reference for everything that does carry state.
+Metrics& GlobalMetrics();
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_METRICS_H

@@ -18,6 +18,7 @@
 #include <memory>
 #include <mutex>
 #include <thread>
+#include <utility>
 
 #include "bayesian_optimization.h"
 #include "collective_operations.h"
@@ -148,19 +149,45 @@ bool EnvBool(const char* name, bool dflt, bool* present = nullptr) {
 
 // ---------------- background loop ----------------
 
-void PerformOperation(HorovodGlobalState& state, const Response& response) {
+// Returns (tensors, payload bytes) executed so RunLoopOnce can feed the
+// per-cycle histograms.
+std::pair<int64_t, int64_t> PerformOperation(HorovodGlobalState& state,
+                                             const Response& response) {
   // Cache the negotiated response while entries are still in the table.
   if (response.response_type() != Response::ERROR) {
     state.response_cache.put(response, state.tensor_queue);
   }
   std::vector<TensorTableEntry> entries;
   state.tensor_queue.GetTensorEntriesFromResponse(response, entries);
-  if (entries.empty()) return;
+  if (entries.empty()) return {0, 0};
   // Fusion diagnostics: responses vs tensors executed (a fused response
   // carries several tensors; with fusion off the counts are equal).
   state.responses_performed.fetch_add(1);
   state.tensors_performed.fetch_add(
       static_cast<int64_t>(entries.size()));
+  int64_t bytes = 0;
+  for (const auto& e : entries) bytes += static_cast<int64_t>(e.SizeBytes());
+  Metrics& metrics = state.metrics;
+  metrics.responses_performed_total.fetch_add(1, std::memory_order_relaxed);
+  metrics.tensors_performed_total.fetch_add(entries.size(),
+                                            std::memory_order_relaxed);
+  metrics.bytes_performed_total.fetch_add(static_cast<uint64_t>(bytes),
+                                          std::memory_order_relaxed);
+  if (response.response_type() == Response::ERROR) {
+    metrics.error_responses_total.fetch_add(1, std::memory_order_relaxed);
+  }
+  if (entries.size() > 1) {
+    metrics.fused_tensors_total.fetch_add(entries.size(),
+                                          std::memory_order_relaxed);
+    metrics.fused_bytes_total.fetch_add(static_cast<uint64_t>(bytes),
+                                        std::memory_order_relaxed);
+    int64_t threshold = state.controller->TensorFusionThresholdBytes();
+    if (threshold > 0) {
+      double fill = static_cast<double>(bytes) /
+                    static_cast<double>(threshold);
+      metrics.fusion_fill_ratio.Observe(fill > 1.0 ? 1.0 : fill);
+    }
+  }
   for (const auto& e : entries) {
     state.timeline.Start(e.tensor_name, response.response_type());
   }
@@ -174,6 +201,7 @@ void PerformOperation(HorovodGlobalState& state, const Response& response) {
     state.timeline.End(e.tensor_name, status.ok());
     if (e.callback) e.callback(status, e);
   }
+  return {static_cast<int64_t>(entries.size()), bytes};
 }
 
 int64_t ResponseListByteTotal(HorovodGlobalState& state,
@@ -210,9 +238,26 @@ bool RunLoopOnce(HorovodGlobalState& state,
   ResponseList response_list =
       state.controller->ComputeResponseList(state.shut_down.load());
 
+  int64_t cycle_tensors = 0;
+  int64_t cycle_bytes = 0;
   for (const auto& response : response_list.responses()) {
-    PerformOperation(state, response);
+    auto executed = PerformOperation(state, response);
+    cycle_tensors += executed.first;
+    cycle_bytes += executed.second;
   }
+  Metrics& metrics = state.metrics;
+  metrics.cycles_total.fetch_add(1, std::memory_order_relaxed);
+  metrics.cycle_seconds.Observe(
+      std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                    last_cycle_start)
+          .count());
+  if (cycle_tensors > 0) {
+    metrics.cycle_tensors.Observe(static_cast<double>(cycle_tensors));
+    metrics.cycle_bytes.Observe(static_cast<double>(cycle_bytes));
+  }
+  metrics.fusion_threshold_bytes.store(
+      state.controller->TensorFusionThresholdBytes(),
+      std::memory_order_relaxed);
 
   if (was_tuning) {
     if (state.controller->is_coordinator()) {
@@ -280,6 +325,21 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
       static_cast<int>(EnvInt64(HVD_TPU_STALL_CHECK_TIME, 60)));
   state.controller->stall_inspector().SetStallShutdownTimeSeconds(
       static_cast<int>(EnvInt64(HVD_TPU_STALL_SHUTDOWN_TIME, 0)));
+
+  // Metrics plane (metrics.h / docs/METRICS.md): the registry always
+  // counts; the PLANE (wire summaries + forced sync cycles + the Python
+  // HTTP endpoint keying off the same env) engages when explicitly
+  // enabled, so metrics-off jobs see zero wire or cycle-shape change.
+  bool metrics_plane = EnvBool(HVD_TPU_METRICS, false) ||
+                       std::getenv(HVD_TPU_METRICS_PORT) != nullptr;
+  state.metrics.Configure(state.controller->size(),
+                          state.controller->rank());
+  state.metrics.set_enabled(metrics_plane);
+  state.metrics.elastic_generation.store(
+      EnvInt64(HVD_TPU_GENERATION_ENV, 0), std::memory_order_relaxed);
+  state.metrics.init_total.fetch_add(1, std::memory_order_relaxed);
+  state.controller->ConfigureMetrics(
+      metrics_plane, EnvDouble(HVD_TPU_METRICS_SYNC, 1.0));
 
   // Divergence cross-check (divergence.h): progress rule fires after a
   // missing rank advances this many calls past a pending tensor (0 = off);
@@ -435,6 +495,8 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
   if (status.ok()) {
     g_state.call_tracker.Record(static_cast<uint8_t>(type),
                                 static_cast<uint8_t>(dtype), ndim, name);
+    g_state.metrics.tensors_enqueued_total.fetch_add(
+        1, std::memory_order_relaxed);
   }
   return status;
 }
@@ -533,6 +595,25 @@ void horovod_tpu_protocol_counters(uint64_t* out) {
   out[2] = g_state.tcp_context.ctrl_msgs();
   out[3] = g_state.controller ? g_state.controller->cycles_fast() : 0;
   out[4] = g_state.controller ? g_state.controller->cycles_full() : 0;
+}
+
+// Live metrics snapshots (metrics.h / docs/METRICS.md). Callable from
+// any thread at any time — before init, mid-run, after shutdown; the
+// registry is a process singleton of atomics. thread_local storage so
+// concurrent scrapers never share a buffer.
+const char* horovod_tpu_metrics_json() {
+  static thread_local std::string out;
+  out = GlobalMetrics().SnapshotJson();
+  return out.c_str();
+}
+
+// Rank 0's job-wide view: every rank's piggybacked summary + the
+// per-rank announce-lag table (straggler identification). "{}" on
+// non-coordinator ranks.
+const char* horovod_tpu_job_metrics_json() {
+  static thread_local std::string out;
+  out = GlobalMetrics().JobJson();
+  return out.c_str();
 }
 
 // This rank's collective call-sequence fingerprint: seq = number of

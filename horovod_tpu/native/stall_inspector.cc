@@ -1,8 +1,11 @@
 #include "stall_inspector.h"
 
+#include <algorithm>
+#include <map>
 #include <sstream>
 
 #include "logging.h"
+#include "metrics.h"
 #include "response_cache.h"
 
 namespace hvdtpu {
@@ -35,29 +38,93 @@ void StallInspector::RemoveCachedTensor(const std::string& tensor_name) {
 bool StallInspector::CheckForStalledTensors(int global_size) {
   bool should_shut_down = false;
   auto now = Clock::now();
-  std::ostringstream warn;
-  bool any = false;
+  // Group stalled tensors by their missing-rank set: the warning surface
+  // is one line per SET per check (a 10k-tensor gradient bucket stalled
+  // on one dead rank is one line, not 10k), and an unchanged set across
+  // consecutive checks collapses to a short "still waiting" repeat line.
+  // Every (tensor, check) stall event — printed or suppressed — counts
+  // into the stall_warnings_total metric.
+  struct Group {
+    std::vector<std::string> names;
+    long max_age = 0;
+    int missing_count = 0;
+  };
+  std::map<std::string, Group> groups;  // key: "1, 3" missing-rank list
   for (const auto& kv : uncached_) {
     auto age = std::chrono::duration_cast<std::chrono::seconds>(
                    now - kv.second.first)
                    .count();
     if (age < warning_seconds_) continue;
-    any = true;
     std::ostringstream missing;
     bool first = true;
+    int missing_count = 0;
     for (int r = 0; r < global_size; ++r) {
       if (kv.second.second.count(r) == 0) {
         if (!first) missing << ", ";
         missing << r;
         first = false;
+        ++missing_count;
       }
     }
-    warn << "\n" << kv.first << " [missing ranks: " << missing.str() << "]";
+    Group& g = groups[missing.str()];
+    g.names.push_back(kv.first);
+    g.max_age = std::max<long>(g.max_age, age);
+    g.missing_count = missing_count;
     if (shutdown_seconds_ > 0 && age >= shutdown_seconds_) {
       should_shut_down = true;
     }
   }
-  if (any) {
+
+  double since_last_check =
+      std::chrono::duration<double>(now - last_check_).count();
+  Metrics& metrics = GlobalMetrics();
+  bool any_new = false;
+  std::ostringstream warn;
+  for (const auto& kv : groups) {
+    const Group& g = kv.second;
+    metrics.stall_warnings_total.fetch_add(g.names.size(),
+                                           std::memory_order_relaxed);
+    // Missing-rank seconds: each stalled tensor spent ~the check window
+    // waiting on `missing_count` ranks since the last inspection.
+    metrics.stall_missing_rank_micros_total.fetch_add(
+        static_cast<uint64_t>(since_last_check * 1e6) * g.names.size() *
+            g.missing_count,
+        std::memory_order_relaxed);
+    auto warned = warned_sets_.find(kv.first);
+    if (warned != warned_sets_.end()) {
+      // Same missing-rank set as a previous check: one compact repeat
+      // line instead of re-listing every tensor.
+      warned->second += 1;
+      LOG(WARNING) << "Stall persists: " << g.names.size()
+                   << " tensor(s) [missing ranks: " << kv.first
+                   << "] still waiting after " << g.max_age
+                   << "s (repeat #" << warned->second
+                   << "; per-tensor details suppressed)";
+      continue;
+    }
+    warned_sets_[kv.first] = 1;
+    any_new = true;
+    std::size_t shown = std::min<std::size_t>(g.names.size(), 5);
+    warn << "\n" << g.names.size() << " tensor(s) [missing ranks: "
+         << kv.first << "] waiting up to " << g.max_age << "s: ";
+    for (std::size_t i = 0; i < shown; ++i) {
+      if (i) warn << ", ";
+      warn << g.names[i];
+    }
+    if (shown < g.names.size()) {
+      warn << " (+" << g.names.size() - shown << " more)";
+    }
+  }
+  // Sets that resolved (or changed membership) re-warn in full next time.
+  for (auto it = warned_sets_.begin(); it != warned_sets_.end();) {
+    if (groups.find(it->first) == groups.end()) {
+      it = warned_sets_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+
+  if (any_new) {
     LOG(WARNING)
         << "One or more tensors were submitted to be reduced, gathered or "
            "broadcasted by subset of ranks and are waiting for remainder of "
@@ -66,10 +133,10 @@ bool StallInspector::CheckForStalledTensors(int global_size) {
            "tensors or that only subset of ranks is submitting tensors, which "
            "will cause deadlock."
         << warn.str();
-    if (should_shut_down) {
-      LOG(ERROR) << "Stall threshold exceeded; initiating coordinated "
-                    "shutdown.";
-    }
+  }
+  if (!groups.empty() && should_shut_down) {
+    LOG(ERROR) << "Stall threshold exceeded; initiating coordinated "
+                  "shutdown.";
   }
   return should_shut_down;
 }

@@ -8,6 +8,7 @@
 #define HVD_TPU_STALL_INSPECTOR_H
 
 #include <chrono>
+#include <cstdint>
 #include <string>
 #include <unordered_map>
 #include <unordered_set>
@@ -55,6 +56,11 @@ class StallInspector {
       uncached_;
   std::unordered_map<std::string, Clock::time_point> cached_;
   Clock::time_point last_check_ = Clock::now();
+  // Missing-rank sets already warned about, with repeat counts: identical
+  // sets across consecutive checks log one compact line instead of the
+  // full per-tensor listing (spam rate-limit; suppressed repeats still
+  // count into the stall_warnings_total metric).
+  std::unordered_map<std::string, uint64_t> warned_sets_;
 };
 
 }  // namespace hvdtpu

@@ -11,7 +11,8 @@ void TimelineWriter::Initialize(const std::string& file_name) {
                << "timeline disabled.";
     return;
   }
-  std::fputs("[\n", file_);
+  std::fputs("[", file_);
+  first_record_ = true;
   active_.store(true);
   shutdown_.store(false);
   writer_thread_ = std::thread(&TimelineWriter::WriterLoop, this);
@@ -27,8 +28,25 @@ void TimelineWriter::Shutdown() {
   if (writer_thread_.joinable()) writer_thread_.join();
   active_.store(false);
   if (file_ != nullptr) {
+    // Close the array so the file is strictly valid chrome-tracing JSON
+    // (the record separators are comma-BEFORE, so there is no trailing
+    // comma to strip). Only a clean shutdown guarantees validity; a
+    // crashed run leaves an unterminated array, same as the reference.
+    std::fputs("\n]\n", file_);
     std::fclose(file_);
     file_ = nullptr;
+  }
+}
+
+// Comma-before-record separation: every record is preceded by ",\n"
+// except the first. Runs on the writer thread (and Shutdown after join),
+// so first_record_ needs no lock.
+void TimelineWriter::BeginRecord() {
+  if (first_record_) {
+    std::fputs("\n", file_);
+    first_record_ = false;
+  } else {
+    std::fputs(",\n", file_);
   }
 }
 
@@ -81,42 +99,48 @@ void TimelineWriter::DoWriteEvent(const TimelineRecord& r) {
     tid = next_tensor_id_++;
     tensor_table_[r.tensor_name] = tid;
     // Metadata record names the row.
+    BeginRecord();
     std::fprintf(file_,
                  "{\"name\": \"process_name\", \"ph\": \"M\", \"pid\": %d, "
-                 "\"args\": {\"name\": \"%s\"}},\n",
+                 "\"args\": {\"name\": \"%s\"}}",
                  tid, JsonEscape(r.tensor_name).c_str());
+    BeginRecord();
     std::fprintf(file_,
                  "{\"name\": \"process_sort_index\", \"ph\": \"M\", \"pid\": "
-                 "%d, \"args\": {\"sort_index\": %d}},\n",
+                 "%d, \"args\": {\"sort_index\": %d}}",
                  tid, tid);
   } else {
     tid = it->second;
   }
   if (r.phase == 'B') {
+    BeginRecord();
     std::fprintf(file_,
                  "{\"name\": \"%s\", \"ph\": \"B\", \"ts\": %lld, \"pid\": "
-                 "%d%s},\n",
+                 "%d%s}",
                  JsonEscape(r.op_name).c_str(),
                  static_cast<long long>(r.ts_us), tid,
                  r.args.empty()
                      ? ""
                      : (", \"args\": {" + r.args + "}").c_str());
   } else if (r.phase == 'E') {
-    std::fprintf(file_, "{\"ph\": \"E\", \"ts\": %lld, \"pid\": %d},\n",
+    BeginRecord();
+    std::fprintf(file_, "{\"ph\": \"E\", \"ts\": %lld, \"pid\": %d}",
                  static_cast<long long>(r.ts_us), tid);
   } else if (r.phase == 'i') {
+    BeginRecord();
     std::fprintf(file_,
                  "{\"name\": \"%s\", \"ph\": \"i\", \"ts\": %lld, \"pid\": %d, "
-                 "\"s\": \"p\"},\n",
+                 "\"s\": \"p\"}",
                  JsonEscape(r.op_name).c_str(),
                  static_cast<long long>(r.ts_us), tid);
   }
 }
 
 void TimelineWriter::DoWriteMarker(const TimelineRecord& r) {
+  BeginRecord();
   std::fprintf(file_,
                "{\"name\": \"%s\", \"ph\": \"i\", \"ts\": %lld, \"pid\": -1, "
-               "\"s\": \"g\"},\n",
+               "\"s\": \"g\"}",
                JsonEscape(r.op_name).c_str(), static_cast<long long>(r.ts_us));
 }
 

@@ -53,11 +53,15 @@ class TimelineWriter {
 
  private:
   void WriterLoop();
+  void BeginRecord();
   void DoWriteEvent(const TimelineRecord& r);
   void DoWriteMarker(const TimelineRecord& r);
 
   std::atomic<bool> active_{false};
   std::atomic<bool> shutdown_{false};
+  // Comma-before-record state; writer thread only (Shutdown touches it
+  // after the join).
+  bool first_record_ = true;
   std::FILE* file_ = nullptr;
   std::thread writer_thread_;
   std::mutex mutex_;

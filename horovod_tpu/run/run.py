@@ -147,6 +147,12 @@ def make_parser():
     parser.add_argument("--start-timeout", type=int, default=60,
                         help="seconds to wait for all ranks to connect")
     parser.add_argument("--check-build", action="store_true")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="serve live Prometheus metrics from every "
+                             "worker at this base port + rank (rank 0 "
+                             "additionally serves the aggregated job "
+                             "view at /job — poll it with bin/hvd-top); "
+                             "see docs/METRICS.md")
     parser.add_argument("--lint", nargs="?", const="warn",
                         choices=("warn", "strict"), default=None,
                         help="hvd-lint preflight: statically check the "
@@ -500,6 +506,17 @@ def main(argv=None):
         parser.error("no command given")
     if args.lint and not lint_preflight(command, args.lint):
         return 1
+    if args.metrics_port:
+        # Workers read the base port from env and offset by their rank
+        # (elastic re-ranks included); run_command/run_elastic inherit
+        # this process's env into every worker.
+        os.environ["HVD_TPU_METRICS_PORT"] = str(args.metrics_port)
+        sys.stderr.write(
+            "[launcher] metrics: per-rank Prometheus at "
+            "http://<worker-host>:%d+rank/metrics; job view at "
+            "http://<rank0-host>:%d/job (try: bin/hvd-top "
+            "localhost:%d)\n"
+            % (args.metrics_port, args.metrics_port, args.metrics_port))
     if args.tpu_pod:
         hosts = discover_tpu_pod()
         if hosts is None:

@@ -1,0 +1,164 @@
+"""``hvd-top`` — a live terminal view of a running horovod_tpu job.
+
+Polls the coordinator's ``/job`` metrics endpoint (rank 0 of a job
+launched with ``--metrics-port`` / ``HVD_TPU_METRICS_PORT``; see
+docs/METRICS.md) and renders per-rank cycle / negotiation / fusion
+stats, so a hanging or straggling job is diagnosable in seconds without
+waiting for a timeline capture: the rank whose announce lag grows is
+the one everybody else is waiting on.
+
+Stdlib-only on purpose — it runs anywhere, against any reachable job.
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def fetch_job(endpoint, timeout=5):
+    url = endpoint
+    if not url.startswith("http"):
+        url = "http://" + url
+    if not url.rstrip("/").endswith("/job"):
+        url = url.rstrip("/") + "/job"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _rate(cur, prev, field, dt):
+    if prev is None or dt <= 0:
+        return None
+    return (cur.get(field, 0.0) - prev.get(field, 0.0)) / dt
+
+
+def _fmt_rate(v, scale=1.0, suffix=""):
+    if v is None:
+        return "-"
+    v *= scale
+    if v >= 1e6:
+        return "%.1fM%s" % (v / 1e6, suffix)
+    if v >= 1e3:
+        return "%.1fk%s" % (v / 1e3, suffix)
+    return "%.1f%s" % (v, suffix)
+
+
+def render(job, prev_job, dt, endpoint):
+    """One frame: header + per-rank table + straggler verdict."""
+    per_rank = job.get("per_rank") or {}
+    lag = job.get("rank_lag_seconds") or []
+    prev_rank = (prev_job or {}).get("per_rank") or {}
+    prev_lag = (prev_job or {}).get("rank_lag_seconds") or []
+    lines = []
+    lines.append("hvd-top — %s — size %d, generation %d — %s" % (
+        endpoint, int(job.get("size", 0)), int(job.get("generation", 0)),
+        time.strftime("%H:%M:%S")))
+    header = ("%4s %9s %9s %8s %9s %9s %7s %6s %6s %6s %9s"
+              % ("rank", "cyc/s", "cyc_ms", "ops/s", "B/s", "fused_B",
+                 "cache%", "queue", "stall", "diverr", "lag_s"))
+    lines.append(header)
+    lines.append("-" * len(header))
+
+    max_lag_delta, straggler = 0.0, None
+    for r in sorted(per_rank, key=int):
+        cur = per_rank[r]
+        prev = prev_rank.get(r)
+        cyc_rate = _rate(cur, prev, "cycles_total", dt)
+        # Mean work-cycle duration over the window (cumulative mean as
+        # the first-frame fallback).
+        dsec = _rate(cur, prev, "cycle_seconds_sum", dt)
+        cyc_ms = (dsec / cyc_rate * 1e3) if cyc_rate else (
+            cur.get("cycle_seconds_sum", 0.0) / cur["cycles_total"] * 1e3
+            if cur.get("cycles_total") else 0.0)
+        hits = cur.get("cache_hit_total", 0.0)
+        misses = cur.get("cache_miss_total", 0.0)
+        cache_pct = 100.0 * hits / (hits + misses) if hits + misses else 0.0
+        ri = int(r)
+        lag_total = lag[ri] if ri < len(lag) else 0.0
+        lag_prev = prev_lag[ri] if ri < len(prev_lag) else 0.0
+        lag_delta = lag_total - lag_prev
+        if prev_job is not None and lag_delta > max_lag_delta:
+            max_lag_delta, straggler = lag_delta, ri
+        lines.append("%4s %9s %9.2f %8s %9s %9s %6.1f%% %6d %6d %6d %9.2f"
+                     % (r,
+                        _fmt_rate(cyc_rate),
+                        cyc_ms,
+                        _fmt_rate(_rate(cur, prev, "tensors_performed_total",
+                                        dt)),
+                        _fmt_rate(_rate(cur, prev, "bytes_performed_total",
+                                        dt)),
+                        _fmt_rate(cur.get("fused_bytes_total", 0.0)),
+                        cache_pct,
+                        int(cur.get("queue_depth", 0)),
+                        int(cur.get("stall_warnings_total", 0)),
+                        int(cur.get("divergence_errors_total", 0)),
+                        lag_total))
+    ages = job.get("age_seconds") or {}
+    stale = [r for r, age in ages.items() if float(age) > 10.0]
+    if stale:
+        lines.append("! no summary from rank(s) %s for >10s (hung or dead?)"
+                     % ", ".join(sorted(stale, key=int)))
+    if lag and max(lag) > 0:
+        worst = lag.index(max(lag))
+        note = " (growing)" if straggler == worst else ""
+        lines.append("straggler: rank %d holds the most waited-on-announce "
+                     "time (%.2fs total)%s" % (worst, max(lag), note))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="hvd-top",
+        description="Live per-rank view of a horovod_tpu job's metrics "
+                    "plane (poll rank 0's /job endpoint).")
+    ap.add_argument("endpoint", nargs="?", default="localhost:9400",
+                    help="coordinator metrics endpoint: host:port, URL, "
+                         "or the --metrics-port base (rank 0 serves the "
+                         "job view there). Default: localhost:9400")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (no screen "
+                         "clearing; for scripts/tests)")
+    args = ap.parse_args(argv)
+
+    prev_job, prev_t = None, None
+    try:
+        while True:
+            try:
+                job = fetch_job(args.endpoint)
+            except Exception as e:
+                msg = "hvd-top: cannot reach %s: %s" % (args.endpoint, e)
+                if args.once:
+                    print(msg, file=sys.stderr)
+                    return 1
+                print(msg, file=sys.stderr)
+                time.sleep(args.interval)
+                continue
+            if not job or not job.get("per_rank"):
+                msg = ("hvd-top: %s answered but has no job view — point "
+                       "me at RANK 0's port (the --metrics-port base)"
+                       % args.endpoint)
+                if args.once:
+                    print(msg, file=sys.stderr)
+                    return 1
+                print(msg, file=sys.stderr)
+                time.sleep(args.interval)
+                continue
+            now = time.monotonic()
+            dt = (now - prev_t) if prev_t is not None else 0.0
+            frame = render(job, prev_job, dt, args.endpoint)
+            if args.once:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            prev_job, prev_t = job, now
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,125 @@
+"""Metrics-plane e2e worker (tests/test_metrics.py): a 2-process job
+with a deliberate straggler that proves the whole plane live —
+
+* every worker serves Prometheus text at HVD_TPU_METRICS_PORT + rank;
+* rank 0 serves the aggregated job view at /job (per-rank summaries
+  ingested from the RequestList piggyback + the announce-lag table);
+* the scraped values agree with hvd.metrics() (parity on counters that
+  are frozen once the workload quiesces);
+* the straggling rank is identifiable WHILE THE JOB RUNS from the
+  job view's rank_lag_seconds (and from `hvd-top --once`).
+
+Rank 0 scrapes rank 1's endpoint while rank 1 is blocked inside the
+final barrier collective — serving from inside a blocked worker is the
+point of the plane (ctypes releases the GIL around native waits).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def scrape(port, path="/metrics", timeout=15):
+    url = "http://127.0.0.1:%d%s" % (port, path)
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8")
+
+
+def prom_value(text, family):
+    """First sample value of `family` in Prometheus text (any labels)."""
+    for line in text.splitlines():
+        if line.startswith(family) and line[len(family)] in (" ", "{"):
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError("no %s sample in:\n%s" % (family, text[:2000]))
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2, n
+    base = int(os.environ["HVD_TPU_METRICS_PORT"])
+    straggle = float(os.environ.get("HVD_TPU_TEST_STRAGGLE", "2.0"))
+
+    steps = 20
+    for i in range(steps):
+        if r == 1 and i == 10:
+            time.sleep(straggle)  # the deliberate straggler
+        hvd.allreduce(np.ones(1024, np.float32), "metrics.grad")
+
+    # Let at least one summary-sync interval pass so rank 0's job view
+    # holds a post-workload rank-1 summary.
+    time.sleep(1.0)
+
+    if r == 0:
+        own_m = hvd.metrics()
+        # -- parity: scraped /json == hvd.metrics() on quiesced counters
+        own_scraped = json.loads(scrape(base, "/json"))
+        for field in ("tensors_enqueued_total", "tensors_performed_total",
+                      "bytes_performed_total"):
+            assert own_scraped["counters"][field] == \
+                own_m["counters"][field], (field, own_scraped, own_m)
+        # Each rank enqueued exactly `steps` collectives so far.
+        assert own_m["counters"]["tensors_enqueued_total"] == steps, own_m
+
+        # -- Prometheus text on BOTH workers' endpoints. Rank 1 is
+        # already blocked in the exit barrier below (its enqueue count
+        # includes that 21st op) — which is the point: its endpoint
+        # answers from inside a blocked worker.
+        own_prom = scrape(base)
+        peer_prom = scrape(base + 1)
+        assert prom_value(own_prom, "hvdtpu_tensors_enqueued_total") == steps
+        assert prom_value(peer_prom, "hvdtpu_tensors_enqueued_total") in \
+            (steps, steps + 1)
+        assert prom_value(own_prom, "hvdtpu_rank") == 0
+        assert prom_value(peer_prom, "hvdtpu_rank") == 1
+        assert 'le="+Inf"' in own_prom
+        # rank 0's scrape target carries the whole job (worker series).
+        assert 'hvdtpu_worker_cycles_total{rank="1"}' in own_prom
+
+        # -- histogram sanity (native bucketing): counts sum to count
+        for name, h in own_m["histograms"].items():
+            assert len(h["counts"]) == len(h["bounds"]) + 1, name
+            assert sum(h["counts"]) == h["count"], (name, h)
+        assert own_m["histograms"]["cycle_seconds"]["count"] > 0
+        assert own_m["histograms"]["negotiation_seconds"]["count"] >= steps
+
+        # -- job view: both ranks present, aggregate, straggler named
+        job = json.loads(scrape(base, "/job"))
+        assert set(job["per_rank"]) == {"0", "1"}, job
+        assert job["per_rank"]["1"]["tensors_enqueued_total"] in \
+            (steps, steps + 1), job
+        agg = job["aggregate"]["tensors_enqueued_total"]
+        assert agg["min"] == steps and agg["max"] <= steps + 1, agg
+        lag = job["rank_lag_seconds"]
+        assert lag[1] > max(straggle * 0.5, lag[0] + straggle * 0.25), \
+            ("straggler not identified", lag)
+
+        # -- hvd-top --once against the coordinator endpoint
+        top = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "hvd-top"),
+             "127.0.0.1:%d" % base, "--once"],
+            capture_output=True, text=True, timeout=60)
+        assert top.returncode == 0, top.stdout + top.stderr
+        assert "straggler: rank 1" in top.stdout, top.stdout
+        assert "size 2" in top.stdout, top.stdout
+
+        print("METRICS_E2E_OK lag=%s" % json.dumps(lag), flush=True)
+
+    # Exit barrier: holds rank 1 (blocked HERE, serving scrapes) alive
+    # until rank 0 finishes scraping it above.
+    hvd.allreduce(np.ones(1, np.float32), "metrics.done")
+    print("rank %d done" % r, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

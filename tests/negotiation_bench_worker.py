@@ -72,6 +72,19 @@ def main():
     if r == 0:
         # Per OP also in bucket mode (k ops ride each step).
         print("NEGOTIATION_US_PER_OP %.1f" % (dt / (iters * k) * 1e6))
+        # Live-metrics snapshot for the BENCH json (docs/METRICS.md):
+        # the cycle-time histogram, fused-bytes total, and cache hit
+        # rate of this run's coordinator.
+        m = hvd.metrics()
+        c = m["counters"]
+        looked_up = c["cache_hit_total"] + c["cache_miss_total"]
+        print("METRICS_SNAPSHOT %s" % json.dumps({
+            "cycle_seconds": m["histograms"]["cycle_seconds"],
+            "fused_bytes_total": c["fused_bytes_total"],
+            "fused_tensors_total": c["fused_tensors_total"],
+            "cache_hit_rate": round(c["cache_hit_total"] / looked_up, 4)
+            if looked_up else None,
+        }))
     print("rank %d done" % r)
     return 0
 

@@ -14,9 +14,28 @@ from horovod_tpu.common import ops
 
 
 def main():
+    import os
+    import threading
+
     hvd.init()
     r, n = hvd.rank(), hvd.size()
     assert n >= 2
+
+    # Metrics-plane race check (make check-tsan / check-asan run with
+    # HVD_TPU_METRICS=1): a scraper thread hammers the C snapshot API
+    # while the background thread and the fuzz's out-of-order enqueue
+    # threads mutate the registry — any locking regression in
+    # native/metrics.{h,cc} shows up as a sanitizer report here.
+    stop_scraper = threading.Event()
+    scraper = None
+    if os.environ.get("HVD_TPU_METRICS") == "1":
+        def scrape_loop():
+            while not stop_scraper.is_set():
+                snap = hvd.metrics()
+                assert "counters" in snap
+                hvd.job_metrics()
+        scraper = threading.Thread(target=scrape_loop, daemon=True)
+        scraper.start()
 
     num_tensors = 40
     jobs = []
@@ -25,7 +44,6 @@ def main():
         jobs.append((i, kind))
 
     # Same job set, rank-specific enqueue order.
-    import os
     seed = int(os.environ.get("HVD_TPU_FUZZ_SEED", "1234"))
     order = list(range(num_tensors))
     random.Random(seed + r).shuffle(order)
@@ -69,6 +87,12 @@ def main():
             root = idx % n
             assert out.shape == (2, idx + 1), (idx, out.shape)
             assert np.allclose(out, float(root * 100 + idx)), (idx, out)
+
+    if scraper is not None:
+        stop_scraper.set()
+        scraper.join(timeout=10)
+        snap = hvd.metrics()
+        assert snap["counters"]["tensors_enqueued_total"] >= num_tensors, snap
 
     print("rank %d: negotiation fuzz passed" % r, flush=True)
     return 0

@@ -1,0 +1,151 @@
+"""Metrics-plane tests (docs/METRICS.md): pure-Python units for the
+Prometheus renderer / aggregator, plus the 2-process e2e that scrapes
+both worker endpoints and the rank-0 job view while a deliberate
+straggler runs (tests/metrics_worker.py)."""
+
+import json
+import socket
+
+import pytest
+
+from horovod_tpu._metrics import aggregate, render_prometheus
+
+
+# ---------------------------------------------------------------- units
+
+def _snap(**over):
+    snap = {
+        "counters": {"tensors_enqueued_total": 7},
+        "gauges": {"queue_depth": 3},
+        "histograms": {
+            "cycle_seconds": {"bounds": [0.1, 1.0, 5.0],
+                              "counts": [2, 3, 1, 4],
+                              "sum": 2.5, "count": 10},
+        },
+        "rank_lag_seconds": [0.0, 1.5],
+    }
+    snap.update(over)
+    return snap
+
+
+def test_render_prometheus_counter_gauge_and_labels():
+    text = render_prometheus(_snap(), labels={"rank": 0})
+    assert "# TYPE hvdtpu_tensors_enqueued_total counter" in text
+    assert 'hvdtpu_tensors_enqueued_total{rank="0"} 7' in text
+    assert "# TYPE hvdtpu_queue_depth gauge" in text
+    assert 'hvdtpu_queue_depth{rank="0"} 3' in text
+    # Coordinator lag table renders per-rank labeled samples.
+    assert 'hvdtpu_rank_announce_lag_seconds_total{rank="1"} 1.5' in text
+
+
+def test_render_prometheus_histogram_buckets_are_cumulative():
+    text = render_prometheus(_snap())
+    assert "# TYPE hvdtpu_cycle_seconds histogram" in text
+    # Raw per-bucket counts [2, 3, 1, 4] must render cumulatively.
+    assert 'hvdtpu_cycle_seconds_bucket{le="0.1"} 2' in text
+    assert 'hvdtpu_cycle_seconds_bucket{le="1"} 5' in text
+    assert 'hvdtpu_cycle_seconds_bucket{le="5"} 6' in text
+    assert 'hvdtpu_cycle_seconds_bucket{le="+Inf"} 10' in text
+    assert "hvdtpu_cycle_seconds_sum 2.5" in text
+    assert "hvdtpu_cycle_seconds_count 10" in text
+
+
+def test_render_prometheus_no_labels():
+    text = render_prometheus(_snap(rank_lag_seconds=[]))
+    assert "hvdtpu_tensors_enqueued_total 7" in text
+    assert "rank_announce_lag" not in text  # all-zero/absent table elided
+
+
+def test_aggregate_min_max_mean_argmax():
+    agg = aggregate({"0": {"x": 1.0, "y": 5.0},
+                     "1": {"x": 3.0, "y": 5.0},
+                     "2": {"x": 2.0}})  # missing y -> 0
+    assert agg["x"] == {"min": 1.0, "max": 3.0, "mean": 2.0,
+                       "argmax_rank": 1}
+    assert agg["y"]["min"] == 0.0 and agg["y"]["max"] == 5.0
+    assert aggregate({}) == {}
+
+
+# ------------------------------------------------------------------ e2e
+
+def _free_port_pair():
+    """A base port where base and base+1 are both currently free (the
+    two workers bind base+rank; ThreadingHTTPServer sets
+    allow_reuse_address so the close->bind handoff is safe)."""
+    for _ in range(64):
+        s1 = socket.socket()
+        s1.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s1.bind(("127.0.0.1", 0))
+        base = s1.getsockname()[1]
+        if base + 1 > 65535:
+            s1.close()
+            continue
+        s2 = socket.socket()
+        s2.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s2.bind(("127.0.0.1", base + 1))
+        except OSError:
+            s1.close()
+            continue
+        s1.close()
+        s2.close()
+        return base
+    raise RuntimeError("no free adjacent port pair")
+
+
+@pytest.mark.e2e
+def test_metrics_endpoints_parity_and_straggler(run_launcher):
+    """The acceptance scenario: 2 workers expose Prometheus endpoints,
+    rank 0 exposes the job aggregate, hvd.metrics() matches the scraped
+    values, and the deliberately straggling rank 1 is identifiable from
+    the job view (announce-lag) and from `hvd-top --once` — all while
+    the job is still running. Cache off so every step is a full
+    negotiation (the cached path's straggler attribution goes through
+    stall-invalidation -> renegotiation, pinned by
+    test_stall_warn_then_recover_with_cache)."""
+    base = _free_port_pair()
+    proc = run_launcher(2, "metrics_worker.py", extra_env={
+        "HVD_TPU_METRICS_PORT": str(base),
+        "HVD_TPU_METRICS_SYNC_SECONDS": "0.25",
+        "HVD_TPU_CACHE_CAPACITY": "0",
+        "HVD_TPU_TEST_STRAGGLE": "2.0",
+    }, timeout=300)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    assert "METRICS_E2E_OK" in out, out
+    assert out.count("done") >= 2, out
+    lag_line = [l for l in proc.stdout.splitlines()
+                if l.startswith("METRICS_E2E_OK")][0]
+    lag = json.loads(lag_line.split("lag=", 1)[1])
+    assert lag[1] > lag[0], lag
+
+
+@pytest.mark.e2e
+def test_launcher_metrics_port_flag(run_launcher, tmp_path):
+    """`horovodrun_tpu --metrics-port` injects the base port into the
+    worker env (workers offset by rank themselves)."""
+    import os
+    import subprocess
+    import sys
+
+    from conftest import clean_worker_env
+
+    base = _free_port_pair()
+    script = tmp_path / "echo_port.py"
+    script.write_text(
+        "import os\n"
+        "import horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "print('PORT', os.environ['HVD_TPU_METRICS_PORT'])\n"
+        "from horovod_tpu import _metrics\n"
+        "print('SERVING', _metrics.server_port())\n")
+    env = clean_worker_env()
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run.run", "-np", "1",
+         "--metrics-port", str(base), "--",
+         sys.executable, str(script)],
+        env=env, timeout=120, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PORT %d" % base in proc.stdout, proc.stdout
+    assert "SERVING %d" % base in proc.stdout, proc.stdout
+    assert "metrics:" in proc.stderr, proc.stderr
