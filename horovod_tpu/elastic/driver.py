@@ -146,6 +146,16 @@ class ElasticDriver:
             "status": "done",
         }))
 
+    def _generation_resolved(self):
+        """True when the current generation needs no rendezvous
+        (size <= 1) or its rendezvous published a resolved table."""
+        if self._published_size <= 1:
+            return True
+        resolved = self._server.scope_items(
+            rendezvous.gen_scope(rendezvous.SCOPE_RESOLVED,
+                                 self._generation))
+        return "table" in resolved
+
     def _generation_stalled(self):
         """True when the current generation's rendezvous has not
         converged (no resolved table) within the start timeout — e.g. a
@@ -155,10 +165,23 @@ class ElasticDriver:
             return False  # size-1 generations do not rendezvous
         if time.monotonic() - self._published_at < self._start_timeout:
             return False
-        resolved = self._server.scope_items(
-            rendezvous.gen_scope(rendezvous.SCOPE_RESOLVED,
-                                 self._generation))
-        return "table" not in resolved
+        return not self._generation_resolved()
+
+    def _generation_ready(self):
+        """Growth gate: True once the CURRENT generation either has a
+        resolved rendezvous or has provably stalled (the stall path
+        bumps it anyway). Publishing a grow-generation while the
+        current one is still rendezvousing strands late-arriving
+        survivors in the superseded scope: after a shrink, the
+        survivors re-bootstrap a second or two apart (connection-loss
+        detection and reconnect windows are not synchronized across
+        ranks), and if the blacklist cooldown expires inside that gap
+        the respawn used to bump the generation between their
+        bootstraps — one survivor then waited in gen N and the other in
+        gen N+1 until both timed out. (Within the start-timeout window
+        the stalled check short-circuits on time, so an unresolved
+        generation costs one scope lookup per tick, not two.)"""
+        return self._generation_resolved() or self._generation_stalled()
 
     def _reinit_requested(self):
         """True when any live worker published a reinit request for the
@@ -304,7 +327,10 @@ class ElasticDriver:
             if now - last_discovery > self._discovery_interval:
                 last_discovery = now
                 self._hosts.refresh()
-            plan = self._plan_growth()
+            # Growth only once the current generation has converged (or
+            # stalled) — see _generation_ready. Shrink/failure bumps are
+            # not gated: a dead worker must repartition immediately.
+            plan = self._plan_growth() if self._generation_ready() else []
 
             if len(self._workers) + len(plan) < self._min_np:
                 plan = []

@@ -42,6 +42,21 @@ namespace hvdtpu {
 #define HVD_TPU_METRICS_PORT "HVD_TPU_METRICS_PORT"
 #define HVD_TPU_METRICS_SYNC "HVD_TPU_METRICS_SYNC_SECONDS"
 #define HVD_TPU_GENERATION_ENV "HVD_TPU_GENERATION"
+// Chaos-hardened transport knobs (net.cc / tcp_context.cc / fault.cc;
+// docs/CHAOS.md): frame checksums are on by default (NET_CRC=0 disables,
+// job-wide); NET_TIMEOUT bounds every blocking send/recv (default: the
+// control poll window, 60 s); KEEPALIVE detects powered-off hosts in
+// ~2*idle seconds (0 disables); MAX_FRAME_BYTES bounds a single frame
+// allocation (default 1 GiB); RECONNECT_SECONDS is the window a broken
+// worker->coordinator control connection may take to resume with capped
+// exponential backoff (0 disables reconnect); FAULT_SPEC arms the
+// deterministic fault injector (never set it on a production job).
+#define HVD_TPU_NET_CRC_ENV "HVD_TPU_NET_CRC"
+#define HVD_TPU_NET_TIMEOUT_ENV "HVD_TPU_NET_TIMEOUT_SECONDS"
+#define HVD_TPU_NET_KEEPALIVE_ENV "HVD_TPU_NET_KEEPALIVE_SECONDS"
+#define HVD_TPU_MAX_FRAME_BYTES_ENV "HVD_TPU_MAX_FRAME_BYTES"
+#define HVD_TPU_RECONNECT_ENV "HVD_TPU_RECONNECT_SECONDS"
+#define HVD_TPU_FAULT_SPEC_ENV "HVD_TPU_FAULT_SPEC"
 
 enum class StatusType : int32_t {
   OK = 0,

@@ -9,6 +9,25 @@
 
 namespace hvdtpu {
 
+// A failed ring exchange means the data-plane transport is desynced or a
+// peer is gone — recoverable only by a generation restart. The status
+// carries the CONNECTION_LOST marker (Python's elastic layer rolls back
+// on it, and the background loop escalates it to a connection-lost
+// shutdown — see PerformOperation) plus the transport-level cause from
+// the context, so a chaos run's failure names what was injected
+// (checksum mismatch, deadline expiry, peer close).
+static Status RingLost(const TcpContext& ctx, const char* what) {
+  std::string msg = CONNECTION_LOST_ERROR;
+  msg += " [";
+  msg += what;
+  if (!ctx.last_error().empty()) {
+    msg += ": ";
+    msg += ctx.last_error();
+  }
+  msg += "]";
+  return Status::UnknownError(msg);
+}
+
 template <typename T>
 static void ReduceSumT(T* dst, const T* src, int64_t n) {
   for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
@@ -157,7 +176,7 @@ static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
     if (!ctx.RingExchangeOn(ring, buf + offsets[send_chunk] * elem,
                             counts[send_chunk] * elem, tmp.data(),
                             counts[recv_chunk] * elem)) {
-      return Status::UnknownError("ring reduce-scatter exchange failed");
+      return RingLost(ctx, "ring reduce-scatter exchange failed");
     }
     ReduceSum(buf + offsets[recv_chunk] * elem, tmp.data(), counts[recv_chunk],
               dtype);
@@ -181,7 +200,7 @@ static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
                             counts[send_chunk] * elem,
                             buf + offsets[recv_chunk] * elem,
                             counts[recv_chunk] * elem)) {
-      return Status::UnknownError("ring allgather exchange failed");
+      return RingLost(ctx, "ring allgather exchange failed");
     }
   }
   return Status::OK();
@@ -348,7 +367,7 @@ Status CpuRingAllgather::Execute(std::vector<TensorTableEntry>& entries,
                              out + block_offsets[recv_block],
                              static_cast<std::size_t>(block_bytes[recv_block]))) {
         timeline.ActivityEndAll(response.tensor_names());
-        return Status::UnknownError("ring allgather exchange failed");
+        return RingLost(ctx_, "ring allgather exchange failed");
       }
     }
   }
@@ -413,7 +432,7 @@ Status CpuHierarchicalAllgather::Execute(
               out + block_offsets[gr],
               static_cast<std::size_t>(block_bytes[gr]))) {
         timeline.ActivityEndAll(response.tensor_names());
-        return Status::UnknownError("hierarchical allgather cross leg failed");
+        return RingLost(ctx_, "hierarchical allgather cross leg failed");
       }
     }
 
@@ -444,7 +463,7 @@ Status CpuHierarchicalAllgather::Execute(
               static_cast<std::size_t>(col_bytes[send_col]), tmp_recv.data(),
               static_cast<std::size_t>(col_bytes[recv_col]))) {
         timeline.ActivityEndAll(response.tensor_names());
-        return Status::UnknownError("hierarchical allgather local leg failed");
+        return RingLost(ctx_, "hierarchical allgather local leg failed");
       }
       const char* q = tmp_recv.data();
       for (int c = 0; c < cs; ++c) {
@@ -480,7 +499,7 @@ Status CpuBroadcast::Execute(std::vector<TensorTableEntry>& entries,
     }
     if (!ctx_.RingBroadcast(e.output, len, e.root_rank)) {
       timeline.ActivityEndAll(response.tensor_names());
-      return Status::UnknownError("ring broadcast failed");
+      return RingLost(ctx_, "ring broadcast failed");
     }
   }
   timeline.ActivityEndAll(response.tensor_names());

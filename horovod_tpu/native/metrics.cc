@@ -58,6 +58,10 @@ const char* SummaryFieldName(int field) {
     case SUM_DIVERGENCE_ERRORS: return "divergence_errors_total";
     case SUM_NEGOTIATION_SECONDS_SUM: return "negotiation_seconds_sum";
     case SUM_NEGOTIATION_COUNT: return "negotiation_count";
+    case SUM_NET_CRC_ERRORS: return "net_crc_errors_total";
+    case SUM_NET_TIMEOUTS: return "net_timeouts_total";
+    case SUM_NET_RECONNECTS: return "net_reconnects_total";
+    case SUM_FAULTS_INJECTED: return "faults_injected_total";
   }
   return "unknown";
 }
@@ -121,6 +125,12 @@ std::vector<double> Metrics::Summary() const {
   v[SUM_NEGOTIATION_SECONDS_SUM] = negotiation_seconds.sum();
   v[SUM_NEGOTIATION_COUNT] =
       static_cast<double>(negotiation_seconds.count());
+  v[SUM_NET_CRC_ERRORS] = static_cast<double>(net_crc_errors_total.load());
+  v[SUM_NET_TIMEOUTS] =
+      static_cast<double>(net_recv_timeouts_total.load() +
+                          net_send_timeouts_total.load());
+  v[SUM_NET_RECONNECTS] = static_cast<double>(net_reconnects_total.load());
+  v[SUM_FAULTS_INJECTED] = static_cast<double>(faults_injected_total.load());
   return v;
 }
 
@@ -213,6 +223,25 @@ std::string Metrics::SnapshotJson() const {
   AppendKV(&out, "error_responses_total", error_responses_total.load(),
            &first);
   AppendKV(&out, "init_total", init_total.load(), &first);
+  AppendKV(&out, "net_crc_errors_total", net_crc_errors_total.load(),
+           &first);
+  AppendKV(&out, "net_recv_timeouts_total", net_recv_timeouts_total.load(),
+           &first);
+  AppendKV(&out, "net_send_timeouts_total", net_send_timeouts_total.load(),
+           &first);
+  AppendKV(&out, "net_oversize_frames_total",
+           net_oversize_frames_total.load(), &first);
+  AppendKV(&out, "net_reconnect_attempts_total",
+           net_reconnect_attempts_total.load(), &first);
+  AppendKV(&out, "net_reconnects_total", net_reconnects_total.load(),
+           &first);
+  AppendKV(&out, "faults_injected_total", faults_injected_total.load(),
+           &first);
+  AppendKV(&out, "fault_drop_total", fault_drop_total.load(), &first);
+  AppendKV(&out, "fault_delay_total", fault_delay_total.load(), &first);
+  AppendKV(&out, "fault_corrupt_total", fault_corrupt_total.load(), &first);
+  AppendKV(&out, "fault_close_total", fault_close_total.load(), &first);
+  AppendKV(&out, "fault_stall_total", fault_stall_total.load(), &first);
   out.append("},\"gauges\":{");
   first = true;
   AppendKV(&out, "queue_depth", static_cast<double>(queue_depth.load()),

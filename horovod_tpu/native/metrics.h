@@ -81,6 +81,13 @@ enum SummaryField : int {
   SUM_DIVERGENCE_ERRORS,
   SUM_NEGOTIATION_SECONDS_SUM,
   SUM_NEGOTIATION_COUNT,
+  // Transport robustness (PR 4, docs/CHAOS.md). Appended AFTER the
+  // original 17 fields — the count prefix keeps the wire
+  // forward-compatible with pre-chaos decoders.
+  SUM_NET_CRC_ERRORS,
+  SUM_NET_TIMEOUTS,
+  SUM_NET_RECONNECTS,
+  SUM_FAULTS_INJECTED,
   SUM_FIELD_COUNT
 };
 const char* SummaryFieldName(int field);
@@ -107,6 +114,20 @@ class Metrics {
   std::atomic<uint64_t> divergence_errors_total{0};
   std::atomic<uint64_t> error_responses_total{0};
   std::atomic<uint64_t> init_total{0};
+
+  // --- transport robustness (net.cc / tcp_context.cc / fault.cc) ---
+  std::atomic<uint64_t> net_crc_errors_total{0};       // checksum mismatches
+  std::atomic<uint64_t> net_recv_timeouts_total{0};    // SO_RCVTIMEO expiry
+  std::atomic<uint64_t> net_send_timeouts_total{0};    // SO_SNDTIMEO expiry
+  std::atomic<uint64_t> net_oversize_frames_total{0};  // > MAX_FRAME_BYTES
+  std::atomic<uint64_t> net_reconnect_attempts_total{0};
+  std::atomic<uint64_t> net_reconnects_total{0};       // successful resumes
+  std::atomic<uint64_t> faults_injected_total{0};      // all injected faults
+  std::atomic<uint64_t> fault_drop_total{0};
+  std::atomic<uint64_t> fault_delay_total{0};
+  std::atomic<uint64_t> fault_corrupt_total{0};
+  std::atomic<uint64_t> fault_close_total{0};
+  std::atomic<uint64_t> fault_stall_total{0};
 
   // --- gauges (instantaneous; reset per generation) ---
   std::atomic<int64_t> queue_depth{0};

@@ -1,0 +1,257 @@
+// In-process transport selftests, exposed over the C API so the Python
+// suite can unit-test the frame layer's failure paths (CRC detection,
+// recv deadlines, oversize rejection, handshake timeouts) without
+// spawning a multi-process job. Each scenario builds its sockets from
+// scratch (socketpair / loopback listener), so these run tier-1-safe on
+// any CPU-only host.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <string>
+#include <thread>
+
+#include "checksum.h"
+#include "fault.h"
+#include "logging.h"
+#include "net.h"
+
+namespace hvdtpu {
+namespace {
+
+struct ConnPair {
+  Conn a;
+  Conn b;
+  bool ok = false;
+
+  ConnPair() {
+    int fds[2];
+    if (::socketpair(AF_UNIX, SOCK_STREAM, 0, fds) != 0) return;
+    a = Conn(fds[0]);
+    b = Conn(fds[1]);
+    ok = true;
+  }
+};
+
+// A frame survives the wire and verifies, for both recv flavors.
+bool CrcRoundtrip() {
+  ConnPair p;
+  if (!p.ok) return false;
+  std::string payload = "the quick brown fox jumps over the lazy dog";
+  if (!p.a.SendFrame(0x42, payload)) return false;
+  uint32_t tag = 0;
+  std::string got;
+  if (!p.b.RecvFrame(&tag, &got)) return false;
+  if (tag != 0x42 || got != payload) return false;
+  if (!p.a.SendFrame(0x43, payload)) return false;
+  std::string fixed(payload.size(), '\0');
+  if (!p.b.RecvFrameInto(&tag, &fixed[0], fixed.size())) return false;
+  return tag == 0x43 && fixed == payload;
+}
+
+// A flipped payload byte is detected as a checksum mismatch, not
+// returned as data.
+bool CrcCorruptDetected() {
+  ConnPair p;
+  if (!p.ok) return false;
+  std::string payload(4096, 'G');  // a "gradient"
+  uint64_t len = payload.size();
+  uint32_t tag = 0x42;
+  char prefix[12];
+  std::memcpy(prefix, &tag, 4);
+  std::memcpy(prefix + 4, &len, 8);
+  uint32_t crc = Crc32c(prefix, sizeof(prefix));
+  crc = Crc32c(payload.data(), payload.size(), crc);
+  payload[1000] ^= 0x1;  // the wire flip
+  char hdr[kFrameHeaderBytes];
+  BuildFrameHeader(hdr, tag, len, crc);
+  if (!p.a.SendAll(hdr, sizeof(hdr))) return false;
+  if (!p.a.SendAll(payload.data(), payload.size())) return false;
+  std::string got;
+  uint32_t rtag;
+  if (p.b.RecvFrame(&rtag, &got)) return false;  // MUST fail
+  return p.b.last_error() == NetError::CRC;
+}
+
+// A peer that sends nothing trips the recv deadline promptly (bounded,
+// not forever).
+bool RecvDeadline() {
+  ConnPair p;
+  if (!p.ok) return false;
+  p.b.SetTimeouts(1);
+  auto t0 = std::chrono::steady_clock::now();
+  uint32_t tag;
+  std::string got;
+  bool recv_ok = p.b.RecvFrame(&tag, &got);
+  double elapsed =
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+          .count();
+  return !recv_ok && p.b.last_error() == NetError::TIMEOUT &&
+         elapsed < 5.0;
+}
+
+// A corrupt length field is rejected before allocation, not OOM'd on.
+bool MaxFrameRejected() {
+  ConnPair p;
+  if (!p.ok) return false;
+  char hdr[kFrameHeaderBytes];
+  BuildFrameHeader(hdr, 0x42, ~0ull >> 1, 0);  // ~9 EB "frame"
+  if (!p.a.SendAll(hdr, sizeof(hdr))) return false;
+  uint32_t tag;
+  std::string got;
+  if (p.b.RecvFrame(&tag, &got)) return false;  // MUST fail
+  return p.b.last_error() == NetError::TOO_BIG;
+}
+
+// A client that connects and never handshakes (port scanner, health
+// probe) cannot wedge the accept loop: AcceptPeer returns within its
+// deadline, and a REAL peer arriving later still gets through.
+bool HandshakeTimeout() {
+  Listener l;
+  if (!l.Start(0)) return false;
+  int silent = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (silent < 0) return false;
+  sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(0x7F000001);  // 127.0.0.1
+  addr.sin_port = htons(static_cast<uint16_t>(l.port()));
+  if (::connect(silent, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+      0) {
+    ::close(silent);
+    return false;
+  }
+  // ... and says nothing. Accept must give up within the deadline.
+  auto t0 = std::chrono::steady_clock::now();
+  PeerHandshake hs;
+  int fd = l.AcceptPeer(&hs, 500, /*expected_generation=*/0);
+  double elapsed =
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+          .count();
+  bool timed_out = fd < 0 && elapsed < 5.0;
+
+  // A real peer still gets accepted while the scanner dangles.
+  std::thread peer([&] {
+    Conn c = ConnectPeer("127.0.0.1", l.port(), /*my_rank=*/3,
+                         Channel::CONTROL, /*timeout_ms=*/3000,
+                         /*generation=*/7);
+    // Hold the conn open until the acceptor has read the handshake.
+    std::this_thread::sleep_for(std::chrono::milliseconds(300));
+  });
+  int fd2 = l.AcceptPeer(&hs, 3000, /*expected_generation=*/7);
+  peer.join();
+  bool accepted = fd2 >= 0 && hs.rank == 3 &&
+                  hs.channel == Channel::CONTROL && hs.generation == 7;
+  if (fd2 >= 0) ::close(fd2);
+  ::close(silent);
+  return timed_out && accepted;
+}
+
+// A stale-generation peer is rejected; a current-generation peer is not.
+bool StaleGenerationRejected() {
+  Listener l;
+  if (!l.Start(0)) return false;
+  std::thread stale([&] {
+    ConnectPeer("127.0.0.1", l.port(), /*my_rank=*/1, Channel::CONTROL,
+                /*timeout_ms=*/2000, /*generation=*/3);
+  });
+  std::thread current([&] {
+    // Give the stale connect a head start so rejection is exercised.
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+    Conn c = ConnectPeer("127.0.0.1", l.port(), /*my_rank=*/2,
+                         Channel::CONTROL, /*timeout_ms=*/3000,
+                         /*generation=*/4);
+    std::this_thread::sleep_for(std::chrono::milliseconds(300));
+  });
+  PeerHandshake hs;
+  int fd = l.AcceptPeer(&hs, 4000, /*expected_generation=*/4);
+  stale.join();
+  current.join();
+  bool ok = fd >= 0 && hs.rank == 2 && hs.generation == 4;
+  if (fd >= 0) ::close(fd);
+  return ok;
+}
+
+// The fault-spec parser + seeded determinism: frame= fires exactly once
+// at the right index; prob= replays identically for the same seed.
+bool FaultSpecDeterministic() {
+  FaultInjector inj;
+  inj.Configure("seed=5;rank=1,chan=control,dir=send,frame=2,action=close",
+                /*rank=*/1);
+  if (!inj.active()) return false;
+  for (int i = 0; i < 2; ++i) {
+    if (inj.OnFrame(Channel::CONTROL, true).action != FaultAction::NONE) {
+      return false;
+    }
+  }
+  if (inj.OnFrame(Channel::CONTROL, true).action != FaultAction::CLOSE) {
+    return false;
+  }
+  // count defaults to 1 for frame rules: never fires again.
+  for (int i = 0; i < 8; ++i) {
+    if (inj.OnFrame(Channel::CONTROL, true).action != FaultAction::NONE) {
+      return false;
+    }
+  }
+  // Rank filter: a rule for rank 1 never fires on rank 2.
+  inj.Configure("rank=1,frame=0,action=drop", /*rank=*/2);
+  if (inj.OnFrame(Channel::RING, true).action != FaultAction::NONE) {
+    return false;
+  }
+  // Seeded prob= replay: identical decision streams for identical seeds.
+  auto stream = [](uint64_t seed) {
+    FaultInjector x;
+    std::string spec =
+        "seed=" + std::to_string(seed) + ";prob=0.3,action=delay,delay_ms=1";
+    x.Configure(spec.c_str(), /*rank=*/0);
+    std::string bits;
+    for (int i = 0; i < 64; ++i) {
+      bits.push_back(
+          x.OnFrame(Channel::RING, false).action == FaultAction::NONE ? '0'
+                                                                      : '1');
+    }
+    return bits;
+  };
+  std::string s1 = stream(99), s2 = stream(99), s3 = stream(100);
+  if (s1 != s2) return false;
+  if (s1.find('1') == std::string::npos) return false;  // must fire some
+  return s1 != s3;  // and differ across seeds (64 frames: ~certain)
+}
+
+}  // namespace
+}  // namespace hvdtpu
+
+extern "C" {
+
+// CRC32C of a buffer (known-answer tests from Python; also handy for
+// tooling that wants to pre-checksum payloads).
+uint32_t horovod_tpu_crc32c(const void* data, uint64_t len) {
+  return hvdtpu::Crc32c(data, static_cast<std::size_t>(len));
+}
+
+// Incremental flavor: extend `crc` over another chunk.
+uint32_t horovod_tpu_crc32c_extend(uint32_t crc, const void* data,
+                                   uint64_t len) {
+  return hvdtpu::Crc32c(data, static_cast<std::size_t>(len), crc);
+}
+
+// Runs the named transport selftest; 1 = pass, 0 = fail, -1 = unknown
+// name. Scenarios: crc_roundtrip, crc_corrupt_detected, recv_deadline,
+// max_frame, handshake_timeout, stale_generation, fault_spec.
+int horovod_tpu_net_selftest(const char* name) {
+  using namespace hvdtpu;
+  std::string n(name ? name : "");
+  if (n == "crc_roundtrip") return CrcRoundtrip() ? 1 : 0;
+  if (n == "crc_corrupt_detected") return CrcCorruptDetected() ? 1 : 0;
+  if (n == "recv_deadline") return RecvDeadline() ? 1 : 0;
+  if (n == "max_frame") return MaxFrameRejected() ? 1 : 0;
+  if (n == "handshake_timeout") return HandshakeTimeout() ? 1 : 0;
+  if (n == "stale_generation") return StaleGenerationRejected() ? 1 : 0;
+  if (n == "fault_spec") return FaultSpecDeterministic() ? 1 : 0;
+  return -1;
+}
+
+}  // extern "C"

@@ -201,6 +201,16 @@ std::pair<int64_t, int64_t> PerformOperation(HorovodGlobalState& state,
     state.timeline.End(e.tensor_name, status.ok());
     if (e.callback) e.callback(status, e);
   }
+  // A data-plane transport loss (ring EOF / checksum mismatch / deadline
+  // — cpu_operations.cc RingLost) leaves the ring desynced: later
+  // exchanges would pair mismatched steps. Escalate to the same
+  // connection-lost shutdown a control-plane failure takes, AFTER the
+  // failed tensors' callbacks have delivered the attributable status.
+  if (!status.ok() &&
+      status.reason().compare(0, CONNECTION_LOST_ERROR.size(),
+                              CONNECTION_LOST_ERROR) == 0) {
+    throw ConnectionLostError(status.reason());
+  }
   return {static_cast<int64_t>(entries.size()), bytes};
 }
 

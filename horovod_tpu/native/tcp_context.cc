@@ -2,13 +2,19 @@
 
 #include <poll.h>
 #include <sys/socket.h>
+#include <unistd.h>
 
 #include <cerrno>
+#include <chrono>
 #include <cstdlib>
 #include <cstring>
 #include <sstream>
+#include <thread>
 
+#include "checksum.h"
+#include "fault.h"
 #include "logging.h"
+#include "metrics.h"
 
 namespace hvdtpu {
 
@@ -33,6 +39,42 @@ static int ControlPollMs() {
   return ms;
 }
 
+// How long a broken control connection may take to come back before the
+// other side declares it lost: the worker retries with capped
+// exponential backoff inside this budget; the coordinator holds the
+// dead peer's slot open for it. 0 disables reconnect entirely (a
+// control failure then fails over immediately, the pre-chaos behavior).
+// Elastic jobs default much shorter: their supervisor rebuilds
+// membership on failure anyway, and a long hold only delays the
+// shrink rendezvous past the driver's blacklist cooldown.
+static int ReconnectWindowMs() {
+  static int ms = [] {
+    const char* elastic = std::getenv("HVD_TPU_ELASTIC");
+    double s = (elastic != nullptr && elastic[0] == '1') ? 1.0 : 5.0;
+    const char* v = std::getenv("HVD_TPU_RECONNECT_SECONDS");
+    if (v != nullptr) s = std::atof(v);
+    if (s < 0) s = 0;
+    if (s > 2147483) s = 2147483;
+    return static_cast<int>(s * 1000);
+  }();
+  return ms;
+}
+
+static const char* ChannelName(Channel c) {
+  switch (c) {
+    case Channel::CONTROL: return "control";
+    case Channel::RING: return "ring";
+    case Channel::LOCAL_RING: return "local-ring";
+    case Channel::CROSS_RING: return "cross-ring";
+  }
+  return "?";
+}
+
+void TcpContext::SetLastError(Channel chan, NetError err) {
+  last_error_ = std::string(NetErrorName(err)) + " on " +
+                ChannelName(chan) + " channel";
+}
+
 static constexpr uint32_t kTagGather = 0x11;
 static constexpr uint32_t kTagBcast = 0x12;
 static constexpr uint32_t kTagBits = 0x13;
@@ -45,7 +87,16 @@ bool TcpContext::Initialize() {
   local_size_ = EnvInt("HVD_TPU_LOCAL_SIZE", size_);
   cross_rank_ = EnvInt("HVD_TPU_CROSS_RANK", 0);
   cross_size_ = EnvInt("HVD_TPU_CROSS_SIZE", 1);
+  generation_ = static_cast<uint32_t>(EnvInt("HVD_TPU_GENERATION", 0));
   SetLogRank(rank_);
+  last_error_.clear();
+
+  // Chaos hooks (fault.h): parsed per init so an elastic re-init replays
+  // the spec from frame 0 of the new generation.
+  GlobalFaultInjector().Configure(std::getenv("HVD_TPU_FAULT_SPEC"), rank_);
+
+  my_ctrl_opseq_ = 0;
+  ctrl_opseq_.assign(static_cast<std::size_t>(size_ > 0 ? size_ : 1), 0);
 
   if (size_ == 1) {
     is_homogeneous_ = true;
@@ -71,6 +122,10 @@ bool TcpContext::Initialize() {
     LOG(ERROR) << "bad address " << addrs[rank_];
     return false;
   }
+  if (!ParseHostPort(addrs[0], &coord_host_, &coord_port_)) {
+    LOG(ERROR) << "bad coordinator address " << addrs[0];
+    return false;
+  }
   if (!listener_.Start(my_port)) return false;
 
   int timeout_ms = EnvInt("HVD_TPU_START_TIMEOUT", 60) * 1000;
@@ -83,20 +138,21 @@ bool TcpContext::Initialize() {
   std::atomic<bool> accept_ok{true};
   std::thread acceptor([&] {
     for (int i = 0; i < expected; ++i) {
-      int peer_rank;
-      Channel channel;
-      int fd = listener_.AcceptPeer(&peer_rank, &channel, timeout_ms);
+      PeerHandshake hs;
+      int fd = listener_.AcceptPeer(&hs, timeout_ms, generation_);
       if (fd < 0) {
         accept_ok.store(false);
         return;
       }
-      if (channel == Channel::RING) {
-        ring_prev_ = Conn(fd);
-      } else if (rank_ == 0 && channel == Channel::CONTROL && peer_rank >= 1 &&
-                 peer_rank < size_) {
-        control_conns_[peer_rank] = Conn(fd);
+      if (hs.channel == Channel::RING && !(hs.flags & kHandshakeReconnect)) {
+        ring_prev_ = Conn(fd, Channel::RING);
+      } else if (rank_ == 0 && hs.channel == Channel::CONTROL &&
+                 !(hs.flags & kHandshakeReconnect) && hs.rank >= 1 &&
+                 hs.rank < size_) {
+        control_conns_[hs.rank] = Conn(fd, Channel::CONTROL);
       } else {
-        LOG(ERROR) << "unexpected connection from rank " << peer_rank;
+        LOG(ERROR) << "unexpected connection from rank " << hs.rank;
+        ::close(fd);
         accept_ok.store(false);
         return;
       }
@@ -110,15 +166,14 @@ bool TcpContext::Initialize() {
     std::string host;
     int port;
     ParseHostPort(addrs[next], &host, &port);
-    ring_next_ = ConnectPeer(host, port, rank_, Channel::RING, timeout_ms);
+    ring_next_ = ConnectPeer(host, port, rank_, Channel::RING, timeout_ms,
+                             generation_);
     ok = ok && ring_next_.valid();
   }
   if (ok && rank_ != 0) {
-    std::string host;
-    int port;
-    ParseHostPort(addrs[0], &host, &port);
-    control_conns_[0] =
-        ConnectPeer(host, port, rank_, Channel::CONTROL, timeout_ms);
+    control_conns_[0] = ConnectPeer(coord_host_, coord_port_, rank_,
+                                    Channel::CONTROL, timeout_ms,
+                                    generation_);
     ok = ok && control_conns_[0].valid();
   }
   acceptor.join();
@@ -140,6 +195,7 @@ bool TcpContext::Initialize() {
 
   initialized_ = true;
   LOG(DEBUG) << "TcpContext initialized: rank " << rank_ << "/" << size_
+             << " generation " << generation_
              << (hierarchical_possible() ? " (hierarchical)" : "");
   return true;
 }
@@ -214,19 +270,19 @@ bool TcpContext::ConnectSubRings(int timeout_ms) {
   std::atomic<bool> accept_ok{true};
   std::thread acceptor([&] {
     for (int i = 0; i < expected; ++i) {
-      int peer_rank;
-      Channel channel;
-      int fd = listener_.AcceptPeer(&peer_rank, &channel, timeout_ms);
+      PeerHandshake hs;
+      int fd = listener_.AcceptPeer(&hs, timeout_ms, generation_);
       if (fd < 0) {
         accept_ok.store(false);
         return;
       }
-      if (channel == Channel::LOCAL_RING && !local_prev_.valid()) {
-        local_prev_ = Conn(fd);
-      } else if (channel == Channel::CROSS_RING && !cross_prev_.valid()) {
-        cross_prev_ = Conn(fd);
+      if (hs.channel == Channel::LOCAL_RING && !local_prev_.valid()) {
+        local_prev_ = Conn(fd, Channel::LOCAL_RING);
+      } else if (hs.channel == Channel::CROSS_RING && !cross_prev_.valid()) {
+        cross_prev_ = Conn(fd, Channel::CROSS_RING);
       } else {
-        LOG(ERROR) << "unexpected sub-ring connection from rank " << peer_rank;
+        LOG(ERROR) << "unexpected sub-ring connection from rank " << hs.rank;
+        ::close(fd);
         accept_ok.store(false);
         return;
       }
@@ -240,8 +296,8 @@ bool TcpContext::ConnectSubRings(int timeout_ms) {
     int port;
     ok = ok && next >= 0 && ParseHostPort(addrs[next], &host, &port);
     if (ok) {
-      local_next_ =
-          ConnectPeer(host, port, rank_, Channel::LOCAL_RING, timeout_ms);
+      local_next_ = ConnectPeer(host, port, rank_, Channel::LOCAL_RING,
+                                timeout_ms, generation_);
       ok = local_next_.valid();
     }
   }
@@ -251,8 +307,8 @@ bool TcpContext::ConnectSubRings(int timeout_ms) {
     int port;
     ok = ok && next >= 0 && ParseHostPort(addrs[next], &host, &port);
     if (ok) {
-      cross_next_ =
-          ConnectPeer(host, port, rank_, Channel::CROSS_RING, timeout_ms);
+      cross_next_ = ConnectPeer(host, port, rank_, Channel::CROSS_RING,
+                                timeout_ms, generation_);
       ok = cross_next_.valid();
     }
   }
@@ -263,6 +319,8 @@ bool TcpContext::ConnectSubRings(int timeout_ms) {
 void TcpContext::Finalize() {
   for (auto& c : control_conns_) c.Close();
   control_conns_.clear();
+  ctrl_opseq_.clear();
+  my_ctrl_opseq_ = 0;
   ring_next_.Close();
   ring_prev_.Close();
   local_next_.Close();
@@ -275,6 +333,98 @@ void TcpContext::Finalize() {
   initialized_ = false;
 }
 
+// ---------------- worker-side control star with reconnect ----------------
+
+bool TcpContext::ReconnectControl() {
+  if (ReconnectWindowMs() <= 0 || coord_port_ == 0) return false;
+  Metrics& metrics = GlobalMetrics();
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(ReconnectWindowMs());
+  int backoff_ms = 50;
+  int attempt = 0;
+  while (std::chrono::steady_clock::now() < deadline) {
+    ++attempt;
+    metrics.net_reconnect_attempts_total.fetch_add(1,
+                                                   std::memory_order_relaxed);
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    deadline - std::chrono::steady_clock::now())
+                    .count();
+    if (left < 1) break;
+    int attempt_ms = static_cast<int>(left < 2000 ? left : 2000);
+    Conn c = ConnectPeer(coord_host_, coord_port_, rank_, Channel::CONTROL,
+                         attempt_ms, generation_, my_ctrl_opseq_,
+                         /*reconnect=*/true);
+    if (c.valid()) {
+      control_conns_[0] = std::move(c);
+      metrics.net_reconnects_total.fetch_add(1, std::memory_order_relaxed);
+      LOG(WARNING) << "control connection re-established to coordinator "
+                   << "(attempt " << attempt << ", opseq "
+                   << my_ctrl_opseq_ << ", generation " << generation_
+                   << ")";
+      return true;
+    }
+    // Capped exponential backoff: fast first retries for a blip, bounded
+    // pressure on a coordinator digging out from under a failure.
+    std::this_thread::sleep_for(std::chrono::milliseconds(backoff_ms));
+    backoff_ms = backoff_ms * 2 > 1000 ? 1000 : backoff_ms * 2;
+  }
+  LOG(ERROR) << "control reconnect failed after " << attempt
+             << " attempt(s) — giving up (elastic recovery takes over)";
+  return false;
+}
+
+bool TcpContext::ControlSendFrame(uint32_t tag, const void* payload,
+                                  std::size_t len) {
+  while (true) {
+    if (control_conns_[0].SendFrame(tag, payload, len)) {
+      ++my_ctrl_opseq_;
+      return true;
+    }
+    NetError err = control_conns_[0].last_error();
+    SetLastError(Channel::CONTROL, err);
+    // Only a broken CONNECTION is worth a reconnect; a deadline or
+    // checksum failure means the stream itself is unrecoverable.
+    if (err != NetError::CLOSED || !ReconnectControl()) return false;
+  }
+}
+
+bool TcpContext::ControlRecvFrame(uint32_t expect_tag, std::string* payload) {
+  while (true) {
+    uint32_t tag;
+    if (control_conns_[0].RecvFrame(&tag, payload)) {
+      if (tag != expect_tag) {
+        LOG(ERROR) << "control frame: unexpected tag " << tag;
+        SetLastError(Channel::CONTROL, NetError::PROTOCOL);
+        return false;
+      }
+      ++my_ctrl_opseq_;
+      return true;
+    }
+    NetError err = control_conns_[0].last_error();
+    SetLastError(Channel::CONTROL, err);
+    if (err != NetError::CLOSED || !ReconnectControl()) return false;
+  }
+}
+
+bool TcpContext::ControlRecvFrameInto(uint32_t expect_tag, void* buf,
+                                      std::size_t len) {
+  while (true) {
+    uint32_t tag;
+    if (control_conns_[0].RecvFrameInto(&tag, buf, len)) {
+      if (tag != expect_tag) {
+        LOG(ERROR) << "control frame: unexpected tag " << tag;
+        SetLastError(Channel::CONTROL, NetError::PROTOCOL);
+        return false;
+      }
+      ++my_ctrl_opseq_;
+      return true;
+    }
+    NetError err = control_conns_[0].last_error();
+    SetLastError(Channel::CONTROL, err);
+    if (err != NetError::CLOSED || !ReconnectControl()) return false;
+  }
+}
+
 // ---------------- poll-multiplexed control star (rank 0) ----------------
 //
 // The reference's coordinator leans on MPI_Gatherv/MPI_Bcast, which the MPI
@@ -282,95 +432,263 @@ void TcpContext::Finalize() {
 // serialize the whole negotiation through rank 0 (the SURVEY §7.3
 // "negotiation latency at 256 chips" wall). These helpers service every
 // worker socket concurrently with one poll loop.
+//
+// Peer-failure handling: a worker whose socket breaks mid-frame is NOT
+// immediately fatal — its slot is held open for ReconnectWindowMs while
+// the listener waits for a RECONNECT handshake carrying the matching
+// (generation, opseq) cursor; the in-flight frame then restarts from
+// byte 0 on both sides. A worker that never comes back (process death)
+// fails the op when its window expires, which is what hands control to
+// the elastic recovery path.
 
 namespace {
 
 struct FrameRecvState {
-  char header[12];
+  char header[kFrameHeaderBytes];
   std::size_t hoff = 0;
   std::string payload;
   std::size_t poff = 0;
   uint32_t tag = 0;
+  uint32_t crc = 0;
   bool have_header = false;
   bool done = false;
+  // One injector consult per frame, even when the first poll wakeups
+  // drain zero bytes (EAGAIN) — repeated consults would skew the
+  // deterministic frame counters.
+  bool fault_checked = false;
+  // Injected recv-corruption: applied to the payload just before the
+  // checksum verify (same semantics as Conn::RecvFrame).
+  bool corrupt = false;
+
+  void Restart() {
+    hoff = 0;
+    poff = 0;
+    payload.clear();
+    have_header = false;
+    fault_checked = false;
+    corrupt = false;
+  }
 };
 
 struct FrameSendState {
-  char header[12];
+  char header[kFrameHeaderBytes];
   std::size_t hoff = 0;
   const char* payload = nullptr;
   std::size_t len = 0;
   std::size_t poff = 0;
   bool done = false;
+  bool fault_checked = false;
+
+  void Restart() {
+    hoff = 0;
+    poff = 0;
+    fault_checked = false;
+  }
 };
 
 }  // namespace
+
+int TcpContext::TryAcceptControlReconnect(const std::vector<bool>& dead) {
+  PeerHandshake hs;
+  // Short accept window: the listener was already readable, so this is
+  // bounded by the handshake read (silent clients get dropped inside).
+  int fd = listener_.AcceptPeer(&hs, 100, generation_);
+  if (fd < 0) return 0;
+  char verdict = 0;
+  if (hs.channel != Channel::CONTROL || !(hs.flags & kHandshakeReconnect) ||
+      hs.rank < 1 || hs.rank >= size_ ||
+      !dead[static_cast<std::size_t>(hs.rank)]) {
+    LOG(WARNING) << "rejecting unexpected control connection from rank "
+                 << hs.rank << " (not awaiting reconnect)";
+    ::send(fd, &verdict, 1, MSG_NOSIGNAL);
+    ::close(fd);
+    return 0;
+  }
+  if (hs.opseq != ctrl_opseq_[static_cast<std::size_t>(hs.rank)]) {
+    // The two sides disagree about which frame is in flight (e.g. a
+    // response was fully sent but never received). Resuming would
+    // desync the lockstep protocol — reject into elastic recovery.
+    LOG(ERROR) << "control reconnect from rank " << hs.rank
+               << " desynced: its opseq " << hs.opseq << " != expected "
+               << ctrl_opseq_[static_cast<std::size_t>(hs.rank)]
+               << " — failing over";
+    ::send(fd, &verdict, 1, MSG_NOSIGNAL);
+    ::close(fd);
+    last_error_ = "control reconnect resume cursor mismatch (desynced "
+                  "worker) on control channel";
+    return -1;
+  }
+  verdict = 1;
+  if (::send(fd, &verdict, 1, MSG_NOSIGNAL) != 1) {
+    ::close(fd);
+    return 0;
+  }
+  control_conns_[static_cast<std::size_t>(hs.rank)] =
+      Conn(fd, Channel::CONTROL);
+  LOG(WARNING) << "accepted control reconnect from rank " << hs.rank
+               << " (opseq " << hs.opseq << ")";
+  return hs.rank;
+}
 
 bool TcpContext::MultiRecvFrames(uint32_t expect_tag,
                                  std::vector<std::string>* blobs) {
   int n = size_ - 1;  // workers 1..size_-1
   std::vector<FrameRecvState> st(static_cast<std::size_t>(n));
+  std::vector<bool> dead(static_cast<std::size_t>(size_), false);
+  std::vector<std::chrono::steady_clock::time_point> dead_deadline(
+      static_cast<std::size_t>(size_));
   int remaining = n;
+  int num_dead = 0;
+  FaultInjector& inj = GlobalFaultInjector();
   std::vector<struct pollfd> pfds;
   std::vector<int> idx;
+
+  // Declares worker w's connection broken: hold its slot open for the
+  // reconnect window (restarting its frame), or fail the op when
+  // reconnect is disabled.
+  auto peer_down = [&](int w, NetError err) -> bool {
+    SetLastError(Channel::CONTROL, err);
+    if (err != NetError::CLOSED || ReconnectWindowMs() <= 0) return false;
+    control_conns_[w + 1].Close();
+    dead[static_cast<std::size_t>(w + 1)] = true;
+    dead_deadline[static_cast<std::size_t>(w + 1)] =
+        std::chrono::steady_clock::now() +
+        std::chrono::milliseconds(ReconnectWindowMs());
+    ++num_dead;
+    st[w].Restart();
+    LOG(WARNING) << "control connection to rank " << w + 1
+                 << " lost mid-gather; holding its slot for reconnect";
+    return true;
+  };
+
   while (remaining > 0) {
+    auto now = std::chrono::steady_clock::now();
+    for (int w = 1; w < size_; ++w) {
+      if (dead[static_cast<std::size_t>(w)] &&
+          now >= dead_deadline[static_cast<std::size_t>(w)]) {
+        LOG(ERROR) << "rank " << w << " did not reconnect within "
+                   << ReconnectWindowMs() << "ms — connection lost";
+        last_error_ = "peer did not reconnect within the window on "
+                      "control channel";
+        return false;
+      }
+    }
     pfds.clear();
     idx.clear();
     for (int i = 0; i < n; ++i) {
-      if (!st[i].done) {
+      if (!st[i].done && !dead[static_cast<std::size_t>(i + 1)]) {
         pfds.push_back({control_conns_[i + 1].fd(), POLLIN, 0});
         idx.push_back(i);
       }
     }
-    if (::poll(pfds.data(), pfds.size(), ControlPollMs()) <= 0) {
+    if (num_dead > 0) {
+      pfds.push_back({listener_.fd(), POLLIN, 0});
+      idx.push_back(-1);
+    }
+    int wait_ms = ControlPollMs();
+    if (num_dead > 0 && wait_ms > 200) wait_ms = 200;  // re-check windows
+    int pr = ::poll(pfds.data(), pfds.size(), wait_ms);
+    if (pr < 0 && errno == EINTR) continue;
+    if (pr < 0 || (pr == 0 && num_dead == 0)) {
       LOG(ERROR) << "control gather poll timeout/error";
+      SetLastError(Channel::CONTROL, NetError::TIMEOUT);
       return false;
     }
     for (std::size_t k = 0; k < pfds.size(); ++k) {
+      if (idx[k] < 0) {
+        if (pfds[k].revents & POLLIN) {
+          int back = TryAcceptControlReconnect(dead);
+          if (back < 0) return false;
+          if (back > 0) {
+            dead[static_cast<std::size_t>(back)] = false;
+            --num_dead;
+            st[back - 1].Restart();
+          }
+        }
+        continue;
+      }
       if (!(pfds[k].revents & (POLLIN | POLLERR | POLLHUP))) continue;
       int i = idx[k];
       auto& s = st[i];
       int fd = control_conns_[i + 1].fd();
       if (!s.have_header) {
+        if (!s.fault_checked && inj.active()) {
+          // Coordinator-side chaos hook, once per frame start.
+          s.fault_checked = true;
+          FaultDecision d = inj.OnFrame(Channel::CONTROL, /*send=*/false);
+          if (d.action == FaultAction::DELAY ||
+              d.action == FaultAction::STALL) {
+            std::this_thread::sleep_for(
+                std::chrono::milliseconds(d.delay_ms));
+          } else if (d.action == FaultAction::CLOSE) {
+            if (!peer_down(i, NetError::CLOSED)) return false;
+            continue;
+          } else if (d.action == FaultAction::CORRUPT) {
+            s.corrupt = true;
+          }
+        }
         ssize_t r = ::recv(fd, s.header + s.hoff, sizeof(s.header) - s.hoff,
                            MSG_DONTWAIT);
-        if (r == 0) return false;
-        if (r < 0) {
-          if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
-            continue;
-          return false;
+        if (r == 0 || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                       errno != EINTR)) {
+          if (!peer_down(i, NetError::CLOSED)) return false;
+          continue;
         }
+        if (r < 0) continue;
         s.hoff += static_cast<std::size_t>(r);
         if (s.hoff == sizeof(s.header)) {
           uint64_t len;
-          std::memcpy(&s.tag, s.header, 4);
-          std::memcpy(&len, s.header + 4, 8);
+          ParseFrameHeader(s.header, &s.tag, &len, &s.crc);
           if (s.tag != expect_tag) {
             LOG(ERROR) << "control gather: unexpected tag " << s.tag;
+            SetLastError(Channel::CONTROL, NetError::PROTOCOL);
+            return false;
+          }
+          if (len > MaxFrameBytes()) {
+            LOG(ERROR) << "control gather: frame length " << len
+                       << " exceeds max " << MaxFrameBytes();
+            SetLastError(Channel::CONTROL, NetError::TOO_BIG);
+            GlobalMetrics().net_oversize_frames_total.fetch_add(
+                1, std::memory_order_relaxed);
             return false;
           }
           s.payload.resize(static_cast<std::size_t>(len));
           s.have_header = true;
-          if (len == 0) {
-            s.done = true;
-            --remaining;
-          }
         }
       }
-      if (s.have_header && !s.done) {
+      if (s.have_header && !s.done && s.poff < s.payload.size()) {
         ssize_t r = ::recv(fd, &s.payload[s.poff], s.payload.size() - s.poff,
                            MSG_DONTWAIT);
-        if (r == 0) return false;
-        if (r < 0) {
-          if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
-            continue;
+        if (r == 0 || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                       errno != EINTR)) {
+          if (!peer_down(i, NetError::CLOSED)) return false;
+          continue;
+        }
+        if (r < 0) continue;
+        s.poff += static_cast<std::size_t>(r);
+      }
+      if (s.have_header && !s.done && s.poff == s.payload.size()) {
+        uint64_t len = s.payload.size();
+        if (s.corrupt) {
+          if (len > 0) {
+            s.payload[len / 2] ^= 0x20;
+          } else {
+            s.crc ^= 0x1;
+          }
+        }
+        if (NetCrcEnabled() &&
+            FrameCrc(s.tag, len, s.payload.data(), s.payload.size()) !=
+                s.crc) {
+          LOG(ERROR) << "control gather: checksum mismatch from rank "
+                     << i + 1 << " — corrupted frame detected";
+          SetLastError(Channel::CONTROL, NetError::CRC);
+          GlobalMetrics().net_crc_errors_total.fetch_add(
+              1, std::memory_order_relaxed);
           return false;
         }
-        s.poff += static_cast<std::size_t>(r);
-        if (s.poff == s.payload.size()) {
-          s.done = true;
-          --remaining;
-        }
+        s.done = true;
+        --remaining;
+        ++ctrl_opseq_[static_cast<std::size_t>(i + 1)];
       }
     }
   }
@@ -388,39 +706,119 @@ bool TcpContext::MultiSendFrames(
   for (int i = 0; i < n; ++i) {
     auto& s = st[i];
     uint64_t len = payloads[i].second;
-    std::memcpy(s.header, &tag, 4);
-    std::memcpy(s.header + 4, &len, 8);
+    BuildFrameHeader(s.header, tag, len,
+                     FrameCrc(tag, len, payloads[i].first, len));
     s.payload = static_cast<const char*>(payloads[i].first);
     s.len = payloads[i].second;
   }
+  std::vector<bool> dead(static_cast<std::size_t>(size_), false);
+  std::vector<std::chrono::steady_clock::time_point> dead_deadline(
+      static_cast<std::size_t>(size_));
   int remaining = n;
+  int num_dead = 0;
+  FaultInjector& inj = GlobalFaultInjector();
   std::vector<struct pollfd> pfds;
   std::vector<int> idx;
+
+  auto peer_down = [&](int w, NetError err) -> bool {
+    SetLastError(Channel::CONTROL, err);
+    if (err != NetError::CLOSED || ReconnectWindowMs() <= 0) return false;
+    control_conns_[w + 1].Close();
+    dead[static_cast<std::size_t>(w + 1)] = true;
+    dead_deadline[static_cast<std::size_t>(w + 1)] =
+        std::chrono::steady_clock::now() +
+        std::chrono::milliseconds(ReconnectWindowMs());
+    ++num_dead;
+    st[w].Restart();
+    LOG(WARNING) << "control connection to rank " << w + 1
+                 << " lost mid-bcast; holding its slot for reconnect";
+    return true;
+  };
+
   while (remaining > 0) {
+    auto now = std::chrono::steady_clock::now();
+    for (int w = 1; w < size_; ++w) {
+      if (dead[static_cast<std::size_t>(w)] &&
+          now >= dead_deadline[static_cast<std::size_t>(w)]) {
+        LOG(ERROR) << "rank " << w << " did not reconnect within "
+                   << ReconnectWindowMs() << "ms — connection lost";
+        last_error_ = "peer did not reconnect within the window on "
+                      "control channel";
+        return false;
+      }
+    }
     pfds.clear();
     idx.clear();
     for (int i = 0; i < n; ++i) {
-      if (!st[i].done) {
+      if (!st[i].done && !dead[static_cast<std::size_t>(i + 1)]) {
         pfds.push_back({control_conns_[i + 1].fd(), POLLOUT, 0});
         idx.push_back(i);
       }
     }
-    if (::poll(pfds.data(), pfds.size(), ControlPollMs()) <= 0) {
+    if (num_dead > 0) {
+      pfds.push_back({listener_.fd(), POLLIN, 0});
+      idx.push_back(-1);
+    }
+    int wait_ms = ControlPollMs();
+    if (num_dead > 0 && wait_ms > 200) wait_ms = 200;
+    int pr = ::poll(pfds.data(), pfds.size(), wait_ms);
+    if (pr < 0 && errno == EINTR) continue;
+    if (pr < 0 || (pr == 0 && num_dead == 0)) {
       LOG(ERROR) << "control bcast poll timeout/error";
+      SetLastError(Channel::CONTROL, NetError::TIMEOUT);
       return false;
     }
     for (std::size_t k = 0; k < pfds.size(); ++k) {
-      if (!(pfds[k].revents & (POLLOUT | POLLERR))) continue;
+      if (idx[k] < 0) {
+        if (pfds[k].revents & POLLIN) {
+          int back = TryAcceptControlReconnect(dead);
+          if (back < 0) return false;
+          if (back > 0) {
+            dead[static_cast<std::size_t>(back)] = false;
+            --num_dead;
+            st[back - 1].Restart();
+          }
+        }
+        continue;
+      }
+      if (!(pfds[k].revents & (POLLOUT | POLLERR | POLLHUP))) continue;
       int i = idx[k];
       auto& s = st[i];
       int fd = control_conns_[i + 1].fd();
+      if (!s.fault_checked && inj.active()) {
+        s.fault_checked = true;
+        FaultDecision d = inj.OnFrame(Channel::CONTROL, /*send=*/true);
+        switch (d.action) {
+          case FaultAction::DROP:
+            s.done = true;  // never sent: the worker's deadline fires
+            --remaining;
+            ++ctrl_opseq_[static_cast<std::size_t>(i + 1)];
+            continue;
+          case FaultAction::DELAY:
+          case FaultAction::STALL:
+            std::this_thread::sleep_for(
+                std::chrono::milliseconds(d.delay_ms));
+            break;
+          case FaultAction::CLOSE:
+            if (!peer_down(i, NetError::CLOSED)) return false;
+            continue;
+          case FaultAction::CORRUPT:
+            // Flip a CRC byte in this worker's header copy: the wire
+            // carries a checksum that no longer matches the payload.
+            s.header[12] = static_cast<char>(s.header[12] ^ 0x1);
+            break;
+          case FaultAction::NONE:
+            break;
+        }
+      }
       if (s.hoff < sizeof(s.header)) {
         ssize_t w = ::send(fd, s.header + s.hoff, sizeof(s.header) - s.hoff,
                            MSG_NOSIGNAL | MSG_DONTWAIT);
         if (w < 0) {
           if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
             continue;
-          return false;
+          if (!peer_down(i, NetError::CLOSED)) return false;
+          continue;
         }
         s.hoff += static_cast<std::size_t>(w);
         if (s.hoff < sizeof(s.header)) continue;
@@ -431,21 +829,20 @@ bool TcpContext::MultiSendFrames(
         if (w < 0) {
           if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
             continue;
-          return false;
+          if (!peer_down(i, NetError::CLOSED)) return false;
+          continue;
         }
         s.poff += static_cast<std::size_t>(w);
       }
       if (s.poff == s.len) {
         s.done = true;
         --remaining;
+        ++ctrl_opseq_[static_cast<std::size_t>(i + 1)];
       }
     }
   }
   return true;
 }
-
-// Control frames are 12 bytes of header (4 tag + 8 length) + payload.
-static constexpr uint64_t kFrameHeaderBytes = 12;
 
 bool TcpContext::GatherBlobs(const std::string& mine,
                              std::vector<std::string>* all) {
@@ -465,7 +862,7 @@ bool TcpContext::GatherBlobs(const std::string& mine,
     ctrl_msgs_ += size_ - 1;
     return true;
   }
-  if (!control_conns_[0].SendFrame(kTagGather, mine)) return false;
+  if (!ControlSendFrame(kTagGather, mine.data(), mine.size())) return false;
   ctrl_bytes_sent_ += mine.size() + kFrameHeaderBytes;
   ctrl_msgs_ += 1;
   return true;
@@ -483,10 +880,7 @@ bool TcpContext::BroadcastBlob(std::string* blob) {
     ctrl_msgs_ += size_ - 1;
     return true;
   }
-  uint32_t tag;
-  if (!(control_conns_[0].RecvFrame(&tag, blob) && tag == kTagBcast)) {
-    return false;
-  }
+  if (!ControlRecvFrame(kTagBcast, blob)) return false;
   ctrl_bytes_recv_ += blob->size() + kFrameHeaderBytes;
   ctrl_msgs_ += 1;
   return true;
@@ -517,10 +911,8 @@ bool TcpContext::BitwiseSync(std::vector<uint64_t>& bits, bool is_or) {
     ctrl_msgs_ += 2 * uint64_t(size_ - 1);
     return true;
   }
-  uint32_t tag;
-  if (!(control_conns_[0].SendFrame(kTagBits, bits.data(), nbytes) &&
-        control_conns_[0].RecvFrameInto(&tag, bits.data(), nbytes) &&
-        tag == kTagBits)) {
+  if (!(ControlSendFrame(kTagBits, bits.data(), nbytes) &&
+        ControlRecvFrameInto(kTagBits, bits.data(), nbytes))) {
     return false;
   }
   ctrl_bytes_sent_ += nbytes + kFrameHeaderBytes;
@@ -565,12 +957,15 @@ bool TcpContext::RingExchangeOn(Ring ring, const void* send_buf,
                                 std::size_t recv_len) {
   Conn* next = &ring_next_;
   Conn* prev = &ring_prev_;
+  Channel chan = Channel::RING;
   if (ring == Ring::LOCAL) {
     next = &local_next_;
     prev = &local_prev_;
+    chan = Channel::LOCAL_RING;
   } else if (ring == Ring::CROSS) {
     next = &cross_next_;
     prev = &cross_prev_;
+    chan = Channel::CROSS_RING;
   }
   if (RingSize(ring) == 1) {
     if (recv_len > 0 && recv_buf != send_buf) {
@@ -582,24 +977,66 @@ bool TcpContext::RingExchangeOn(Ring ring, const void* send_buf,
     LOG(ERROR) << "ring exchange on unconnected ring";
     return false;
   }
+
+  // Chaos hooks, once per exchange per direction. corrupt flips the
+  // outgoing header's CRC byte (the payload is the caller's gradient
+  // buffer — never mutated); close/stall exercise the peer's deadline.
+  bool corrupt_out = false;
+  FaultInjector& inj = GlobalFaultInjector();
+  if (inj.active()) {
+    FaultDecision d = inj.OnFrame(chan, /*send=*/true);
+    switch (d.action) {
+      case FaultAction::DELAY:
+      case FaultAction::STALL:
+        std::this_thread::sleep_for(std::chrono::milliseconds(d.delay_ms));
+        break;
+      case FaultAction::CLOSE:
+        next->Close();
+        break;
+      case FaultAction::CORRUPT:
+        corrupt_out = true;
+        break;
+      case FaultAction::DROP:
+        // Dropping a ring frame = never sending it; the peer's recv
+        // deadline fires. Model it as closing our send side silently.
+        next->Close();
+        break;
+      case FaultAction::NONE:
+        break;
+    }
+  }
+
   // Frame headers first (blocking, tiny), then pump payloads full-duplex so
   // a ring of simultaneous large sends can't deadlock on socket buffers.
-  char shdr[12];
+  // The send CRC covers the whole payload (computed up front — one pass
+  // over the buffer); the receive side accumulates incrementally as
+  // chunks arrive and verifies at the end, so a corrupted frame becomes
+  // a detected error, never silently wrong gradients.
   uint64_t slen = send_len;
-  std::memcpy(shdr, &kTagRing, 4);
-  std::memcpy(shdr + 4, &slen, 8);
-  if (!next->SendAll(shdr, 12)) return false;
-  char rhdr[12];
-  if (!prev->RecvAll(rhdr, 12)) return false;
+  uint32_t scrc = FrameCrc(kTagRing, slen, send_buf, send_len);
+  if (corrupt_out) scrc ^= 0x1;
+  char shdr[kFrameHeaderBytes];
+  BuildFrameHeader(shdr, kTagRing, slen, scrc);
+  if (!next->SendAll(shdr, sizeof(shdr))) {
+    SetLastError(chan, next->last_error());
+    return false;
+  }
+  char rhdr[kFrameHeaderBytes];
+  if (!prev->RecvAll(rhdr, sizeof(rhdr))) {
+    SetLastError(chan, prev->last_error());
+    return false;
+  }
   uint32_t rtag;
   uint64_t rlen;
-  std::memcpy(&rtag, rhdr, 4);
-  std::memcpy(&rlen, rhdr + 4, 8);
+  uint32_t rcrc;
+  ParseFrameHeader(rhdr, &rtag, &rlen, &rcrc);
   if (rtag != kTagRing || rlen != recv_len) {
     LOG(ERROR) << "ring exchange mismatch: tag " << rtag << " len " << rlen
                << " expected " << recv_len;
+    SetLastError(chan, NetError::PROTOCOL);
     return false;
   }
+  uint32_t crc_acc = NetCrcEnabled() ? FrameHeaderCrc(rtag, rlen) : 0;
 
   const char* sp = static_cast<const char*>(send_buf);
   char* rp = static_cast<char*>(recv_buf);
@@ -618,12 +1055,14 @@ bool TcpContext::RingExchangeOn(Ring ring, const void* send_buf,
     }
     if (::poll(pfds, n, ControlPollMs()) <= 0) {
       LOG(ERROR) << "ring exchange poll timeout/error";
+      SetLastError(chan, NetError::TIMEOUT);
       return false;
     }
     if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR))) {
       ssize_t w = ::send(next->fd(), sp + sent, send_len - sent,
                          MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        SetLastError(chan, NetError::CLOSED);
         return false;
       }
       if (w > 0) sent += static_cast<std::size_t>(w);
@@ -631,12 +1070,30 @@ bool TcpContext::RingExchangeOn(Ring ring, const void* send_buf,
     if (recv_idx >= 0 && (pfds[recv_idx].revents & (POLLIN | POLLERR))) {
       ssize_t r = ::recv(prev->fd(), rp + received, recv_len - received,
                          MSG_DONTWAIT);
-      if (r == 0) return false;
-      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+      if (r == 0) {
+        SetLastError(chan, NetError::CLOSED);
         return false;
       }
-      if (r > 0) received += static_cast<std::size_t>(r);
+      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        SetLastError(chan, NetError::CLOSED);
+        return false;
+      }
+      if (r > 0) {
+        if (NetCrcEnabled()) {
+          crc_acc = Crc32c(rp + received, static_cast<std::size_t>(r),
+                           crc_acc);
+        }
+        received += static_cast<std::size_t>(r);
+      }
     }
+  }
+  if (NetCrcEnabled() && crc_acc != rcrc) {
+    LOG(ERROR) << "ring exchange checksum mismatch (" << recv_len
+               << " bytes) — corrupted frame detected";
+    SetLastError(chan, NetError::CRC);
+    GlobalMetrics().net_crc_errors_total.fetch_add(1,
+                                                   std::memory_order_relaxed);
+    return false;
   }
   return true;
 }
@@ -645,13 +1102,60 @@ bool TcpContext::RingBroadcast(void* buf, std::size_t len, int root) {
   if (size_ == 1 || len == 0) return true;
   int next = (rank_ + 1) % size_;
   char* p = static_cast<char*>(buf);
+  uint64_t len64 = len;
   if (rank_ == root) {
-    // Root only streams downstream (size_ > 1 so next != root).
-    return ring_next_.SendAll(p, len);
+    // Root only streams downstream (size_ > 1 so next != root). One
+    // frame header up front carries the CRC every hop verifies.
+    uint32_t crc = FrameCrc(kTagRing, len64, p, len);
+    FaultInjector& inj = GlobalFaultInjector();
+    if (inj.active()) {
+      FaultDecision d = inj.OnFrame(Channel::RING, /*send=*/true);
+      if (d.action == FaultAction::DELAY || d.action == FaultAction::STALL) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(d.delay_ms));
+      } else if (d.action == FaultAction::CLOSE ||
+                 d.action == FaultAction::DROP) {
+        ring_next_.Close();
+      } else if (d.action == FaultAction::CORRUPT) {
+        crc ^= 0x1;
+      }
+    }
+    char hdr[kFrameHeaderBytes];
+    BuildFrameHeader(hdr, kTagRing, len64, crc);
+    if (!ring_next_.SendAll(hdr, sizeof(hdr)) ||
+        !ring_next_.SendAll(p, len)) {
+      SetLastError(Channel::RING, ring_next_.last_error());
+      return false;
+    }
+    return true;
   }
-  // Non-root: stream from the predecessor, forwarding bytes as they arrive
-  // (cut-through, not store-and-forward — total time ~ len/BW + hop latency).
+  // Non-root: read the header, forward it downstream if we forward at
+  // all, then stream from the predecessor, forwarding bytes as they
+  // arrive (cut-through, not store-and-forward — total time ~ len/BW +
+  // hop latency). The CRC is verified at the END on every hop: bytes
+  // already forwarded may be corrupt, but every downstream hop detects
+  // the same mismatch, so corruption surfaces as a detected error
+  // everywhere, never as silently wrong data.
+  char rhdr[kFrameHeaderBytes];
+  if (!ring_prev_.RecvAll(rhdr, sizeof(rhdr))) {
+    SetLastError(Channel::RING, ring_prev_.last_error());
+    return false;
+  }
+  uint32_t rtag;
+  uint64_t rlen;
+  uint32_t rcrc;
+  ParseFrameHeader(rhdr, &rtag, &rlen, &rcrc);
+  if (rtag != kTagRing || rlen != len64) {
+    LOG(ERROR) << "ring broadcast mismatch: tag " << rtag << " len " << rlen
+               << " expected " << len64;
+    SetLastError(Channel::RING, NetError::PROTOCOL);
+    return false;
+  }
   bool forward = next != root;
+  if (forward && !ring_next_.SendAll(rhdr, sizeof(rhdr))) {
+    SetLastError(Channel::RING, ring_next_.last_error());
+    return false;
+  }
+  uint32_t crc_acc = NetCrcEnabled() ? FrameHeaderCrc(rtag, rlen) : 0;
   std::size_t received = 0, sent = 0;
   while (received < len || (forward && sent < len)) {
     struct pollfd pfds[2];
@@ -668,25 +1172,45 @@ bool TcpContext::RingBroadcast(void* buf, std::size_t len, int root) {
     if (n == 0) break;
     if (::poll(pfds, n, ControlPollMs()) <= 0) {
       LOG(ERROR) << "ring broadcast poll timeout/error";
+      SetLastError(Channel::RING, NetError::TIMEOUT);
       return false;
     }
     if (recv_idx >= 0 && (pfds[recv_idx].revents & (POLLIN | POLLERR))) {
       ssize_t r = ::recv(ring_prev_.fd(), p + received, len - received,
                          MSG_DONTWAIT);
-      if (r == 0) return false;
-      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+      if (r == 0) {
+        SetLastError(Channel::RING, NetError::CLOSED);
         return false;
       }
-      if (r > 0) received += static_cast<std::size_t>(r);
+      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        SetLastError(Channel::RING, NetError::CLOSED);
+        return false;
+      }
+      if (r > 0) {
+        if (NetCrcEnabled()) {
+          crc_acc = Crc32c(p + received, static_cast<std::size_t>(r),
+                           crc_acc);
+        }
+        received += static_cast<std::size_t>(r);
+      }
     }
     if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR))) {
       ssize_t w = ::send(ring_next_.fd(), p + sent, received - sent,
                          MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        SetLastError(Channel::RING, NetError::CLOSED);
         return false;
       }
       if (w > 0) sent += static_cast<std::size_t>(w);
     }
+  }
+  if (NetCrcEnabled() && crc_acc != rcrc) {
+    LOG(ERROR) << "ring broadcast checksum mismatch (" << len
+               << " bytes) — corrupted frame detected";
+    SetLastError(Channel::RING, NetError::CRC);
+    GlobalMetrics().net_crc_errors_total.fetch_add(1,
+                                                   std::memory_order_relaxed);
+    return false;
   }
   return true;
 }

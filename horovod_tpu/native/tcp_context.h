@@ -11,6 +11,14 @@
 //   HVD_TPU_RANK / HVD_TPU_SIZE / HVD_TPU_LOCAL_RANK / HVD_TPU_LOCAL_SIZE /
 //   HVD_TPU_CROSS_RANK / HVD_TPU_CROSS_SIZE
 //   HVD_TPU_ADDRS = host:port per rank, comma-separated, index == rank.
+//
+// Failure discipline (docs/CHAOS.md): every frame is CRC32C-checked, all
+// sockets carry I/O deadlines and keepalive, and the worker side of the
+// control star survives a dropped connection by reconnecting to the
+// coordinator with capped exponential backoff — the handshake echoes the
+// elastic generation and this side's completed control-frame count, so a
+// stale worker can never splice into a newer ring and a desynced resume
+// is rejected into the ordinary elastic recovery path.
 #ifndef HVD_TPU_TCP_CONTEXT_H
 #define HVD_TPU_TCP_CONTEXT_H
 
@@ -41,6 +49,15 @@ class TcpContext {
   int local_size() const { return local_size_; }
   int cross_rank() const { return cross_rank_; }
   int cross_size() const { return cross_size_; }
+  // Elastic generation this communicator was built for (HVD_TPU_GENERATION).
+  uint32_t generation() const { return generation_; }
+
+  // Human-readable cause of the most recent transport failure on this
+  // context ("frame checksum mismatch on control channel", "recv
+  // deadline expired on ring channel", ...). Read by the controller to
+  // build recoverable-error messages that NAME the failure; background
+  // thread only.
+  const std::string& last_error() const { return last_error_; }
 
   // True when every rank reported the same local/cross sizes and the
   // (local_rank, cross_rank) grid is complete — the precondition for the
@@ -85,7 +102,7 @@ class TcpContext {
   bool RingBroadcast(void* buf, std::size_t len, int root);
 
   // --- control-plane protocol accounting ---
-  // Bytes/messages THIS rank moved on the control star (12-byte frame
+  // Bytes/messages THIS rank moved on the control star (16-byte frame
   // headers included; data-ring traffic is not counted — these isolate
   // the NEGOTIATION cost, the quantity the response-cache fast path
   // exists to shrink; reference design goal: response_cache.cc:308-409).
@@ -111,6 +128,29 @@ class TcpContext {
                        const std::vector<std::pair<const void*, std::size_t>>&
                            payloads);
 
+  // --- worker-side control star with reconnect ---
+  // Frame-granular control I/O: on a CLOSED connection these reconnect
+  // to the coordinator with capped exponential backoff (up to
+  // HVD_TPU_RECONNECT_SECONDS) and retry the frame; checksum/deadline/
+  // oversize failures are NOT retried (the frame stream is unrecoverable
+  // — that is the elastic layer's job). Each completed frame bumps
+  // my_ctrl_opseq_, the resume cursor the reconnect handshake carries.
+  bool ControlSendFrame(uint32_t tag, const void* payload, std::size_t len);
+  bool ControlRecvFrame(uint32_t expect_tag, std::string* payload);
+  bool ControlRecvFrameInto(uint32_t expect_tag, void* buf, std::size_t len);
+  bool ReconnectControl();
+
+  // --- coordinator-side reconnect acceptance ---
+  // Accepts a pending control reconnect on the listener, validates its
+  // (rank, generation, opseq) against `expect_opseq_for` (per-rank
+  // expected resume cursor) and the dead-peer mask, sends the verdict
+  // byte, and swaps the new Conn in. Returns the reconnected worker
+  // index (1..size-1), 0 when nothing usable was accepted, or -1 on a
+  // fatal desync (the job must fail over).
+  int TryAcceptControlReconnect(const std::vector<bool>& dead);
+
+  void SetLastError(Channel chan, NetError err);
+
   int rank_ = 0;
   int size_ = 1;
   int local_rank_ = 0;
@@ -119,6 +159,7 @@ class TcpContext {
   int cross_size_ = 1;
   bool is_homogeneous_ = false;
   bool initialized_ = false;
+  uint32_t generation_ = 0;
 
   std::atomic<uint64_t> ctrl_bytes_sent_{0};
   std::atomic<uint64_t> ctrl_bytes_recv_{0};
@@ -130,6 +171,16 @@ class TcpContext {
   Listener listener_;
   // Rank 0: control_conns_[r] for r=1..N-1; workers: control_conns_[0].
   std::vector<Conn> control_conns_;
+  // Completed control-frame counts: the coordinator tracks one cursor
+  // per worker; a worker tracks its own in my_ctrl_opseq_. A reconnect
+  // resumes only when the two cursors agree (both sides then retry the
+  // same in-flight frame from its first byte).
+  std::vector<uint64_t> ctrl_opseq_;
+  uint64_t my_ctrl_opseq_ = 0;
+  std::string coord_host_;  // rank 0's address, kept for reconnects
+  int coord_port_ = 0;
+  std::string last_error_;
+
   Conn ring_next_;        // connected to (rank+1) % size
   Conn ring_prev_;        // accepted from (rank-1+size) % size
   Conn local_next_;       // successor within my host's local ring

@@ -49,39 +49,57 @@ void TcpController::Initialize() {
 }
 
 // Control-plane failures mean a peer went away mid-protocol (EOF/reset on
-// the star). Throwing ConnectionLostError (instead of the previous
-// LOG(FATAL) abort) lets the background loop fail outstanding work with a
-// recoverable status so Python can roll back and re-initialize for a new
-// generation — the core of elastic fault tolerance.
+// the star) — or, post-chaos-hardening, that a frame failed its checksum
+// or an I/O deadline expired. Throwing ConnectionLostError (instead of
+// the previous LOG(FATAL) abort) lets the background loop fail
+// outstanding work with a recoverable status so Python can roll back and
+// re-initialize for a new generation — the core of elastic fault
+// tolerance. The message NAMES the transport-level cause
+// (tcp_context.last_error) so a chaos run's failure is attributable.
+
+namespace {
+std::string WithCause(const char* what, const TcpContext& ctx) {
+  std::string msg(what);
+  if (!ctx.last_error().empty()) {
+    msg += ": ";
+    msg += ctx.last_error();
+  }
+  return msg;
+}
+}  // namespace
 
 void TcpController::GatherBlobs(const std::string& mine,
                                 std::vector<std::string>* all) {
   if (!tcp_context_.GatherBlobs(mine, all)) {
-    throw ConnectionLostError("control-plane gather failed");
+    throw ConnectionLostError(
+        WithCause("control-plane gather failed", tcp_context_));
   }
 }
 
 void TcpController::BroadcastBlob(std::string* blob) {
   if (!tcp_context_.BroadcastBlob(blob)) {
-    throw ConnectionLostError("control-plane broadcast failed");
+    throw ConnectionLostError(
+        WithCause("control-plane broadcast failed", tcp_context_));
   }
 }
 
 void TcpController::CrossRankBitwiseAnd(std::vector<uint64_t>& bits) {
   if (!tcp_context_.BitwiseSync(bits, /*is_or=*/false)) {
-    throw ConnectionLostError("bitwise AND sync failed");
+    throw ConnectionLostError(
+        WithCause("bitwise AND sync failed", tcp_context_));
   }
 }
 
 void TcpController::CrossRankBitwiseOr(std::vector<uint64_t>& bits) {
   if (!tcp_context_.BitwiseSync(bits, /*is_or=*/true)) {
-    throw ConnectionLostError("bitwise OR sync failed");
+    throw ConnectionLostError(
+        WithCause("bitwise OR sync failed", tcp_context_));
   }
 }
 
 void TcpController::Barrier() {
   if (!tcp_context_.Barrier()) {
-    throw ConnectionLostError("barrier failed");
+    throw ConnectionLostError(WithCause("barrier failed", tcp_context_));
   }
 }
 

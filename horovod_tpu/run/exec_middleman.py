@@ -14,6 +14,16 @@ middleman registers itself as a child subreaper
 exit — including setsid'd and double-forked ones — reparent to the
 middleman instead of init and can still be swept after the command
 exits.
+
+When ``HVD_TPU_LOG_FILE`` is set, the middleman additionally TEES the
+command's stdout/stderr into that file (line-wise, so concurrent ranks
+sharing the launcher's pipes never interleave mid-line) while still
+passing everything through — the launcher's failure summary can then
+point at the exact log of the first-failing rank.
+
+A command killed by a signal is reported as exit code 128+signum (the
+shell convention) instead of a raw negative status, so supervisors and
+failure summaries can name the signal.
 """
 
 import os
@@ -157,12 +167,69 @@ def main(argv=None):
         signal.signal(signal.SIGHUP, _terminate)
     except (ValueError, AttributeError):
         pass
-    child = subprocess.Popen(argv)
+
+    log_f = None
+    log_path = os.environ.get("HVD_TPU_LOG_FILE")
+    if log_path:
+        try:
+            log_f = open(log_path, "ab", buffering=0)
+        except OSError:
+            log_f = None  # unwritable log dir: plain pass-through
+
+    if log_f is None:
+        child = subprocess.Popen(argv)
+        pumps = []
+    else:
+        import threading
+        child = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE)
+        log_lock = threading.Lock()
+
+        def pump(src, dst):
+            # Line-wise tee: each complete line is written atomically to
+            # the inherited stream, so other ranks' middlemen sharing
+            # the launcher's pipe never interleave mid-line.
+            for line in iter(src.readline, b""):
+                with log_lock:
+                    try:
+                        log_f.write(line)
+                    except (OSError, ValueError):
+                        pass  # ValueError: log closed during teardown
+                try:
+                    dst.write(line)
+                    dst.flush()
+                except (OSError, ValueError):
+                    pass
+            src.close()
+
+        pumps = [
+            threading.Thread(target=pump,
+                             args=(child.stdout, sys.stdout.buffer),
+                             daemon=True),
+            threading.Thread(target=pump,
+                             args=(child.stderr, sys.stderr.buffer),
+                             daemon=True),
+        ]
+        for t in pumps:
+            t.start()
+
     rc = child.wait()
     # The command exited on its own: descendants it left behind (even
-    # setsid'd/double-forked ones) have reparented to us — sweep them.
+    # setsid'd/double-forked ones) have reparented to us — sweep them
+    # BEFORE joining the pumps: a straggler holding the pipes would
+    # otherwise keep readline blocked and stall teardown; killing it
+    # closes the pipes and EOFs the pumps promptly.
     _sweep_orphans(exclude=child.pid)
-    return rc
+    for t in pumps:
+        t.join(timeout=5)
+    if log_f is not None:
+        try:
+            log_f.close()
+        except OSError:
+            pass
+    # Signal deaths surface as 128+signum (shell convention) so the
+    # launcher's failure summary can name the signal.
+    return 128 - rc if rc < 0 else rc
 
 
 if __name__ == "__main__":

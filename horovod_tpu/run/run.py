@@ -25,6 +25,8 @@ import shlex
 import signal
 import subprocess
 import sys
+import tempfile
+import time
 
 from . import rendezvous, util
 
@@ -171,6 +173,33 @@ def make_parser():
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command to run, e.g. python train.py")
     return parser
+
+
+def make_log_dir():
+    """Per-job worker log directory (HVD_TPU_LOG_DIR overrides the
+    tmp default). Every rank's middleman tees its output into
+    ``rank<k>.log`` here, so the failure summary can name the exact log
+    of the first-failing rank. Returns None when unwritable."""
+    log_dir = os.environ.get("HVD_TPU_LOG_DIR")
+    try:
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            return log_dir
+        return tempfile.mkdtemp(prefix="hvd_tpu_logs_")
+    except OSError:
+        return None
+
+
+def describe_exit(rc):
+    """Human-readable exit status: middlemen report signal deaths as
+    128+signum (shell convention)."""
+    if rc > 128 and rc <= 128 + 64:
+        try:
+            name = signal.Signals(rc - 128).name
+        except ValueError:
+            name = "signal %d" % (rc - 128)
+        return "killed by %s" % name
+    return "exit code %d" % rc
 
 
 def build_env(slot, addrs, base_env=None):
@@ -425,6 +454,26 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
             })
             rank_envs.append(rank_env)
 
+    # Per-rank tee'd logs: the middleman duplicates each worker's output
+    # into rank<k>.log so a torn-down job's failure summary can point at
+    # the first-failing rank's exact log. Local slots only — a
+    # launcher-local tmp path does not exist on a remote host (set
+    # HVD_TPU_LOG_DIR to a path valid everywhere to tee remote ranks
+    # too; remote output still streams through the ssh channel either
+    # way). The tmp dir is created lazily and removed again when the
+    # job succeeds, so a long-lived launcher host doesn't accumulate
+    # one directory per run.
+    tee_slots = [i for i, slot in enumerate(slots)
+                 if util.is_local_host(slot.hostname)
+                 or os.environ.get("HVD_TPU_LOG_DIR")]
+    log_dir = make_log_dir() if tee_slots else None
+    log_paths = [None] * len(slots)
+    if log_dir is not None:
+        for i in tee_slots:
+            log_paths[i] = os.path.join(log_dir,
+                                        "rank%d.log" % slots[i].rank)
+            rank_envs[i]["HVD_TPU_LOG_FILE"] = log_paths[i]
+
     procs = launch(slots, rank_envs, command, ssh_port=ssh_port,
                    verbose=verbose)
 
@@ -439,19 +488,51 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
     old_int = signal.signal(signal.SIGINT, kill_all)
     old_term = signal.signal(signal.SIGTERM, kill_all)
     try:
+        # Poll (rather than wait in rank order) so the FIRST failure —
+        # the root cause, not the teardown collateral — is the one the
+        # summary names.
         exit_code = 0
-        for p in procs:
-            rc = p.wait()
-            if rc != 0:
-                exit_code = max(exit_code, rc if rc > 0 else 1)
-                # One failed rank: tear down the rest (they would hang in
-                # negotiation otherwise).
-                for q in procs:
-                    if q.poll() is None:
-                        try:
-                            os.killpg(os.getpgid(q.pid), signal.SIGTERM)
-                        except (ProcessLookupError, PermissionError):
-                            pass
+        first_fail = None  # (slot, rc, log_path)
+        pending = set(range(len(procs)))
+        while pending:
+            progressed = False
+            for i in sorted(pending):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                pending.discard(i)
+                progressed = True
+                if rc != 0:
+                    exit_code = max(exit_code, rc if rc > 0 else 1)
+                    if first_fail is None:
+                        first_fail = (slots[i], rc, log_paths[i])
+                        # One failed rank: tear down the rest (they
+                        # would hang in negotiation otherwise).
+                        for q in procs:
+                            if q.poll() is None:
+                                try:
+                                    os.killpg(os.getpgid(q.pid),
+                                              signal.SIGTERM)
+                                except (ProcessLookupError,
+                                        PermissionError):
+                                    pass
+            if pending and not progressed:
+                time.sleep(0.05)
+        if first_fail is not None:
+            slot, rc, log_path = first_fail
+            where = ("" if util.is_local_host(slot.hostname)
+                     else " on %s" % slot.hostname)
+            sys.stderr.write(
+                "[launcher] job failed: first failing rank was rank %d%s "
+                "(%s); worker log: %s\n"
+                % (slot.rank, where, describe_exit(rc),
+                   log_path or "<unavailable>"))
+        elif (exit_code == 0 and log_dir is not None
+              and not os.environ.get("HVD_TPU_LOG_DIR")):
+            # Clean run: reclaim the tmp log dir (an explicit
+            # HVD_TPU_LOG_DIR is the user's to keep).
+            import shutil
+            shutil.rmtree(log_dir, ignore_errors=True)
         return exit_code
     finally:
         signal.signal(signal.SIGINT, old_int)

@@ -54,13 +54,15 @@ def render(job, prev_job, dt, endpoint):
     lines.append("hvd-top — %s — size %d, generation %d — %s" % (
         endpoint, int(job.get("size", 0)), int(job.get("generation", 0)),
         time.strftime("%H:%M:%S")))
-    header = ("%4s %9s %9s %8s %9s %9s %7s %6s %6s %6s %9s"
+    header = ("%4s %9s %9s %8s %9s %9s %7s %6s %6s %6s %5s %5s %5s %9s"
               % ("rank", "cyc/s", "cyc_ms", "ops/s", "B/s", "fused_B",
-                 "cache%", "queue", "stall", "diverr", "lag_s"))
+                 "cache%", "queue", "stall", "diverr", "crc", "nto",
+                 "rcn", "lag_s"))
     lines.append(header)
     lines.append("-" * len(header))
 
     max_lag_delta, straggler = 0.0, None
+    faults_total = 0
     for r in sorted(per_rank, key=int):
         cur = per_rank[r]
         prev = prev_rank.get(r)
@@ -80,7 +82,9 @@ def render(job, prev_job, dt, endpoint):
         lag_delta = lag_total - lag_prev
         if prev_job is not None and lag_delta > max_lag_delta:
             max_lag_delta, straggler = lag_delta, ri
-        lines.append("%4s %9s %9.2f %8s %9s %9s %6.1f%% %6d %6d %6d %9.2f"
+        faults_total += int(cur.get("faults_injected_total", 0))
+        lines.append("%4s %9s %9.2f %8s %9s %9s %6.1f%% %6d %6d %6d %5d "
+                     "%5d %5d %9.2f"
                      % (r,
                         _fmt_rate(cyc_rate),
                         cyc_ms,
@@ -93,7 +97,17 @@ def render(job, prev_job, dt, endpoint):
                         int(cur.get("queue_depth", 0)),
                         int(cur.get("stall_warnings_total", 0)),
                         int(cur.get("divergence_errors_total", 0)),
+                        # Transport health (docs/CHAOS.md): detected
+                        # corrupt frames, I/O deadline expiries, and
+                        # control-star reconnects survived.
+                        int(cur.get("net_crc_errors_total", 0)),
+                        int(cur.get("net_timeouts_total", 0)),
+                        int(cur.get("net_reconnects_total", 0)),
                         lag_total))
+    if faults_total:
+        lines.append("! fault injection active: %d fault(s) injected "
+                     "across the job (HVD_TPU_FAULT_SPEC set)"
+                     % faults_total)
     ages = job.get("age_seconds") or {}
     stale = [r for r, age in ages.items() if float(age) > 10.0]
     if stale:

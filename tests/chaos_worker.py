@@ -1,0 +1,70 @@
+"""Chaos e2e worker: a stream of allreduces with known-correct expected
+values, run under an injected transport fault (HVD_TPU_FAULT_SPEC set by
+the test). The contract being proved (docs/CHAOS.md):
+
+* every synchronize() that RETURNS returned the numerically correct
+  result — an injected corrupt frame may abort the op but must never
+  produce wrong gradients;
+* when the transport dies, the error is the recoverable connection-lost
+  kind and NAMES a transport-level cause;
+* with a recoverable fault (control close + reconnect), the whole
+  stream completes and the job exits 0.
+
+Prints "chaos: connection lost surfaced cleanly" and exits 0 when the
+fault surfaced as the expected error, so the test can distinguish a
+clean detected failure from a crash or a silent wrong answer.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import ops
+from horovod_tpu.common.ops import HorovodInternalError
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    steps = int(os.environ.get("HVD_TPU_CHAOS_STEPS", "30"))
+    expect_failure = os.environ.get("HVD_TPU_CHAOS_EXPECT_FAILURE") == "1"
+
+    completed = 0
+    try:
+        for i in range(steps):
+            # 64 KiB per step so corrupt/close faults land mid-payload,
+            # not only in tiny headers.
+            arr = np.full((128, 128), float(r + 1 + i), np.float32)
+            out = ops.synchronize(
+                ops.allreduce_async(arr, "chaos.%d" % i))
+            expected = sum(rr + 1 + i for rr in range(n))
+            # THE invariant: a result that comes back is correct. A
+            # corrupted frame must be a detected error, never this
+            # assert firing.
+            assert np.allclose(out, expected), (
+                "SILENT CORRUPTION at step %d: got %r want %r"
+                % (i, out.flat[0], expected))
+            completed += 1
+    except HorovodInternalError as e:
+        msg = str(e)
+        print("rank %d failed at step %d: %s" % (r, completed, msg),
+              flush=True)
+        assert "connection" in msg.lower(), (
+            "transport fault surfaced as the wrong error: %s" % msg)
+        print("chaos: connection lost surfaced cleanly", flush=True)
+        return 0
+    print("rank %d completed all %d steps" % (r, steps), flush=True)
+    if expect_failure:
+        # The fault spec should have killed this stream; finishing means
+        # the injection missed — fail loudly so the test's spec gets
+        # fixed rather than silently passing.
+        print("chaos: expected a transport failure but none occurred",
+              flush=True)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,29 @@
+"""Worker for the chaos metrics e2e: run a short verified allreduce
+stream under an injected control-close fault, then print the transport
+recovery counters from this rank's own metrics snapshot."""
+
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import ops
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    for i in range(20):
+        arr = np.full((64,), float(r + 1 + i), np.float32)
+        out = ops.synchronize(ops.allreduce_async(arr, "mchaos.%d" % i))
+        assert np.allclose(out, sum(rr + 1 + i for rr in range(n))), i
+    snap = hvd.metrics()["counters"]
+    print("chaos metrics: reconnects=%d attempts=%d faults=%d"
+          % (snap["net_reconnects_total"],
+             snap["net_reconnect_attempts_total"],
+             snap["faults_injected_total"]), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

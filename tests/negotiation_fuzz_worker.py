@@ -37,56 +37,68 @@ def main():
         scraper = threading.Thread(target=scrape_loop, daemon=True)
         scraper.start()
 
-    num_tensors = 40
+    # HVD_TPU_FUZZ_TENSORS trims the run; HVD_TPU_FUZZ_ROUNDS repeats
+    # the enqueue+verify cycle with fresh names so negotiation traffic
+    # flows across the WHOLE run instead of batching into the first few
+    # coordinator cycles. The chaos matrix (test_chaos.py) relies on the
+    # rounds to place an injected fault deterministically mid-run.
+    num_tensors = int(os.environ.get("HVD_TPU_FUZZ_TENSORS", "40"))
+    rounds = int(os.environ.get("HVD_TPU_FUZZ_ROUNDS", "1"))
+    seed = int(os.environ.get("HVD_TPU_FUZZ_SEED", "1234"))
     jobs = []
     for i in range(num_tensors):
         kind = ("allreduce", "allgather", "broadcast")[i % 3]
         jobs.append((i, kind))
 
-    # Same job set, rank-specific enqueue order.
-    seed = int(os.environ.get("HVD_TPU_FUZZ_SEED", "1234"))
-    order = list(range(num_tensors))
-    random.Random(seed + r).shuffle(order)
+    for rnd in range(rounds):
+        # Same job set, rank-specific enqueue order (reshuffled per round).
+        order = list(range(num_tensors))
+        random.Random(seed + r + 101 * rnd).shuffle(order)
 
-    handles = {}
-    for i in order:
-        idx, kind = jobs[i]
-        if kind == "allreduce":
-            arr = np.full((idx + 1, 3), float(r + 1), np.float32)
-            handles[idx] = ("allreduce",
-                            ops.allreduce_async(arr, "fuzz.%d" % idx))
-        elif kind == "allgather":
-            # Rank-dependent fill so a permuted segment order is caught.
-            arr = np.full((r + 1, 2), float(idx * 1000 + r), np.float32)
-            handles[idx] = ("allgather",
-                            ops.allgather_async(arr, "fuzz.%d" % idx))
-        else:
-            arr = np.full((2, idx + 1), float(r * 100 + idx), np.float32)
-            handles[idx] = ("broadcast",
-                            ops.broadcast_async(arr, idx % n,
-                                                "fuzz.%d" % idx))
+        handles = {}
+        for i in order:
+            idx, kind = jobs[i]
+            name = "fuzz.%d.%d" % (rnd, idx)
+            if kind == "allreduce":
+                arr = np.full((idx + 1, 3), float(r + 1), np.float32)
+                handles[idx] = ("allreduce",
+                                ops.allreduce_async(arr, name))
+            elif kind == "allgather":
+                # Rank-dependent fill so a permuted segment order is
+                # caught.
+                arr = np.full((r + 1, 2), float(idx * 1000 + r),
+                              np.float32)
+                handles[idx] = ("allgather",
+                                ops.allgather_async(arr, name))
+            else:
+                arr = np.full((2, idx + 1), float(r * 100 + idx),
+                              np.float32)
+                handles[idx] = ("broadcast",
+                                ops.broadcast_async(arr, idx % n, name))
 
-    # Synchronize in a different rank-specific order.
-    sync_order = list(range(num_tensors))
-    random.Random(seed * 3 + 7 + r).shuffle(sync_order)
-    for idx in sync_order:
-        kind, handle = handles[idx]
-        out = ops.synchronize(handle)
-        if kind == "allreduce":
-            expected = sum(rr + 1 for rr in range(n))
-            assert out.shape == (idx + 1, 3), (idx, out.shape)
-            assert np.allclose(out, expected), (idx, out)
-        elif kind == "allgather":
-            assert out.shape == (sum(rr + 1 for rr in range(n)), 2), \
-                (idx, out.shape)
-            expected = np.concatenate(
-                [np.full((rr + 1, 2), float(idx * 1000 + rr), np.float32)
-                 for rr in range(n)])
-            assert np.allclose(out, expected), (idx, out)
-        else:
-            root = idx % n
-            assert out.shape == (2, idx + 1), (idx, out.shape)
-            assert np.allclose(out, float(root * 100 + idx)), (idx, out)
+        # Synchronize in a different rank-specific order.
+        sync_order = list(range(num_tensors))
+        random.Random(seed * 3 + 7 + r + 101 * rnd).shuffle(sync_order)
+        for idx in sync_order:
+            kind, handle = handles[idx]
+            out = ops.synchronize(handle)
+            if kind == "allreduce":
+                expected = sum(rr + 1 for rr in range(n))
+                assert out.shape == (idx + 1, 3), (idx, out.shape)
+                assert np.allclose(out, expected), (idx, out)
+            elif kind == "allgather":
+                assert out.shape == (sum(rr + 1 for rr in range(n)), 2), \
+                    (idx, out.shape)
+                expected = np.concatenate(
+                    [np.full((rr + 1, 2), float(idx * 1000 + rr),
+                             np.float32)
+                     for rr in range(n)])
+                assert np.allclose(out, expected), (idx, out)
+            else:
+                root = idx % n
+                assert out.shape == (2, idx + 1), (idx, out.shape)
+                assert np.allclose(out, float(root * 100 + idx)), (idx,
+                                                                   out)
 
     if scraper is not None:
         stop_scraper.set()

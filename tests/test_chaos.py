@@ -1,0 +1,236 @@
+"""Chaos harness (docs/CHAOS.md): the negotiation fuzz runs under a
+matrix of seeded fault specs — drop / corrupt / delay / close / stall,
+across the control star and the data ring, on worker and coordinator
+sides — with ONE invariant:
+
+    every run either completes with verified-correct results, or fails
+    within its deadline with a clean error naming the injected cause.
+    No hangs. No wrong answers. No silent success.
+
+The fuzz worker itself asserts numerical correctness of every completed
+collective, so "completes" == "completes correctly"; a CRC regression
+that let a corrupted frame through would surface as the worker's value
+assertion, not a pass.
+
+Two e2e cases cap the acceptance criteria: a mid-stream corrupted frame
+raises the recoverable connection-lost error (never wrong gradients),
+and a killed-then-restarted control connection reconnects with backoff
+without restarting the job.
+"""
+
+import os
+import re
+import time
+
+import pytest
+
+pytestmark = pytest.mark.e2e
+
+# Per-run wall deadline: fault runs must resolve promptly (the timeout
+# knobs below put every failure path well under this), and a hang is
+# itself a failed invariant.
+DEADLINE = 90
+
+# Tight timeouts so provoked failures surface in seconds: net deadline
+# 4s, coordinator poll 6s, reconnect window 3s. Stall checks pushed out
+# of the way — the transport deadlines, not the stall inspector, must be
+# what fires here.
+CHAOS_ENV = {
+    "HVD_TPU_NET_TIMEOUT_SECONDS": "4",
+    "HVD_TPU_CONTROL_POLL_TIMEOUT_SECONDS": "6",
+    "HVD_TPU_RECONNECT_SECONDS": "3",
+    "HVD_TPU_STALL_CHECK_TIME_SECONDS": "60",
+    # Six rounds of nine tensors: negotiation traffic then flows across
+    # the WHOLE run (~16 control sends on a worker, ~2-3 per round), so
+    # a frame-indexed fault lands mid-run — with work still pending to
+    # verify after it — instead of in post-completion heartbeats.
+    "HVD_TPU_FUZZ_TENSORS": "9",
+    "HVD_TPU_FUZZ_ROUNDS": "6",
+}
+
+# (id, spec, outcome, causes)
+#   outcome "recover": the job must complete (rc 0) — the fault is
+#     absorbed (delays) or healed (control reconnect).
+#   outcome "fail": the job must die before DEADLINE with one of
+#     `causes` named in its output.
+#   outcome "either": both legal — the invariant is only "correct
+#     completion OR a prompt cause-named failure".
+# Specs filter by rank so worker-side (rank 1) frame counters are
+# deterministic; coordinator-side (rank 0) rules use the multiplexed
+# control path. Frame indices are low because a 9-tensor fuzz round
+# exchanges only a few control frames per worker.
+#
+# The recoverable close cases pin dir=send: a close before a SEND
+# leaves both sides at the same completed-frame cursor, so the resume
+# deterministically matches. A close on a RECV races the coordinator's
+# send completion — resumable if the response was still in flight,
+# cursor-mismatch failover if it had fully left — so that case is
+# "either" by design (both outcomes clean, and the refusal proves the
+# desync guard).
+MATRIX = [
+    ("ctl-close-reconnect",
+     "seed=1;rank=1,chan=control,dir=send,frame=3,action=close",
+     "recover", ["re-established"]),
+    ("ctl-close-early-reconnect",
+     "seed=2;rank=1,chan=control,dir=send,frame=2,action=close",
+     "recover", ["re-established"]),
+    ("ctl-close-recv",
+     "seed=15;rank=1,chan=control,dir=recv,frame=4,action=close",
+     "either", ["re-established", "cursor mismatch", "connection lost"]),
+    ("ctl-delay-prob",
+     "seed=3;rank=1,chan=control,prob=0.3,action=delay,delay_ms=50",
+     "recover", []),
+    ("ring-delay-prob",
+     "seed=4;rank=1,chan=ring,prob=0.3,action=delay,delay_ms=50",
+     "recover", []),
+    ("ring-corrupt-send",
+     "seed=5;rank=1,chan=ring,dir=send,frame=3,action=corrupt",
+     "fail", ["checksum mismatch"]),
+    ("ctl-corrupt-send",
+     "seed=6;rank=1,chan=control,dir=send,frame=8,action=corrupt",
+     "fail", ["checksum mismatch"]),
+    ("ctl-corrupt-recv",
+     "seed=7;rank=1,chan=control,dir=recv,frame=8,action=corrupt",
+     "fail", ["checksum mismatch"]),
+    ("coord-corrupt-send",
+     "seed=8;rank=0,chan=control,dir=send,frame=8,action=corrupt",
+     "fail", ["checksum mismatch"]),
+    ("coord-corrupt-recv",
+     "seed=16;rank=0,chan=control,dir=recv,frame=8,action=corrupt",
+     "fail", ["checksum mismatch"]),
+    ("ring-close",
+     "seed=9;rank=1,chan=ring,frame=3,action=close",
+     "fail", ["connection closed", "connection lost", "timeout",
+              "deadline"]),
+    ("ctl-drop-send",
+     "seed=10;rank=1,chan=control,dir=send,frame=8,action=drop",
+     "fail", ["timeout", "deadline", "connection"]),
+    ("coord-drop-send",
+     "seed=11;rank=0,chan=control,dir=send,frame=8,action=drop",
+     "fail", ["timeout", "deadline", "connection"]),
+    ("ctl-stall",
+     "seed=12;rank=1,chan=control,dir=send,frame=8,action=stall,"
+     "delay_ms=30000",
+     "fail", ["timeout", "deadline", "connection"]),
+    ("ring-stall",
+     "seed=13;rank=1,chan=ring,frame=3,action=stall,delay_ms=30000",
+     "fail", ["timeout", "deadline", "connection"]),
+    ("ring-drop",
+     "seed=14;rank=1,chan=ring,dir=send,frame=3,action=drop",
+     "fail", ["timeout", "deadline", "connection"]),
+]
+
+
+@pytest.mark.parametrize("name,spec,outcome,causes",
+                         MATRIX, ids=[m[0] for m in MATRIX])
+def test_chaos_matrix(run_launcher, name, spec, outcome, causes):
+    env = dict(CHAOS_ENV)
+    env["HVD_TPU_FAULT_SPEC"] = spec
+    t0 = time.monotonic()
+    result = run_launcher(2, "negotiation_fuzz_worker.py", extra_env=env,
+                          timeout=DEADLINE + 30)
+    elapsed = time.monotonic() - t0
+    out = result.stdout + result.stderr
+
+    # Invariant 0: no hangs — every run resolves inside the deadline.
+    assert elapsed < DEADLINE, \
+        "%s: run took %.0fs (hang?)" % (name, elapsed)
+    # The spec must actually have armed (a parse error disables
+    # injection and would make every case pass vacuously).
+    assert "fault injection ACTIVE" in out, out
+
+    if outcome == "recover":
+        # Invariant 1: recoverable faults are absorbed — completed run,
+        # every collective's value verified by the worker itself.
+        assert result.returncode == 0, (name, out[-3000:])
+        assert out.count("negotiation fuzz passed") == 2, (name,
+                                                           out[-3000:])
+    elif outcome == "either":
+        # Both outcomes legal; both must be CLEAN: a completed run
+        # verified its values, a failed one named its cause promptly.
+        assert any(c in out for c in causes), (name, out[-3000:])
+        if result.returncode == 0:
+            assert out.count("negotiation fuzz passed") == 2, (name,
+                                                               out[-3000:])
+        assert "SILENT CORRUPTION" not in out
+        return
+    else:
+        # Invariant 2: fatal faults fail CLEANLY — nonzero exit, no
+        # silent success, and the output names the injected cause.
+        assert result.returncode != 0, \
+            "%s: injected fault produced a silent success" % name
+        assert "fault injected" in out, (name, out[-3000:])
+        assert any(c in out for c in causes), \
+            "%s: failure does not name its cause (%s): %s" % (
+                name, causes, out[-3000:])
+        # Never a wrong answer: a value-assertion failure would mean a
+        # corrupted frame made it into a result.
+        assert "SILENT CORRUPTION" not in out
+    for cause in causes:
+        if outcome == "recover" and cause:
+            assert cause in out, (name, cause, out[-3000:])
+
+
+def test_chaos_corrupt_frame_raises_connection_lost(run_launcher):
+    """Acceptance: a mid-stream corrupted data-ring frame surfaces as a
+    detected checksum mismatch inside a recoverable connection-lost
+    error — and every collective that completed before it returned
+    correct values (no wrong gradients, ever)."""
+    env = dict(CHAOS_ENV)
+    env["HVD_TPU_FAULT_SPEC"] = \
+        "seed=21;rank=1,chan=ring,dir=send,frame=10,action=corrupt"
+    env["HVD_TPU_CHAOS_EXPECT_FAILURE"] = "1"
+    t0 = time.monotonic()
+    result = run_launcher(2, "chaos_worker.py", extra_env=env,
+                          timeout=DEADLINE + 30)
+    elapsed = time.monotonic() - t0
+    out = result.stdout + result.stderr
+    assert elapsed < DEADLINE, "took %.0fs" % elapsed
+    # The worker exits 0 IFF the fault surfaced as the expected
+    # connection-lost error; wrong values or a missed injection exit
+    # nonzero.
+    assert result.returncode == 0, out[-3000:]
+    assert "chaos: connection lost surfaced cleanly" in out
+    assert "checksum mismatch" in out
+    assert "SILENT CORRUPTION" not in out
+
+
+def test_chaos_control_reconnect_without_restart(run_launcher):
+    """Acceptance: a killed-then-restarted control connection reconnects
+    with capped backoff and the job runs to a verified-correct
+    completion — no restart, no elastic rollback."""
+    env = dict(CHAOS_ENV)
+    env["HVD_TPU_RECONNECT_SECONDS"] = "10"
+    env["HVD_TPU_FAULT_SPEC"] = \
+        "seed=22;rank=1,chan=control,dir=send,frame=4,action=close"
+    result = run_launcher(2, "negotiation_fuzz_worker.py", extra_env=env,
+                          timeout=DEADLINE + 30)
+    out = result.stdout + result.stderr
+    assert result.returncode == 0, out[-3000:]
+    assert "fault injected: close" in out
+    assert "control connection re-established" in out
+    assert "accepted control reconnect from rank 1" in out
+    assert out.count("negotiation fuzz passed") == 2
+
+
+def test_chaos_reconnect_metrics_counted(run_launcher):
+    """The recovery counters (docs/METRICS.md) record the healed fault:
+    reconnect attempts/successes and the injected-fault tally are
+    visible in the worker's own metrics snapshot."""
+    env = dict(CHAOS_ENV)
+    env["HVD_TPU_RECONNECT_SECONDS"] = "10"
+    env["HVD_TPU_METRICS"] = "1"
+    env["HVD_TPU_FAULT_SPEC"] = \
+        "seed=23;rank=1,chan=control,dir=send,frame=4,action=close"
+    result = run_launcher(2, "metrics_chaos_worker.py", extra_env=env,
+                          timeout=DEADLINE + 30)
+    out = result.stdout + result.stderr
+    assert result.returncode == 0, out[-3000:]
+    rows = [tuple(int(v) for v in m)
+            for m in re.findall(r"chaos metrics: reconnects=(\d+) "
+                                r"attempts=(\d+) faults=(\d+)", out)]
+    assert len(rows) == 2, out[-3000:]
+    # Rank 1 (the faulted side) shows the healed fault; both rows obey
+    # attempts >= successes.
+    assert any(rec >= 1 and faults >= 1 for rec, _, faults in rows), rows
+    assert all(att >= rec for rec, att, _ in rows), rows

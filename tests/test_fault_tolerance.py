@@ -1,7 +1,11 @@
 """Failure fan-out e2e: when one rank dies, the launcher must kill the
-survivors and report failure promptly (reference: run.py's
-one-failed-rank teardown; SURVEY §5.3 failure-detection obligations)."""
+survivors, report failure promptly, and name the root cause — the
+first-failing rank, its exit status, and its tee'd log (reference:
+run.py's one-failed-rank teardown; SURVEY §5.3 failure-detection
+obligations)."""
 
+import os
+import re
 import time
 
 import pytest
@@ -26,6 +30,20 @@ def test_worker_crash_tears_down_job(run_launcher):
     assert "rank 1 crashing now" in result.stdout
     assert elapsed < 115, "teardown took %.0fs - failure fan-out broken" \
         % elapsed
+
+    # Failure summary: the launcher must name the FIRST failing rank
+    # (the root cause — rank 1, which crashed — not the teardown
+    # collateral), its exit status, and the tee'd per-rank log, which
+    # must contain that rank's output.
+    m = re.search(r"first failing rank was rank (\d+) \(([^)]*)\); "
+                  r"worker log: (\S+)", result.stderr)
+    assert m, result.stderr
+    assert m.group(1) == "1", result.stderr
+    assert "exit code" in m.group(2) or "killed by" in m.group(2)
+    log_path = m.group(3)
+    assert os.path.exists(log_path), result.stderr
+    with open(log_path) as f:
+        assert "rank 1 crashing now" in f.read()
 
 
 def test_torch_cext_crash_surfaces_error(run_launcher):

@@ -1,0 +1,72 @@
+"""Transport-hardening unit tests (docs/CHAOS.md satellites): CRC32C
+known answers, frame round-trip + detected corruption, recv-deadline
+expiry, oversize-frame rejection, handshake-timeout accept, stale
+generation rejection, and fault-spec determinism. All run in-process
+against the native lib's selftest C API — no multi-process job, CPU
+only, tier-1 safe."""
+
+import ctypes
+import os
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lib():
+    native_dir = os.environ.get("HVD_TPU_NATIVE_DIR") or os.path.join(
+        REPO_ROOT, "horovod_tpu", "native")
+    lib = ctypes.CDLL(os.path.join(native_dir, "libhorovod_tpu.so"))
+    lib.horovod_tpu_crc32c.restype = ctypes.c_uint32
+    lib.horovod_tpu_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.horovod_tpu_crc32c_extend.restype = ctypes.c_uint32
+    lib.horovod_tpu_crc32c_extend.argtypes = [
+        ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint64]
+    lib.horovod_tpu_net_selftest.restype = ctypes.c_int
+    lib.horovod_tpu_net_selftest.argtypes = [ctypes.c_char_p]
+    return lib
+
+
+def test_crc32c_known_answers():
+    lib = _lib()
+    # The canonical CRC32C check vector (RFC 3720 appendix B.4 et al).
+    assert lib.horovod_tpu_crc32c(b"123456789", 9) == 0xE3069283
+    assert lib.horovod_tpu_crc32c(b"", 0) == 0
+    # 32 zero bytes — second known vector (iSCSI test pattern).
+    assert lib.horovod_tpu_crc32c(b"\x00" * 32, 32) == 0x8A9136AA
+
+
+def test_crc32c_incremental_matches_oneshot():
+    lib = _lib()
+    data = bytes(range(256)) * 17 + b"tail-bytes"
+    want = lib.horovod_tpu_crc32c(data, len(data))
+    for split in (0, 1, 7, 64, 255, len(data) - 1):
+        crc = lib.horovod_tpu_crc32c(data[:split], split)
+        crc = lib.horovod_tpu_crc32c_extend(crc, data[split:],
+                                            len(data) - split)
+        assert crc == want, split
+
+
+def test_crc32c_detects_single_bit_flip():
+    lib = _lib()
+    data = bytearray(b"G" * 4096)
+    want = lib.horovod_tpu_crc32c(bytes(data), len(data))
+    data[1000] ^= 0x1
+    assert lib.horovod_tpu_crc32c(bytes(data), len(data)) != want
+
+
+@pytest.mark.parametrize("scenario", [
+    "crc_roundtrip",           # frame survives the wire and verifies
+    "crc_corrupt_detected",    # flipped payload byte -> CRC error, not data
+    "recv_deadline",           # silent peer trips SO_RCVTIMEO promptly
+    "max_frame",               # corrupt length field rejected, not OOM'd
+    "handshake_timeout",       # silent client can't wedge the accept loop
+    "stale_generation",        # old-generation peer rejected at accept
+    "fault_spec",              # injector parse + seeded determinism
+])
+def test_net_selftest(scenario):
+    assert _lib().horovod_tpu_net_selftest(scenario.encode()) == 1, scenario
+
+
+def test_net_selftest_unknown_name():
+    assert _lib().horovod_tpu_net_selftest(b"no_such_scenario") == -1
