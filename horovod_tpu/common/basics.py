@@ -148,6 +148,17 @@ class HorovodBasics:
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
         lib.horovod_tpu_metrics_json.restype = ctypes.c_char_p
         lib.horovod_tpu_metrics_json.argtypes = []
+        lib.horovod_tpu_crc32c.restype = ctypes.c_uint32
+        lib.horovod_tpu_crc32c.argtypes = [ctypes.c_char_p,
+                                           ctypes.c_uint64]
+        lib.horovod_tpu_crc32c_extend.restype = ctypes.c_uint32
+        lib.horovod_tpu_crc32c_extend.argtypes = [
+            ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint64]
+        lib.horovod_tpu_ckpt_metrics.restype = None
+        lib.horovod_tpu_ckpt_metrics.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_double]
         lib.horovod_tpu_job_metrics_json.restype = ctypes.c_char_p
         lib.horovod_tpu_job_metrics_json.argtypes = []
         lib.horovod_tpu_autotune_params.restype = None
@@ -235,6 +246,26 @@ class HorovodBasics:
         summary, summary staleness, and the per-rank announce-lag
         table (straggler signal). "{}" on non-coordinator ranks."""
         return self.lib.horovod_tpu_job_metrics_json().decode("utf-8")
+
+    def crc32c(self, data, crc=0):
+        """CRC32C (Castagnoli) over `data` via the native slicing-by-8
+        implementation (the transport frame checksum, native/checksum) —
+        chained from `crc` for incremental use. The durable checkpoint
+        writer checksums every shard and manifest through this."""
+        buf = bytes(data)
+        return int(self.lib.horovod_tpu_crc32c_extend(
+            ctypes.c_uint32(crc), buf, len(buf))) if crc else \
+            int(self.lib.horovod_tpu_crc32c(buf, len(buf)))
+
+    def ckpt_metrics(self, writes=0, failures=0, nbytes=0, restores=0,
+                     restore_failures=0, last_step=-1,
+                     write_seconds=-1.0):
+        """Reports durable-checkpoint accounting into the native
+        registry (deltas; last_step absolute with <0 = skip;
+        write_seconds one histogram observation with <0 = skip)."""
+        self.lib.horovod_tpu_ckpt_metrics(
+            int(writes), int(failures), int(nbytes), int(restores),
+            int(restore_failures), int(last_step), float(write_seconds))
 
     def autotune_params(self):
         """Current synchronized knob values (autotune introspection):

@@ -21,6 +21,10 @@ Pieces:
 * ``driver.py`` — the launcher-side supervisor: monitors workers,
   blacklists failing hosts, bumps the rendezvous generation, and spawns
   replacements, keeping the world between ``--min-np`` and ``--max-np``.
+* ``durable.py`` — async sharded durable snapshots of the committed
+  state (``ElasticState.enable_durable`` / ``--ckpt-dir``): CRC32C
+  manifests, atomic tmp→fsync→rename writes, torn-write-proof restore,
+  and full-job crash recovery (auto-resume in :func:`run`).
 
 See docs/ELASTIC.md for the state-commit semantics, the discovery script
 contract, and the failure model.
@@ -31,6 +35,12 @@ from .discovery import (  # noqa: F401
     HostDiscovery,
     HostDiscoveryScript,
     HostManager,
+)
+from .durable import (  # noqa: F401
+    CkptFaultInjector,
+    DurableCheckpointer,
+    last_durable_step,
+    latest_valid_manifest,
 )
 from .run import HostsUpdatedInterrupt, run  # noqa: F401
 from .state import ElasticState, State  # noqa: F401

@@ -133,6 +133,15 @@ class HostManager:
             return
         self._failures.pop(host, None)
 
+    def reset(self):
+        """Clears every failure streak and blacklist entry. Used by the
+        driver's full-job checkpoint restart (--restart-from-ckpt): the
+        restart is a clean slate — a host whose backoff window was the
+        reason the world fell below --min-np must be retriable by the
+        relaunched job, exactly as it would be by an operator-driven
+        restart."""
+        self._failures = {}
+
     def is_blacklisted(self, host):
         ent = self._failures.get(host)
         return ent is not None and self._clock() < ent[1]

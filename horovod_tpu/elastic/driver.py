@@ -54,7 +54,8 @@ class ElasticDriver:
 
     def __init__(self, command, discovery, min_np, max_np,
                  np_initial=None, ssh_port=None, start_timeout=60,
-                 verbose=False, env=None):
+                 verbose=False, env=None, ckpt_dir=None,
+                 restart_from_ckpt=False):
         if min_np < 1 or max_np < min_np:
             raise ValueError("need 1 <= min_np <= max_np (got %d..%d)"
                              % (min_np, max_np))
@@ -66,6 +67,11 @@ class ElasticDriver:
         self._start_timeout = start_timeout
         self._verbose = verbose
         self._base_env = dict(env if env is not None else os.environ)
+        self._ckpt_dir = ckpt_dir or self._base_env.get("HVD_TPU_CKPT_DIR")
+        self._restart_from_ckpt = restart_from_ckpt and self._ckpt_dir
+        self._restarts = 0
+        self._max_restarts = int(os.environ.get(
+            "HVD_TPU_CKPT_MAX_RESTARTS", "3"))
         cooldown = float(os.environ.get("HVD_TPU_ELASTIC_COOLDOWN", "10"))
         self._hosts = HostManager(discovery, cooldown=cooldown)
         self._discovery_interval = float(
@@ -97,6 +103,12 @@ class ElasticDriver:
             "HVD_TPU_RENDEZVOUS_ADDR": self._addr,
             rendezvous.KEY_ENV: self._secret,
         })
+        if self._ckpt_dir:
+            # Durable checkpoints (docs/ELASTIC.md "Durability"): every
+            # worker — including replacements spawned mid-job and the
+            # fresh cohort of a --restart-from-ckpt relaunch — writes
+            # to and auto-resumes from the same directory.
+            env["HVD_TPU_CKPT_DIR"] = self._ckpt_dir
         env.setdefault("HVD_TPU_START_TIMEOUT", str(self._start_timeout))
         return env
 
@@ -256,6 +268,87 @@ class ElasticDriver:
             except (ProcessLookupError, PermissionError):
                 pass
 
+    # -- durable-checkpoint restart (--restart-from-ckpt) -----------------
+    def _report_last_durable(self):
+        """Names the newest durable step in the teardown summary, so an
+        operator knows exactly what a restart recovers (nothing, when
+        durability was off or no checkpoint ever published)."""
+        if not self._ckpt_dir:
+            return
+        from .durable import describe_last_durable
+        sys.stderr.write(
+            "[elastic] %s\n" % describe_last_durable(self._ckpt_dir))
+
+    def _teardown_workers(self, grace=10.0):
+        """Kills every remaining worker (SIGTERM, then SIGKILL after
+        `grace`) and reaps them WITHOUT blacklisting their hosts — a
+        deliberate restart kill is not host evidence."""
+        self._kill_all()
+        deadline = time.monotonic() + grace
+        while self._workers:
+            for wid, w in list(self._workers.items()):
+                if w.proc.poll() is not None:
+                    del self._workers[wid]
+            if not self._workers:
+                break
+            if time.monotonic() > deadline:
+                for w in self._workers.values():
+                    try:
+                        os.killpg(os.getpgid(w.proc.pid), signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+                for w in self._workers.values():
+                    try:
+                        w.proc.wait(timeout=5)
+                    except Exception:
+                        pass
+                self._workers = {}
+                break
+            time.sleep(0.1)
+
+    def _try_restart_from_ckpt(self, reason):
+        """Full-job restart instead of teardown: kill whatever is left,
+        clear the host blacklist, wait for discovery to offer at least
+        --min-np slots again, and spawn a fresh cohort that auto-resumes
+        from the newest valid durable manifest. Returns True when the
+        restart was performed (the caller continues supervising)."""
+        if not self._restart_from_ckpt:
+            return False
+        if self._restarts >= self._max_restarts:
+            sys.stderr.write(
+                "[elastic] restart budget exhausted (%d/%d); tearing "
+                "down for real\n" % (self._restarts, self._max_restarts))
+            return False
+        self._restarts += 1
+        from .durable import last_durable_step
+        step, _ = last_durable_step(self._ckpt_dir)
+        sys.stderr.write(
+            "[elastic] %s; full-job restart %d/%d from durable "
+            "checkpoint (last durable step: %s)\n"
+            % (reason, self._restarts, self._max_restarts,
+               step if step is not None else "none — fresh start"))
+        self._teardown_workers()
+        self._hosts.reset()
+        deadline = time.monotonic() + self._start_timeout
+        while True:
+            self._hosts.refresh()
+            capacity = sum(
+                self._hosts.available_hosts_and_slots().values())
+            if capacity >= self._min_np:
+                break
+            if time.monotonic() > deadline:
+                sys.stderr.write(
+                    "[elastic] restart aborted: discovery offered %d "
+                    "slot(s) < --min-np=%d within %ds\n"
+                    % (capacity, self._min_np, int(self._start_timeout)))
+                return False
+            time.sleep(self._discovery_interval)
+        target = min(self._np_initial or capacity, self._max_np, capacity)
+        for host in self._plan_growth()[:target]:
+            self._spawn(host)
+        self._publish()
+        return True
+
     # -- main loop ---------------------------------------------------------
     def run(self):
         local_addr = self._base_env.get("HVD_TPU_RENDEZVOUS_HOST")
@@ -335,7 +428,12 @@ class ElasticDriver:
             if len(self._workers) + len(plan) < self._min_np:
                 plan = []
                 if not self._workers:
+                    if self._try_restart_from_ckpt(
+                            "no workers left and no spawnable hosts"):
+                        below_min_since = None
+                        continue
                     self._publish(status="shutdown")
+                    self._report_last_durable()
                     sys.stderr.write(
                         "[elastic] no workers left and no spawnable "
                         "hosts; failing the job\n")
@@ -343,11 +441,17 @@ class ElasticDriver:
                 if below_min_since is None:
                     below_min_since = now
                 elif now - below_min_since > self._start_timeout:
+                    if self._try_restart_from_ckpt(
+                            "stuck below --min-np=%d for %ds"
+                            % (self._min_np, int(self._start_timeout))):
+                        below_min_since = None
+                        continue
                     sys.stderr.write(
                         "[elastic] stuck below --min-np=%d for %ds; "
                         "tearing down\n"
                         % (self._min_np, int(self._start_timeout)))
                     self._publish(status="shutdown")
+                    self._report_last_durable()
                     self._kill_all()
                     return 1
                 continue
@@ -361,11 +465,13 @@ class ElasticDriver:
 
 
 def run_elastic(np_, discovery, command, min_np, max_np, ssh_port=None,
-                start_timeout=60, verbose=False, env=None):
+                start_timeout=60, verbose=False, env=None,
+                ckpt_dir=None, restart_from_ckpt=False):
     """Launcher entry: supervise `command` elastically. Returns exit
     code."""
     driver = ElasticDriver(command, discovery, min_np, max_np,
                            np_initial=np_, ssh_port=ssh_port,
                            start_timeout=start_timeout, verbose=verbose,
-                           env=env)
+                           env=env, ckpt_dir=ckpt_dir,
+                           restart_from_ckpt=restart_from_ckpt)
     return driver.run()

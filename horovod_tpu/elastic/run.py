@@ -169,6 +169,45 @@ def _reinitialize(min_generation):
                  % (e, min_generation))
 
 
+def _maybe_auto_resume(state):
+    """Durable auto-resume (docs/ELASTIC.md "Durability"): on the FIRST
+    entry of a process — a fresh job, or a full-job restart after a
+    crash — rank 0 restores the newest valid durable manifest into the
+    state; the ``state.sync()`` that follows broadcasts it to every
+    rank, whatever the new world size. Durability is auto-enabled from
+    ``HVD_TPU_CKPT_DIR`` (``horovodrun_tpu --ckpt-dir``) when the user
+    did not call ``enable_durable`` themselves. Never raises: a broken
+    checkpoint directory degrades to a fresh start with a warning."""
+    try:
+        if getattr(state, "_durable", None) is None:
+            if not os.environ.get("HVD_TPU_CKPT_DIR") or \
+                    not hasattr(state, "enable_durable"):
+                return
+            state.enable_durable()
+        import horovod_tpu as hvd
+        if hvd.rank() == 0:
+            step = state._durable.restore_into(state)
+            if step is not None:
+                _log("auto-resume: restored durable step %d; syncing "
+                     "to %d rank(s)" % (step, hvd.size()))
+    except Exception as e:
+        _log("auto-resume skipped (%s); starting fresh" % e)
+
+
+def _flush_durable(state, timeout=None):
+    """Drains the durable writer at clean training exit so the final
+    committed state is on disk before the process goes away."""
+    durable = getattr(state, "_durable", None)
+    if durable is None:
+        return
+    if timeout is None:
+        timeout = float(os.environ.get("HVD_TPU_CKPT_FLUSH_TIMEOUT",
+                                       "120"))
+    if not durable.flush(timeout=timeout):
+        _log("durable writer did not drain within %.0fs at exit; "
+             "newest snapshot may not be durable" % timeout)
+
+
 def run(func):
     """Decorator making ``func(state, *args, **kwargs)`` elastic:
 
@@ -199,8 +238,22 @@ def run(func):
                     _log("resuming at generation %d size %d (rank %d)"
                          % (current_generation(), hvd.size(), hvd.rank()))
                 reset = None
+                if getattr(state, "_committed", None) is None:
+                    # Nothing committed in THIS process yet — a fresh
+                    # job or full-job restart picks up the newest valid
+                    # durable checkpoint before the initial sync
+                    # distributes it. Gating on the in-memory commit
+                    # (not a one-shot flag) matters: if the first sync
+                    # fails and the ranks reshuffle, the NEW rank 0
+                    # re-attempts the restore instead of silently
+                    # broadcasting its fresh step-0 state. Once any
+                    # commit exists, rollbacks use it, never the disk
+                    # copy.
+                    _maybe_auto_resume(state)
                 state.sync()
-                return func(state, *args, **kwargs)
+                result = func(state, *args, **kwargs)
+                _flush_durable(state)
+                return result
             except HorovodInternalError as e:
                 if "protocol divergence" in str(e):
                     # Not a fault but a program bug (rank-conditional
@@ -223,6 +276,7 @@ def run(func):
                 # no generation left to join — that is success elsewhere,
                 # not a failure here.
                 _log(str(e))
+                _flush_durable(state)
                 return None
 
     return wrapper

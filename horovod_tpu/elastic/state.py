@@ -100,6 +100,7 @@ class State:
 
     def __init__(self, **kwargs):
         self._committed = None
+        self._durable = None
         self._last_check = 0.0
         self._check_interval = float(
             os.environ.get("HVD_TPU_ELASTIC_CHECK_INTERVAL", "0.5"))
@@ -138,9 +139,51 @@ class State:
     def commit(self):
         """save() + check_host_updates() — the reference's commit contract:
         the snapshot lands first, so a membership interrupt raised here
-        still resumes from the state just committed."""
+        still resumes from the state just committed. With durability
+        enabled (``enable_durable``), every Nth commit also hands the
+        snapshot to the background durable writer — by reference, since
+        save() replaces the committed dict wholesale, so the commit hot
+        path pays nothing beyond the existing deep copy."""
         self.save()
+        if self._durable is not None:
+            self._durable.maybe_enqueue(self._committed,
+                                        self._durable_step())
         self.check_host_updates()
+
+    # -- durability (elastic/durable.py; docs/ELASTIC.md "Durability") -----
+    def enable_durable(self, directory=None, every_n_commits=None,
+                       interval_s=None, **kwargs):
+        """Makes commits durable: every Nth ``commit()`` (or one per
+        ``interval_s`` wall-clock window) is written asynchronously to
+        `directory` as per-rank CRC-checksummed shards plus a rank-0
+        manifest, surviving whole-job loss. `directory` defaults to
+        ``HVD_TPU_CKPT_DIR`` (what ``horovodrun_tpu --ckpt-dir``
+        plumbs). Returns the DurableCheckpointer."""
+        from . import durable
+        directory = directory or os.environ.get("HVD_TPU_CKPT_DIR")
+        if not directory:
+            raise ValueError(
+                "enable_durable needs a directory (argument or "
+                "HVD_TPU_CKPT_DIR / horovodrun_tpu --ckpt-dir)")
+        self._durable = durable.DurableCheckpointer(
+            directory, every_n_commits=every_n_commits,
+            interval_s=interval_s, **kwargs)
+        return self._durable
+
+    @property
+    def durable(self):
+        """The active DurableCheckpointer, or None."""
+        return self._durable
+
+    def _durable_step(self):
+        """The step number a durable snapshot is filed under: the
+        state's own integer ``step`` attribute when present (the
+        documented convention), else a monotonic commit counter."""
+        step = getattr(self, "step", None)
+        try:
+            return int(step)
+        except (TypeError, ValueError):
+            return self._durable._commit_index
 
     def restore(self):
         """Loads the last committed snapshot back into the attributes."""

@@ -7,12 +7,25 @@ resume_from_epoch + `hvd.broadcast`). This module packages that pattern
 over orbax for optax/flax pytrees:
 
 * :func:`save` — the root rank (default 0) writes the pytree(s); other
-  ranks no-op. A barrier (tiny allreduce) ensures no rank races ahead
-  before the write is durable.
+  ranks no-op. The root's success/failure is broadcast BEFORE any rank
+  may proceed, so an orbax error on the root surfaces as a named
+  :class:`CheckpointSaveError` on EVERY rank instead of the historical
+  deadlock (non-root ranks waiting in the completion barrier for a root
+  that already raised). The broadcast doubles as the completion barrier.
 * :func:`restore` — the same root rank reads from disk, every rank
   receives the values via the core broadcast plane — so shared
   filesystems are NOT required (exactly the reference's
-  broadcast-restore shape).
+  broadcast-restore shape). A root-side read error raises
+  :class:`CheckpointRestoreError` on every rank, same flag protocol.
+
+Both functions contain collectives: every rank must call them. Guarding
+them with ``if hvd.rank() == 0:`` deadlocks the job — hvd-lint's
+``checkpoint-in-rank-guard`` rule flags that statically (docs/LINT.md).
+
+For *durable, asynchronous, crash-surviving* checkpoints of elastic
+training state, see ``hvd.elastic.ElasticState.enable_durable``
+(docs/ELASTIC.md "Durability") — this module is the synchronous,
+user-driven flavor.
 """
 
 import numpy as np
@@ -23,8 +36,29 @@ from horovod_tpu.common import ops as _ops
 from . import broadcast_parameters
 
 
-def _barrier(name):
-    _ops.allreduce(np.zeros(1, np.float32), name)
+class CheckpointError(RuntimeError):
+    """Base for cross-rank checkpoint failures (named, raised on EVERY
+    rank — never a hang)."""
+
+
+class CheckpointSaveError(CheckpointError):
+    """The root rank's checkpoint write failed; all ranks raise this
+    (only the root carries the original exception as __cause__)."""
+
+
+class CheckpointRestoreError(CheckpointError):
+    """The root rank's checkpoint read failed; all ranks raise this
+    (only the root carries the original exception as __cause__)."""
+
+
+def _sync_root_ok(ok, root_rank, name):
+    """Broadcasts the root's success flag; returns it on every rank.
+    This is both the error channel and the completion barrier: a
+    non-root rank returning from this broadcast proves the root got
+    past its filesystem work."""
+    flag = np.array([1.0 if ok else 0.0], np.float32)
+    out = _ops.broadcast(flag, root_rank, name)
+    return bool(np.asarray(out).reshape(-1)[0] >= 0.5)
 
 
 def save(path, tree, step=None, root_rank=0):
@@ -33,18 +67,40 @@ def save(path, tree, step=None, root_rank=0):
 
     `step` appends a numbered subdirectory (path/<step>), the usual
     orbax layout for training runs. Returns the concrete directory
-    written (on every rank, for logging)."""
+    written (on every rank, for logging). Raises
+    :class:`CheckpointSaveError` on every rank when the root's write
+    fails."""
     import os
-
-    import orbax.checkpoint as ocp
 
     target = os.path.join(str(path), str(step)) if step is not None \
         else str(path)
+    err = None
     if _hvd.rank() == root_rank:
-        with ocp.PyTreeCheckpointer() as ckpt:
-            ckpt.save(os.path.abspath(target), tree, force=True)
+        try:
+            import orbax.checkpoint as ocp
+
+            with ocp.PyTreeCheckpointer() as ckpt:
+                ckpt.save(os.path.abspath(target), tree, force=True)
+        except Exception as e:  # surfaced on every rank below
+            err = e
     if _hvd.size() > 1:
-        _barrier("ckpt_save.%s" % (step if step is not None else "x"))
+        # Success flag FIRST (it doubles as the barrier): if the root
+        # just raised, every rank must learn that and raise too — the
+        # old bare barrier left non-root ranks blocked in an allreduce
+        # the root never joined, until the stall timeout.
+        ok = _sync_root_ok(err is None, root_rank,
+                           "ckpt_save_ok.%s"
+                           % (step if step is not None else "x"))
+        if not ok:
+            raise CheckpointSaveError(
+                "checkpoint save to %r failed on root rank %d%s"
+                % (target, root_rank,
+                   ": %s" % err if err is not None else
+                   " (see the root rank's log for the underlying "
+                   "error)")) from err
+    elif err is not None:
+        raise CheckpointSaveError(
+            "checkpoint save to %r failed: %s" % (target, err)) from err
     return target
 
 
@@ -55,32 +111,55 @@ def restore(path, template, step=None, root_rank=0):
     params/opt_state pytree). Only `root_rank` touches the filesystem;
     the values reach every other rank over the core broadcast plane, so
     workers without access to the checkpoint directory still restore
-    consistently."""
+    consistently. Raises :class:`CheckpointRestoreError` on every rank
+    when the root's read fails."""
     import os
-
-    import orbax.checkpoint as ocp
 
     target = os.path.join(str(path), str(step)) if step is not None \
         else str(path)
+    err = None
+    tree = template
     if _hvd.rank() == root_rank:
-        # Restore WITH the template so orbax rebuilds the exact pytree
-        # structure (namedtuples/custom nodes would otherwise come back
-        # as dicts whose sorted-key leaf order can silently permute
-        # same-shaped leaves).
-        with ocp.PyTreeCheckpointer() as ckpt:
-            tree = ckpt.restore(os.path.abspath(target), item=template)
-        # Conform dtypes to the template BEFORE the broadcast: the saved
-        # dtypes may differ (e.g. bf16 checkpoint, f32 template) and the
-        # controller rejects mixed-dtype collectives across ranks.
-        import jax
-        import jax.numpy as jnp
+        try:
+            import orbax.checkpoint as ocp
 
-        tree = jax.tree_util.tree_map(
-            lambda r, t: jnp.asarray(r, dtype=t.dtype)
-            if hasattr(t, "dtype") else r, tree, template)
-    else:
-        tree = template
+            # Restore WITH the template so orbax rebuilds the exact
+            # pytree structure (namedtuples/custom nodes would otherwise
+            # come back as dicts whose sorted-key leaf order can
+            # silently permute same-shaped leaves).
+            with ocp.PyTreeCheckpointer() as ckpt:
+                tree = ckpt.restore(os.path.abspath(target),
+                                    item=template)
+            # Conform dtypes to the template BEFORE the broadcast: the
+            # saved dtypes may differ (e.g. bf16 checkpoint, f32
+            # template) and the controller rejects mixed-dtype
+            # collectives across ranks.
+            import jax
+            import jax.numpy as jnp
+
+            tree = jax.tree_util.tree_map(
+                lambda r, t: jnp.asarray(r, dtype=t.dtype)
+                if hasattr(t, "dtype") else r, tree, template)
+        except Exception as e:  # surfaced on every rank below
+            err = e
     if _hvd.size() > 1:
+        # Same flag-before-collectives protocol as save(): without it a
+        # root-side read error (missing/corrupt checkpoint) left every
+        # other rank hanging inside broadcast_parameters.
+        ok = _sync_root_ok(err is None, root_rank,
+                           "ckpt_restore_ok.%s"
+                           % (step if step is not None else "x"))
+        if not ok:
+            raise CheckpointRestoreError(
+                "checkpoint restore from %r failed on root rank %d%s"
+                % (target, root_rank,
+                   ": %s" % err if err is not None else
+                   " (see the root rank's log for the underlying "
+                   "error)")) from err
         tree = broadcast_parameters(tree, root_rank=root_rank,
                                     name_prefix="ckpt_restore")
+    elif err is not None:
+        raise CheckpointRestoreError(
+            "checkpoint restore from %r failed: %s"
+            % (target, err)) from err
     return tree

@@ -19,6 +19,8 @@ def check_rank_conditional(model):
     for site in model.call_sites:
         if site.func in TRAIN_MARKERS and site.func != "allreduce_gradients":
             continue  # wrapping an optimizer is not itself a collective
+        if site.func.startswith("checkpoint."):
+            continue  # owned by checkpoint-in-rank-guard below
         for cond in site.conditions:
             if cond.rank_dependent:
                 kind = "elastic commit point" if site.is_commit \
@@ -30,6 +32,33 @@ def check_rank_conditional(model):
                     "submit it and the job hangs in negotiation "
                     "(runtime: divergence cross-check / stall inspector)"
                     % (kind, site.func, cond.source))
+                break
+
+
+@register("checkpoint-in-rank-guard", ERROR,
+          "hvd checkpoint save/restore guarded by a rank condition")
+def check_checkpoint_rank_guard(model):
+    """``hvd.jax.checkpoint.save()``/``restore()`` CONTAIN collectives
+    (the root broadcasts a success flag — the torn-save deadlock fix —
+    and restore broadcasts the values), so the classic
+    ``if hvd.rank() == 0: checkpoint.save(...)`` guard deadlocks: rank 0
+    waits in the flag broadcast for peers that never entered the call.
+    The API already rank-splits internally — call it from EVERY rank."""
+    for site in model.call_sites:
+        if not site.func.startswith("checkpoint."):
+            continue
+        for cond in site.conditions:
+            if cond.rank_dependent:
+                yield make_finding(
+                    model, site.node, "checkpoint-in-rank-guard",
+                    "`%s` is only reachable under the rank-dependent "
+                    "condition `%s`, but it contains collectives (the "
+                    "success-flag broadcast and the restore value "
+                    "broadcast) — ranks skipping this branch never "
+                    "join them and the job deadlocks. The call already "
+                    "no-ops filesystem work off the root rank; invoke "
+                    "it unconditionally on every rank"
+                    % (site.func, cond.source))
                 break
 
 

@@ -73,6 +73,14 @@ INITIAL_BROADCASTS = {
 # collectives internally).
 ELASTIC_COMMITS = {"commit", "sync"}
 
+# hvd.jax.checkpoint entry points: save()/restore() contain collectives
+# (the success-flag broadcast + barrier / value broadcast), so they are
+# collective call sites for lexical purposes — recorded with the
+# canonical names "checkpoint.save"/"checkpoint.restore" so the
+# dedicated checkpoint-in-rank-guard rule (not the generic
+# rank-conditional-collective one) owns them.
+CHECKPOINT_CALLS = {"save", "restore"}
+
 # Calls returning per-rank values: conditions and collective names derived
 # from these diverge across ranks. (size()/cross_size() are uniform;
 # local_size() differs on heterogeneous hosts, so it is included.)
@@ -484,6 +492,15 @@ class _Walker(ast.NodeVisitor):
         if attr in ELASTIC_COMMITS and self.m.uses_elastic and \
                 base is not None:
             return attr
+        # checkpoint.save()/restore(): only when the receiver is the
+        # horovod checkpoint module (`from horovod_tpu.jax import
+        # checkpoint` binds it as an hvd alias; dotted access like
+        # hvd.jax.checkpoint.save resolves through the alias root) —
+        # bare `model.save(...)` / `state.save()` never match.
+        if attr in CHECKPOINT_CALLS and base is not None and \
+                (base == "checkpoint" or base.endswith(".checkpoint")) \
+                and _is_hvd_base(self.m, base):
+            return "checkpoint." + attr
         return None
 
     def _name_argument(self, node, func):

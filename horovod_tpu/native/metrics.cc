@@ -62,6 +62,9 @@ const char* SummaryFieldName(int field) {
     case SUM_NET_TIMEOUTS: return "net_timeouts_total";
     case SUM_NET_RECONNECTS: return "net_reconnects_total";
     case SUM_FAULTS_INJECTED: return "faults_injected_total";
+    case SUM_CKPT_WRITES: return "ckpt_writes_total";
+    case SUM_CKPT_WRITE_FAILURES: return "ckpt_write_failures_total";
+    case SUM_LAST_DURABLE_STEP: return "last_durable_step";
   }
   return "unknown";
 }
@@ -80,7 +83,13 @@ Metrics::Metrics()
       cycle_bytes({1024, 16384, 262144, 1048576, 4194304, 16777216, 67108864,
                    268435456},
                   1.0),
-      fusion_fill_ratio({0.1, 0.25, 0.5, 0.75, 0.9, 1.0}, 1e6) {}
+      fusion_fill_ratio({0.1, 0.25, 0.5, 0.75, 0.9, 1.0}, 1e6),
+      // Durable writes run in a background thread against real storage:
+      // 1ms (page-cache local disk) up to minutes (an overloaded object
+      // store with injected slow-fsync faults).
+      ckpt_write_seconds({1e-3, 5e-3, 2.5e-2, 0.1, 0.5, 1.0, 2.5, 5.0,
+                          10.0, 30.0, 60.0, 120.0},
+                         1e6) {}
 
 void Metrics::Configure(int world_size_in, int rank_in) {
   world_size.store(world_size_in, std::memory_order_relaxed);
@@ -131,6 +140,10 @@ std::vector<double> Metrics::Summary() const {
                           net_send_timeouts_total.load());
   v[SUM_NET_RECONNECTS] = static_cast<double>(net_reconnects_total.load());
   v[SUM_FAULTS_INJECTED] = static_cast<double>(faults_injected_total.load());
+  v[SUM_CKPT_WRITES] = static_cast<double>(ckpt_writes_total.load());
+  v[SUM_CKPT_WRITE_FAILURES] =
+      static_cast<double>(ckpt_write_failures_total.load());
+  v[SUM_LAST_DURABLE_STEP] = static_cast<double>(last_durable_step.load());
   return v;
 }
 
@@ -242,6 +255,13 @@ std::string Metrics::SnapshotJson() const {
   AppendKV(&out, "fault_corrupt_total", fault_corrupt_total.load(), &first);
   AppendKV(&out, "fault_close_total", fault_close_total.load(), &first);
   AppendKV(&out, "fault_stall_total", fault_stall_total.load(), &first);
+  AppendKV(&out, "ckpt_writes_total", ckpt_writes_total.load(), &first);
+  AppendKV(&out, "ckpt_write_failures_total",
+           ckpt_write_failures_total.load(), &first);
+  AppendKV(&out, "ckpt_bytes_total", ckpt_bytes_total.load(), &first);
+  AppendKV(&out, "ckpt_restores_total", ckpt_restores_total.load(), &first);
+  AppendKV(&out, "ckpt_restore_failures_total",
+           ckpt_restore_failures_total.load(), &first);
   out.append("},\"gauges\":{");
   first = true;
   AppendKV(&out, "queue_depth", static_cast<double>(queue_depth.load()),
@@ -255,6 +275,8 @@ std::string Metrics::SnapshotJson() const {
   AppendKV(&out, "rank", static_cast<double>(rank.load()), &first);
   AppendKV(&out, "fusion_threshold_bytes",
            static_cast<double>(fusion_threshold_bytes.load()), &first);
+  AppendKV(&out, "last_durable_step",
+           static_cast<double>(last_durable_step.load()), &first);
   out.append("},\"histograms\":{");
   first = true;
   AppendHistogram(&out, "cycle_seconds", cycle_seconds, &first);
@@ -262,6 +284,7 @@ std::string Metrics::SnapshotJson() const {
   AppendHistogram(&out, "cycle_tensors", cycle_tensors, &first);
   AppendHistogram(&out, "cycle_bytes", cycle_bytes, &first);
   AppendHistogram(&out, "fusion_fill_ratio", fusion_fill_ratio, &first);
+  AppendHistogram(&out, "ckpt_write_seconds", ckpt_write_seconds, &first);
   out.append("},\"rank_lag_seconds\":[");
   {
     std::lock_guard<std::mutex> lk(rank_mutex_);

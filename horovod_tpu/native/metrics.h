@@ -88,6 +88,11 @@ enum SummaryField : int {
   SUM_NET_TIMEOUTS,
   SUM_NET_RECONNECTS,
   SUM_FAULTS_INJECTED,
+  // Durable checkpoints (docs/ELASTIC.md "Durability"). Appended after
+  // the chaos fields, same forward-compatibility rule.
+  SUM_CKPT_WRITES,
+  SUM_CKPT_WRITE_FAILURES,
+  SUM_LAST_DURABLE_STEP,
   SUM_FIELD_COUNT
 };
 const char* SummaryFieldName(int field);
@@ -129,6 +134,13 @@ class Metrics {
   std::atomic<uint64_t> fault_close_total{0};
   std::atomic<uint64_t> fault_stall_total{0};
 
+  // --- durable checkpoints (elastic/durable.py via the C API) ---
+  std::atomic<uint64_t> ckpt_writes_total{0};          // published snapshots
+  std::atomic<uint64_t> ckpt_write_failures_total{0};  // degraded writes
+  std::atomic<uint64_t> ckpt_bytes_total{0};           // shard bytes written
+  std::atomic<uint64_t> ckpt_restores_total{0};        // successful restores
+  std::atomic<uint64_t> ckpt_restore_failures_total{0};
+
   // --- gauges (instantaneous; reset per generation) ---
   std::atomic<int64_t> queue_depth{0};
   std::atomic<int64_t> pending_negotiation{0};
@@ -136,6 +148,10 @@ class Metrics {
   std::atomic<int64_t> world_size{0};
   std::atomic<int64_t> rank{-1};
   std::atomic<int64_t> fusion_threshold_bytes{0};
+  // Newest step known durable on THIS rank's storage view (-1 = none).
+  // Deliberately survives Configure(): an elastic re-init does not
+  // un-write a checkpoint.
+  std::atomic<int64_t> last_durable_step{-1};
 
   // --- histograms ---
   MetricHistogram cycle_seconds;        // background work-cycle duration
@@ -143,6 +159,7 @@ class Metrics {
   MetricHistogram cycle_tensors;        // tensors executed per work cycle
   MetricHistogram cycle_bytes;          // payload bytes executed per work cycle
   MetricHistogram fusion_fill_ratio;    // fused payload / fusion threshold
+  MetricHistogram ckpt_write_seconds;   // durable shard write+publish time
 
   // Whether the metrics PLANE (wire piggyback, forced sync cycles, HTTP
   // serving) is live — HVD_TPU_METRICS=1 or HVD_TPU_METRICS_PORT set.

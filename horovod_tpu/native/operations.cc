@@ -626,6 +626,39 @@ const char* horovod_tpu_job_metrics_json() {
   return out.c_str();
 }
 
+// Durable-checkpoint accounting (elastic/durable.py's writer thread
+// reports through here so the ckpt_* counters ride the same registry,
+// wire summaries, /job view, and hvd-top column as everything else).
+// All arguments are DELTAS except last_step (absolute; < 0 = no
+// update) and write_seconds (one histogram observation; < 0 = none).
+// Relaxed atomics — safe from any thread, any time.
+void horovod_tpu_ckpt_metrics(int64_t writes, int64_t failures,
+                              int64_t bytes, int64_t restores,
+                              int64_t restore_failures, int64_t last_step,
+                              double write_seconds) {
+  auto& m = GlobalMetrics();
+  if (writes > 0) m.ckpt_writes_total.fetch_add(
+      static_cast<uint64_t>(writes), std::memory_order_relaxed);
+  if (failures > 0) m.ckpt_write_failures_total.fetch_add(
+      static_cast<uint64_t>(failures), std::memory_order_relaxed);
+  if (bytes > 0) m.ckpt_bytes_total.fetch_add(
+      static_cast<uint64_t>(bytes), std::memory_order_relaxed);
+  if (restores > 0) m.ckpt_restores_total.fetch_add(
+      static_cast<uint64_t>(restores), std::memory_order_relaxed);
+  if (restore_failures > 0) m.ckpt_restore_failures_total.fetch_add(
+      static_cast<uint64_t>(restore_failures), std::memory_order_relaxed);
+  if (last_step >= 0) {
+    // Monotonic max: a late-finishing older write must not move the
+    // gauge backwards past a newer one.
+    int64_t cur = m.last_durable_step.load(std::memory_order_relaxed);
+    while (last_step > cur &&
+           !m.last_durable_step.compare_exchange_weak(
+               cur, last_step, std::memory_order_relaxed)) {
+    }
+  }
+  if (write_seconds >= 0.0) m.ckpt_write_seconds.Observe(write_seconds);
+}
+
 // This rank's collective call-sequence fingerprint: seq = number of
 // collectives enqueued since init, digest = rolling FNV-1a over each
 // call's (op, dtype, shape-rank, name). Ranks that executed identical

@@ -145,6 +145,19 @@ def make_parser():
                         help="elastic mode: executable printing one "
                              "'host' or 'host:slots' line per available "
                              "host; polled to grow/shrink the job")
+    parser.add_argument("--ckpt-dir", default=None,
+                        help="durable checkpoint directory: elastic "
+                             "commits are asynchronously written here "
+                             "as CRC-checksummed shards + manifest, and "
+                             "a fresh job auto-resumes from the newest "
+                             "valid one (docs/ELASTIC.md 'Durability')")
+    parser.add_argument("--restart-from-ckpt", action="store_true",
+                        help="elastic mode with --ckpt-dir: when the "
+                             "world would fall below --min-np, perform "
+                             "a full-job restart that resumes from the "
+                             "newest durable checkpoint instead of "
+                             "tearing the job down (bounded by "
+                             "HVD_TPU_CKPT_MAX_RESTARTS, default 3)")
     parser.add_argument("--ssh-port", type=int, default=None)
     parser.add_argument("--start-timeout", type=int, default=60,
                         help="seconds to wait for all ranks to connect")
@@ -527,6 +540,14 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
                 "(%s); worker log: %s\n"
                 % (slot.rank, where, describe_exit(rc),
                    log_path or "<unavailable>"))
+            ckpt_dir = os.environ.get("HVD_TPU_CKPT_DIR")
+            if ckpt_dir:
+                # Durable checkpoints were on: tell the operator what a
+                # relaunch of this same command recovers.
+                from horovod_tpu.elastic.durable import \
+                    describe_last_durable
+                sys.stderr.write(
+                    "[launcher] %s\n" % describe_last_durable(ckpt_dir))
         elif (exit_code == 0 and log_dir is not None
               and not os.environ.get("HVD_TPU_LOG_DIR")):
             # Clean run: reclaim the tmp log dir (an explicit
@@ -587,6 +608,17 @@ def main(argv=None):
         parser.error("no command given")
     if args.lint and not lint_preflight(command, args.lint):
         return 1
+    if args.ckpt_dir:
+        # Both launch paths (static run_command and the elastic driver)
+        # inherit this process's env into every worker; workers
+        # auto-enable durable commits from it (elastic/durable.py).
+        os.environ["HVD_TPU_CKPT_DIR"] = os.path.abspath(args.ckpt_dir)
+    if args.restart_from_ckpt and not (
+            args.ckpt_dir or os.environ.get("HVD_TPU_CKPT_DIR")):
+        # The env var is the documented equivalent of --ckpt-dir
+        # everywhere else (worker auto-enable, driver, summaries).
+        parser.error("--restart-from-ckpt requires --ckpt-dir (or "
+                     "HVD_TPU_CKPT_DIR in the environment)")
     if args.metrics_port:
         # Workers read the base port from env and offset by their rank
         # (elastic re-ranks included); run_command/run_elastic inherit
@@ -639,7 +671,14 @@ def main(argv=None):
                            max_np=args.max_np or np_,
                            ssh_port=args.ssh_port,
                            start_timeout=args.start_timeout,
-                           verbose=args.verbose)
+                           verbose=args.verbose,
+                           ckpt_dir=os.environ.get("HVD_TPU_CKPT_DIR"),
+                           restart_from_ckpt=args.restart_from_ckpt)
+    if args.restart_from_ckpt:
+        parser.error("--restart-from-ckpt needs elastic mode (give "
+                     "--min-np / --max-np / --host-discovery-script); "
+                     "the static launcher has no supervisor to relaunch "
+                     "the job")
     if args.num_proc is None:
         parser.error("-np is required")
     return run_command(args.num_proc, hosts, command,

@@ -54,10 +54,10 @@ def render(job, prev_job, dt, endpoint):
     lines.append("hvd-top — %s — size %d, generation %d — %s" % (
         endpoint, int(job.get("size", 0)), int(job.get("generation", 0)),
         time.strftime("%H:%M:%S")))
-    header = ("%4s %9s %9s %8s %9s %9s %7s %6s %6s %6s %5s %5s %5s %9s"
+    header = ("%4s %9s %9s %8s %9s %9s %7s %6s %6s %6s %5s %5s %5s %7s %9s"
               % ("rank", "cyc/s", "cyc_ms", "ops/s", "B/s", "fused_B",
                  "cache%", "queue", "stall", "diverr", "crc", "nto",
-                 "rcn", "lag_s"))
+                 "rcn", "dur", "lag_s"))
     lines.append(header)
     lines.append("-" * len(header))
 
@@ -84,7 +84,7 @@ def render(job, prev_job, dt, endpoint):
             max_lag_delta, straggler = lag_delta, ri
         faults_total += int(cur.get("faults_injected_total", 0))
         lines.append("%4s %9s %9.2f %8s %9s %9s %6.1f%% %6d %6d %6d %5d "
-                     "%5d %5d %9.2f"
+                     "%5d %5d %7d %9.2f"
                      % (r,
                         _fmt_rate(cyc_rate),
                         cyc_ms,
@@ -103,6 +103,10 @@ def render(job, prev_job, dt, endpoint):
                         int(cur.get("net_crc_errors_total", 0)),
                         int(cur.get("net_timeouts_total", 0)),
                         int(cur.get("net_reconnects_total", 0)),
+                        # Durable checkpoints: the newest step this rank
+                        # knows is safely on disk (-1 = durability off /
+                        # nothing written yet) — docs/ELASTIC.md.
+                        int(cur.get("last_durable_step", -1)),
                         lag_total))
     if faults_total:
         lines.append("! fault injection active: %d fault(s) injected "

@@ -8,6 +8,7 @@ import collections
 import os
 import sys
 import tempfile
+import time
 
 import numpy as np
 import jax.numpy as jnp
@@ -47,6 +48,29 @@ def main():
     assert np.allclose(np.asarray(out["mu"]), 0.5), out["mu"]
     assert int(out["counters"].zz_mini) == 111, out["counters"]
     assert int(out["counters"].aa_grad) == 222, out["counters"]
+
+    # Error paths must raise the NAMED error on EVERY rank, promptly —
+    # historically a root-side failure left the other ranks blocked in
+    # the completion barrier until the stall timeout (the satellite fix:
+    # the root broadcasts a success flag before any barrier collective).
+    t0 = time.monotonic()
+    try:
+        checkpoint.save("/proc/nonexistent/unwritable", tree)
+    except checkpoint.CheckpointSaveError:
+        pass
+    else:
+        raise AssertionError("save to an unwritable path did not raise")
+    try:
+        checkpoint.restore(tmpdir, template, step=99)  # never written
+    except checkpoint.CheckpointRestoreError:
+        pass
+    else:
+        raise AssertionError("restore of a missing step did not raise")
+    elapsed = time.monotonic() - t0
+    # Both failures must surface collectively in seconds, not via the
+    # multi-minute stall timeout the old deadlock needed.
+    assert elapsed < 30, "error propagation took %.1fs" % elapsed
+
     print("rank %d: checkpoint tests passed" % r, flush=True)
     return 0
 

@@ -45,6 +45,21 @@ def main():
     num_tensors = int(os.environ.get("HVD_TPU_FUZZ_TENSORS", "40"))
     rounds = int(os.environ.get("HVD_TPU_FUZZ_ROUNDS", "1"))
     seed = int(os.environ.get("HVD_TPU_FUZZ_SEED", "1234"))
+
+    # Durable-writer race check (the sanitizer durable variant,
+    # native/Makefile): a background checkpoint writer commits every
+    # round — pickling snapshots, calling the crc32c and ckpt-metrics C
+    # APIs from ITS thread — concurrently with the fuzz's out-of-order
+    # enqueues, the background coordination thread, and the scraper.
+    # HVD_TPU_CKPT_FAULT_SPEC additionally drives the retry/degrade
+    # paths under the same concurrency.
+    state = None
+    if os.environ.get("HVD_TPU_FUZZ_DURABLE") == "1":
+        from horovod_tpu import elastic
+
+        state = elastic.ElasticState(
+            w=np.arange(4096, dtype=np.float64) * (r + 1), step=0)
+        state.enable_durable()  # HVD_TPU_CKPT_DIR
     jobs = []
     for i in range(num_tensors):
         kind = ("allreduce", "allgather", "broadcast")[i % 3]
@@ -99,6 +114,15 @@ def main():
                 assert out.shape == (2, idx + 1), (idx, out.shape)
                 assert np.allclose(out, float(root * 100 + idx)), (idx,
                                                                    out)
+
+        if state is not None:
+            state.step = rnd + 1
+            state.w = state.w + 1.0
+            state.commit()
+
+    if state is not None:
+        assert state._durable.flush(timeout=120), \
+            "durable writer did not drain"
 
     if scraper is not None:
         stop_scraper.set()

@@ -56,6 +56,12 @@ BAD_CORPUS = {
         hj.allreduce(x, name="g", average=True)
         hj.allreduce(y, name="g", average=False)
     """,
+    "checkpoint-in-rank-guard": """
+        import horovod_tpu.jax as hvd
+        from horovod_tpu.jax import checkpoint
+        if hvd.rank() == 0:
+            checkpoint.save("/ckpt", tree, step=5)
+    """,
 }
 
 # --- known-good twins: the corrected version of each snippet ----------------
@@ -96,6 +102,13 @@ GOOD_CORPUS = {
         import horovod_tpu.jax as hj
         hj.allreduce(x, name="g.sum", average=False)
         hj.allreduce(y, name="g.mean", average=True)
+    """,
+    "checkpoint-in-rank-guard": """
+        import horovod_tpu.jax as hvd
+        from horovod_tpu.jax import checkpoint
+        checkpoint.save("/ckpt", tree, step=5)
+        if hvd.rank() == 0:
+            print("saved")
     """,
 }
 
@@ -154,6 +167,43 @@ def test_elastic_commit_under_rank_conditional():
         if hvd.rank() == 0:
             state.commit()
     """)
+
+
+def test_checkpoint_rank_guard_variants():
+    # restore under a guard is the same deadlock as save.
+    assert "checkpoint-in-rank-guard" in rules_of("""
+        import horovod_tpu.jax as hvd
+        from horovod_tpu.jax import checkpoint
+        if hvd.rank() == 0:
+            tree = checkpoint.restore("/ckpt", template, step=5)
+    """)
+    # Dotted access through the hvd alias counts too.
+    assert "checkpoint-in-rank-guard" in rules_of("""
+        import horovod_tpu.jax as hvd
+        r = hvd.rank()
+        if r == 0:
+            hvd.checkpoint.save("/ckpt", tree)
+    """)
+    # The generic rank-conditional-collective rule must NOT double-fire
+    # on the same site.
+    findings = lint_source(textwrap.dedent("""
+        import horovod_tpu.jax as hvd
+        from horovod_tpu.jax import checkpoint
+        if hvd.rank() == 0:
+            checkpoint.save("/ckpt", tree)
+    """))
+    assert [f.rule for f in findings] == ["checkpoint-in-rank-guard"]
+
+
+def test_checkpoint_rank_guard_ignores_unrelated_save():
+    # model.save() / state.save() under a rank guard is ordinary
+    # rank-0-only work (no collectives inside) — not our business.
+    assert rules_of("""
+        import horovod_tpu as hvd
+        if hvd.rank() == 0:
+            model.save("/weights.h5")
+            state.save()
+    """) == []
 
 
 def test_parse_error_is_a_finding():
