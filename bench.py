@@ -188,17 +188,18 @@ def _reserve_ports(n):
     return socks, ports
 
 
-def _run_negotiation_bench(n, iters, extra_env=None, timeout=1800):
-    """Launches n local control-plane workers (numpy+ctypes only);
-    returns (rank-0 negotiation latency us/op, protocol counters by
-    rank for ranks 0 and 1 — bytes/messages/cycle kinds)."""
+def _spawn_local_workers(n, script, extra_env=None):
+    """Reserves ports and spawns n local control-plane worker
+    subprocesses (numpy+ctypes only) of tests/`script` with the shared
+    rank/rendezvous env; returns (procs, socks) — the caller owns
+    communicate/kill and closing the sockets."""
     socks, ports = _reserve_ports(n)
     addrs = ",".join("127.0.0.1:%d" % p for p in ports)
-    procs, outputs = [], []
+    procs = []
     for r in range(n):
         env = dict(os.environ)
-        # Negotiation workers are numpy+ctypes only; drop PYTHONPATH
-        # entries that exist to register accelerator plugins (their
+        # The workers are numpy+ctypes only; drop PYTHONPATH entries
+        # that exist to register accelerator plugins (their
         # sitecustomize costs seconds of interpreter boot per worker —
         # at 256 serialized starts that dwarfs the measurement).
         inherited = [
@@ -211,7 +212,6 @@ def _run_negotiation_bench(n, iters, extra_env=None, timeout=1800):
             "HVD_TPU_LOCAL_RANK": str(r), "HVD_TPU_LOCAL_SIZE": str(n),
             "HVD_TPU_CROSS_RANK": "0", "HVD_TPU_CROSS_SIZE": "1",
             "HVD_TPU_ADDRS": addrs, "HVD_TPU_CYCLE_TIME": "0",
-            "HVD_TPU_BENCH_ITERS": str(iters),
             "HVD_TPU_LISTEN_REUSEPORT": "1",
             # Interpreter startup for n ranks is serialized on small
             # hosts; the default 60s accept timeout starves out at
@@ -221,10 +221,21 @@ def _run_negotiation_bench(n, iters, extra_env=None, timeout=1800):
         if extra_env:
             env.update(extra_env)
         procs.append(subprocess.Popen(
-            [sys.executable,
-             os.path.join(REPO, "tests", "negotiation_bench_worker.py")],
+            [sys.executable, os.path.join(REPO, "tests", script)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True))
+    return procs, socks
+
+
+def _run_negotiation_bench(n, iters, extra_env=None, timeout=1800):
+    """Launches n local control-plane workers (numpy+ctypes only);
+    returns (rank-0 negotiation latency us/op, protocol counters by
+    rank for ranks 0 and 1 — bytes/messages/cycle kinds)."""
+    env = {"HVD_TPU_BENCH_ITERS": str(iters)}
+    env.update(extra_env or {})
+    procs, socks = _spawn_local_workers(n, "negotiation_bench_worker.py",
+                                        env)
+    outputs = []
     us = None
     counters = {}
     try:
@@ -438,6 +449,182 @@ def durable_commit_main(args):
         "baseline": "durable-off in-memory commit (same %dMB state); "
                     "acceptance: <= 1.10 (writes overlap training)" % mb,
     })
+    return 0
+
+
+def _run_compression_bench(n, iters, mb, mode, timeout=900):
+    """Launches n local workers allreducing an `mb`-MB f32 payload under
+    compression `mode` (control-plane + numpy only, no jax); returns
+    per-rank dicts of wall time and socket-layer wire counters."""
+    procs, socks = _spawn_local_workers(
+        n, "compression_bench_worker.py",
+        {"HVD_TPU_BENCH_ITERS": str(iters),
+         "HVD_TPU_BENCH_MB": str(mb),
+         "HVD_TPU_COMPRESSION": mode})
+    outputs = []
+    rows = {}
+    try:
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=timeout)
+            outputs.append(out)
+            if p.returncode != 0:
+                raise RuntimeError("compression bench rank %d (mode %s) "
+                                   "failed:\n%s" % (r, mode, out))
+            m = re.search(r"COMPRESSION_BENCH (\{.*\})", out)
+            if m:
+                d = json.loads(m.group(1))
+                rows[d["rank"]] = d
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for s in socks:
+            s.close()
+    if 0 not in rows:
+        raise RuntimeError("no COMPRESSION_BENCH line from rank 0:\n%s"
+                           % (outputs[0] if outputs else "<no output>"))
+    return rows
+
+
+def _compression_convergence(steps=40, tolerance=0.05):
+    """Trains the same tiny MLP regression twice on an 8-device virtual
+    CPU mesh — exact fp32 psum gradients vs the int8 block-quantized
+    ring — and compares the loss curves. Returns the curve stats; the
+    caller asserts `loss_match`."""
+    # The int8 ring only engages over a >= 2-device mesh: force the
+    # virtual CPU device count BEFORE jax initializes, and fail loudly
+    # if a pre-initialized 1-device jax sneaks through — a 1-device
+    # "A/B" would be two identical fp32 runs and a vacuous loss_match.
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_"
+                                   "count=8").strip()
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.parallel.ring import ring_allreduce
+
+    cpus = jax.devices("cpu")
+    n = min(8, len(cpus))
+    if n < 2:
+        raise RuntimeError(
+            "compression convergence A/B needs >= 2 cpu devices; got %d "
+            "(jax initialized before the device-count flag applied?)" % n)
+    mesh = Mesh(np.array(cpus[:n]), ("dp",))
+    rng = np.random.RandomState(0)
+    d_in, d_h, batch = 64, 128, 32 * n
+    x = rng.randn(batch, d_in).astype(np.float32)
+    w_true = rng.randn(d_in, 1).astype(np.float32)
+    y = np.tanh(x @ w_true) + 0.01 * rng.randn(batch, 1).astype(np.float32)
+
+    def init_params():
+        r = np.random.RandomState(1)
+        return {"w1": jnp.asarray(r.randn(d_in, d_h).astype(np.float32)
+                                  * 0.1),
+                "w2": jnp.asarray(r.randn(d_h, 1).astype(np.float32) * 0.1)}
+
+    def make_step(mode, lr=0.05):
+        def step(params, bx, by):
+            def loss_fn(p):
+                h = jnp.tanh(bx @ p["w1"])
+                return jnp.mean((h @ p["w2"] - by) ** 2)
+
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            if mode == "none":
+                g = {k: lax.psum(v, "dp") / n for k, v in g.items()}
+            else:
+                g = {k: ring_allreduce(v, "dp", compression=mode) / n
+                     for k, v in g.items()}
+            params = {k: params[k] - lr * g[k] for k in params}
+            return params, lax.pmean(loss, "dp")
+
+        return jax.jit(jax.shard_map(
+            step, mesh=mesh, in_specs=(P(), P("dp"), P("dp")),
+            out_specs=(P(), P()), check_vma=False))
+
+    curves = {}
+    for mode in ("none", "int8"):
+        step = make_step(mode)
+        params = init_params()
+        losses = []
+        for _ in range(steps):
+            params, loss = step(params, x, y)
+            losses.append(float(loss))
+        curves[mode] = losses
+
+    ref = np.asarray(curves["none"])
+    got = np.asarray(curves["int8"])
+    # Relative divergence after the first few steps (early steps have
+    # near-zero denominators as both curves drop fast).
+    rel = np.abs(got[3:] - ref[3:]) / (np.abs(ref[3:]) + 1e-8)
+    return {
+        "steps": steps, "devices": n,
+        "fp32_final_loss": round(float(ref[-1]), 6),
+        "int8_final_loss": round(float(got[-1]), 6),
+        "max_rel_divergence_after_step3": round(float(rel.max()), 4),
+        "tolerance": tolerance,
+        "loss_match": bool(rel.max() < tolerance),
+    }
+
+
+def compression_main(args):
+    """bench.py --compression {none,bf16,int8}: A/B the host data
+    plane's wire compression stage (docs/COMPRESSION.md). Measures the
+    actual data-ring socket bytes (net_ring_bytes counters, headers
+    included) and wall time per 4MB allreduce with compression off vs
+    the requested mode, plus the int8-vs-fp32 convergence run.
+    Acceptance (ISSUE 6): bf16 moves >= 1.9x fewer allreduce wire bytes
+    than none, and the int8 loss curve matches fp32 within tolerance."""
+    mode = args.compression
+    iters, mb = max(10, args.num_iters), 4
+    rows = {"none": _run_compression_bench(2, iters, mb, "none")}
+    if mode != "none":
+        rows[mode] = _run_compression_bench(2, iters, mb, mode)
+
+    def rank0(m, field):
+        return rows[m][0][field]
+
+    none_bytes = rank0("none", "ring_bytes_sent")
+    out = {
+        "metric": "compression_allreduce_wire_reduction",
+        "unit": "x_ring_bytes_none_over_%s" % mode,
+        "mode": mode,
+        "payload_mb": mb, "iters": iters, "ranks": 2,
+        "none_ring_bytes_sent": none_bytes,
+        "none_us_per_op": rank0("none", "us_per_op"),
+    }
+    if mode != "none":
+        mode_bytes = rank0(mode, "ring_bytes_sent")
+        out["value"] = round(none_bytes / mode_bytes, 3)
+        out["%s_ring_bytes_sent" % mode] = mode_bytes
+        out["%s_us_per_op" % mode] = rank0(mode, "us_per_op")
+        out["codec_ratio"] = round(
+            rank0(mode, "codec_bytes_in") /
+            max(1, rank0(mode, "codec_bytes_out")), 3)
+        print("compression %s: wire %.2fx smaller (%d -> %d B), "
+              "%.0f -> %.0f us/op"
+              % (mode, out["value"], none_bytes, mode_bytes,
+                 out["none_us_per_op"], out["%s_us_per_op" % mode]),
+              file=sys.stderr)
+    else:
+        out["value"] = 1.0
+
+    out["convergence_int8_vs_fp32"] = _compression_convergence()
+    if not out["convergence_int8_vs_fp32"]["loss_match"]:
+        raise RuntimeError("int8 convergence diverged from fp32: %s"
+                           % out["convergence_int8_vs_fp32"])
+    # BENCH_r05 predates the compression stage, so the baseline is the
+    # same-run compression=none wire bytes; vs_baseline is the measured
+    # reduction over that baseline.
+    out["vs_baseline"] = out["value"]
+    out["baseline"] = ("same-run compression=none data-ring bytes "
+                      "(BENCH_r05 predates the compression stage); "
+                      "acceptance: bf16 >= 1.9x, int8 convergence "
+                      "loss_match true")
+    emit(out)
     return 0
 
 
@@ -841,6 +1028,13 @@ def main():
                     help="run the whole model-zoo sweep (one subprocess "
                          "per model) and print a single combined JSON "
                          "line")
+    ap.add_argument("--compression", choices=["none", "bf16", "int8"],
+                    default=None,
+                    help="A/B the wire-compression stage "
+                         "(docs/COMPRESSION.md): data-ring bytes + "
+                         "step time with compression off vs this mode "
+                         "(2 local ranks, CPU-only), plus the int8 vs "
+                         "fp32 convergence run; prints one JSON line")
     ap.add_argument("--durable-commit", action="store_true",
                     help="measure ElasticState.commit() latency with "
                          "the durable checkpoint writer off vs on "
@@ -873,6 +1067,8 @@ def main():
 
     if args.scaling_worker is not None:
         return scaling_worker(args)
+    if args.compression is not None:
+        return compression_main(args)
     if args.durable_commit:
         return durable_commit_main(args)
     if args.scaling:

@@ -105,8 +105,16 @@ class HorovodBasics:
         lib.horovod_tpu_enqueue_allreduce.argtypes = [
             ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_double,
-            ctypes.c_double,
+            ctypes.c_double, ctypes.c_int,
         ]
+        lib.horovod_tpu_parse_compression.restype = ctypes.c_int
+        lib.horovod_tpu_parse_compression.argtypes = [ctypes.c_char_p]
+        lib.horovod_tpu_effective_compression.restype = ctypes.c_int
+        lib.horovod_tpu_effective_compression.argtypes = [ctypes.c_int,
+                                                          ctypes.c_int]
+        lib.horovod_tpu_compressed_size.restype = ctypes.c_int64
+        lib.horovod_tpu_compressed_size.argtypes = [ctypes.c_int64,
+                                                    ctypes.c_int]
         lib.horovod_tpu_enqueue_allgather.restype = ctypes.c_int
         lib.horovod_tpu_enqueue_allgather.argtypes = [
             ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int,
@@ -266,6 +274,18 @@ class HorovodBasics:
         self.lib.horovod_tpu_ckpt_metrics(
             int(writes), int(failures), int(nbytes), int(restores),
             int(restore_failures), int(last_step), float(write_seconds))
+
+    def compressed_size(self, count, mode):
+        """Wire bytes `count` f32 elements occupy under compression
+        mode `mode` (native/compression.cc layout)."""
+        return int(self.lib.horovod_tpu_compressed_size(
+            int(count), int(mode)))
+
+    def effective_compression(self, mode, dtype):
+        """The mode a payload of native dtype id `dtype` actually rides
+        the wire with (non-f32 degrades to 0 = none)."""
+        return int(self.lib.horovod_tpu_effective_compression(
+            int(mode), int(dtype)))
 
     def autotune_params(self):
         """Current synchronized knob values (autotune introspection):

@@ -11,6 +11,7 @@ import os
 
 import numpy as np
 
+from horovod_tpu import compression as _compression
 from .basics import get_basics, numpy_to_hvd_dtype, _DTYPE_TO_NUMPY
 
 # handle -> (input array, output array or None) — keeps buffers alive while
@@ -31,15 +32,22 @@ def _shape_array(arr):
 
 
 def allreduce_async(tensor, name, prescale_factor=1.0, postscale_factor=1.0,
-                    out=None):
+                    out=None, compression=None):
     """Starts an allreduce (sum) on a numpy array; returns a handle.
 
     `out`, when given, is a C-contiguous same-dtype/size array the core
     writes the result into directly — it MAY alias the input (the native
     ops guard self-copy: cpu_operations.cc `e.output != e.data`). This
     is the zero-copy path for framework tensors whose memory numpy can
-    view (torch CPU tensors)."""
+    view (torch CPU tensors).
+
+    `compression` selects the wire codec ('none'/'bf16'/'int8' or a
+    `horovod_tpu.compression.Compression` mode; None defers to
+    HVD_TPU_COMPRESSION). The array stays this dtype end to end — only
+    ring-hop payloads are encoded — and the mode rides the negotiation,
+    so every rank must pass the same value (docs/COMPRESSION.md)."""
     basics = get_basics()
+    mode = _compression.resolve(compression)
     arr = np.ascontiguousarray(tensor)
     # ascontiguousarray promotes 0-d to (1,); the result must round-trip
     # the caller's shape (a reshape view shares the output buffer).
@@ -49,7 +57,7 @@ def allreduce_async(tensor, name, prescale_factor=1.0, postscale_factor=1.0,
         name.encode("utf-8"), arr.ctypes.data_as(ctypes.c_void_p),
         out.ctypes.data_as(ctypes.c_void_p), arr.ndim, _shape_array(arr),
         numpy_to_hvd_dtype(arr.dtype), float(prescale_factor),
-        float(postscale_factor))
+        float(postscale_factor), int(mode.mode))
     _handle_map[handle] = (arr, out)
     return handle
 
@@ -158,12 +166,13 @@ def _view_core_buffer(basics, handle, ptr, nbytes, dtype, shape):
 
 
 def allreduce(tensor, name, average=False, prescale_factor=1.0,
-              postscale_factor=1.0):
+              postscale_factor=1.0, compression=None):
     """Synchronous allreduce; returns the reduced array."""
     if average:
         postscale_factor = postscale_factor / get_basics().size()
     return synchronize(allreduce_async(tensor, name, prescale_factor,
-                                       postscale_factor))
+                                       postscale_factor,
+                                       compression=compression))
 
 
 def allgather(tensor, name):

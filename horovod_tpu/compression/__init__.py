@@ -1,0 +1,231 @@
+"""Pluggable gradient compression (docs/COMPRESSION.md).
+
+The modes here are *wire* codecs: the tensor (and the native core's
+fusion buffer) stays float32 end to end — only the bytes each transport
+hop moves are re-encoded. Selectable per optimizer / per collective
+(``hvd.DistributedOptimizer(compression="int8")``,
+``hvd.allreduce(x, compression="bf16")``) and job-wide via
+``HVD_TPU_COMPRESSION``; the mode rides the negotiation protocol, so
+mixed-mode ranks are rejected by name and a mode change is a response-
+cache miss.
+
+* ``none`` — bitwise-identical behavior to an uncompressed build.
+* ``bf16`` — each f32 element rides the wire as round-to-nearest
+  bfloat16: 2x fewer bytes per hop. Reduction still accumulates in f32
+  on both data planes, so the loss is one rounding per hop — but the
+  summation is no longer bit-identical to the uncompressed sum (exact
+  sum-order caveats in docs/COMPRESSION.md).
+* ``int8`` — EQuARX-style block-scaled quantization (PAPERS.md, arxiv
+  2506.17615): per :data:`BLOCK`-element block an f32 scale
+  (``max|x| / 127``) is carried in-band ahead of the int8 payload,
+  ~3.9x fewer bytes per hop, per-element error bounded by ``scale/2``.
+
+Numpy reference quantizers live here (the native codec in
+``native/compression.cc`` implements the same layout; tests pin them
+against each other) plus the jax block quantizers the in-jit ring
+allreduce (:func:`horovod_tpu.parallel.ring.ring_allreduce`) fuses into
+its per-hop compute.
+
+Integer and embedding-lookup tensors must NOT be compressed — lossy
+quantization silently corrupts them; ``hvd-lint`` flags it statically
+(rule ``compression-on-integer-tensor``) and the core degrades non-f32
+payloads to ``none`` at enqueue so the wire can never desync.
+"""
+
+import os
+
+import numpy as np
+
+# Mode ids — must match native/compression.h CompressionMode.
+NONE = 0
+BF16 = 1
+INT8 = 2
+
+# Elements per int8 quantization block (one in-band f32 scale each);
+# must match native/compression.h kCompressionBlock.
+BLOCK = 256
+
+ENV_VAR = "HVD_TPU_COMPRESSION"
+
+
+class Mode(object):
+    """One wire-compression mode (hashable, comparable by id)."""
+
+    __slots__ = ("mode", "name")
+
+    def __init__(self, mode, name):
+        self.mode = mode
+        self.name = name
+
+    def __repr__(self):
+        return "Compression.%s" % self.name
+
+    def __eq__(self, other):
+        if isinstance(other, Mode):
+            return self.mode == other.mode
+        if isinstance(other, str):
+            return self.name == other
+        if isinstance(other, int):
+            return self.mode == other
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(self.mode)
+
+
+class Compression(object):
+    """The selectable modes, as attributes (``Compression.int8``) —
+    strings ("int8") and ints (2) resolve to the same objects."""
+
+    none = Mode(NONE, "none")
+    bf16 = Mode(BF16, "bf16")
+    int8 = Mode(INT8, "int8")
+
+
+_BY_KEY = {
+    None: Compression.none,
+    "": Compression.none,
+    "none": Compression.none, "0": Compression.none, NONE: Compression.none,
+    "bf16": Compression.bf16, "1": Compression.bf16, BF16: Compression.bf16,
+    "int8": Compression.int8, "2": Compression.int8, INT8: Compression.int8,
+}
+
+
+def default_mode():
+    """The job-wide mode from ``HVD_TPU_COMPRESSION`` (none when unset
+    or unparseable — an env typo must not silently quantize)."""
+    v = os.environ.get(ENV_VAR, "").strip().lower()
+    return _BY_KEY.get(v, Compression.none)
+
+
+def resolve(spec):
+    """Maps a user-facing ``compression=`` value to a :class:`Mode`.
+
+    ``None`` defers to the env default; strings/ints/Modes map directly.
+    Legacy codec classes (objects with a ``compress`` attribute, e.g.
+    ``hvd.jax.Compression.fp16``) are NOT accepted here — the framework
+    bindings intercept those before the wire layer.
+    """
+    if isinstance(spec, Mode):
+        return spec
+    if spec is None:
+        return default_mode()
+    if hasattr(spec, "compress"):
+        raise TypeError(
+            "legacy codec objects (%r) belong to the framework binding "
+            "layer; pass 'none'/'bf16'/'int8' (or Compression.<mode>) "
+            "for wire compression" % (spec,))
+    key = spec.lower().strip() if isinstance(spec, str) else spec
+    try:
+        return _BY_KEY[key]
+    except (KeyError, TypeError):
+        raise ValueError(
+            "unknown compression mode %r (expected 'none', 'bf16' or "
+            "'int8')" % (spec,))
+
+
+def wire_bytes(count, mode):
+    """Wire bytes `count` f32 elements occupy under `mode` — the same
+    pure function of (count, mode) both ring endpoints size buffers
+    with (native/compression.cc CompressedSize)."""
+    mode = resolve(mode)
+    if mode.mode == BF16:
+        return 2 * count
+    if mode.mode == INT8:
+        nblocks = (count + BLOCK - 1) // BLOCK
+        return 4 * nblocks + count
+    return 4 * count
+
+
+# --- numpy reference quantizers (tests pin the native codec to these) ---
+
+
+def quantize_int8(x, block=BLOCK):
+    """Block-scaled int8 quantization of a float array.
+
+    Returns ``(q, scales)``: ``q`` int8 with ``x.size`` elements,
+    ``scales`` f32 with one ``max|block| / 127`` entry per block (the
+    last block may be short). Symmetric range [-127, 127] — -128 is
+    never produced — so ``|x - dequantize| <= scales[b] / 2`` holds per
+    element, the bound the round-trip tests assert.
+
+    Nonfinite inputs (an overflowed gradient step) make the block's
+    in-band SCALE NaN, so the block decodes nonfinite — isfinite /
+    loss-scale skip-step guards downstream of the allreduce still fire
+    (matching the native codec and bf16's NaN preservation).
+    """
+    flat = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    n = flat.size
+    nblocks = (n + block - 1) // block
+    padded = np.zeros(nblocks * block, np.float32)
+    padded[:n] = flat
+    blocks = padded.reshape(nblocks, block)
+    with np.errstate(invalid="ignore", over="ignore"):
+        amax = np.max(np.abs(blocks), axis=1)  # NaN-propagating max
+        scales = np.where(np.isfinite(amax),
+                          np.where(amax > 0, amax / 127.0, 0.0),
+                          np.float32(np.nan)).astype(np.float32)
+        finite_scale = np.where(np.isfinite(scales) & (scales > 0),
+                                scales, 1.0)
+        inv = np.where(np.isfinite(scales) & (scales > 0),
+                       1.0 / finite_scale, 0.0)
+        q = np.clip(np.rint(np.nan_to_num(blocks * inv[:, None])),
+                    -127, 127).astype(np.int8)
+    return q.reshape(-1)[:n], scales
+
+
+def dequantize_int8(q, scales, block=BLOCK):
+    """Inverse of :func:`quantize_int8` (up to the codec's rounding)."""
+    flat = np.ascontiguousarray(q, dtype=np.int8).reshape(-1)
+    n = flat.size
+    nblocks = (n + block - 1) // block
+    padded = np.zeros(nblocks * block, np.int8)
+    padded[:n] = flat
+    out = padded.reshape(nblocks, block).astype(np.float32) * \
+        np.asarray(scales, np.float32)[:, None]
+    return out.reshape(-1)[:n]
+
+
+def bf16_roundtrip(x):
+    """f32 -> bfloat16 (round-to-nearest-even) -> f32, in numpy bit
+    arithmetic — what one bf16 wire hop does to a value. NaNs quiet to
+    a canonical NaN instead of rounding (the RNE increment would carry
+    an all-ones-mantissa NaN out into a FINITE value), matching the
+    native codec (half.h FloatToBFloat16)."""
+    bits = np.ascontiguousarray(x, dtype=np.float32).view(np.uint32)
+    is_nan = (bits & np.uint32(0x7FFFFFFF)) > np.uint32(0x7F800000)
+    lsb = (bits >> 16) & 1
+    with np.errstate(over="ignore"):
+        rounded = (bits + 0x7FFF + lsb) & np.uint32(0xFFFF0000)
+    quiet_nan = ((bits >> 16) | np.uint32(0x40)).astype(np.uint32) << 16
+    return np.where(is_nan, quiet_nan, rounded).astype(
+        np.uint32).view(np.float32)
+
+
+# --- jax block quantizers (fused into the ring's per-hop compute) ---
+
+
+def quantize_int8_jax(x, block=BLOCK):
+    """jax version of :func:`quantize_int8` for a 1-D f32 array whose
+    length is a multiple of `block` (the ring pads its chunks).
+    Returns ``(q int8 [nblocks, block], scales f32 [nblocks])``.
+    Nonfinite blocks get a NaN scale (see :func:`quantize_int8`)."""
+    import jax.numpy as jnp
+
+    xb = x.reshape(-1, block)
+    amax = jnp.max(jnp.abs(xb), axis=1)  # NaN-propagating max
+    ok = jnp.isfinite(amax)
+    scales = jnp.where(ok, jnp.where(amax > 0, amax / 127.0, 0.0),
+                       jnp.nan)
+    pos = ok & (scales > 0)
+    inv = jnp.where(pos, 1.0 / jnp.where(pos, scales, 1.0), 0.0)
+    q = jnp.clip(jnp.round(jnp.nan_to_num(xb * inv[:, None])), -127,
+                 127).astype(jnp.int8)
+    return q, scales.astype(jnp.float32)
+
+
+def dequantize_int8_jax(q, scales):
+    """Inverse of :func:`quantize_int8_jax`; returns 1-D f32."""
+    import jax.numpy as jnp
+
+    return (q.astype(jnp.float32) * scales[:, None]).reshape(-1)

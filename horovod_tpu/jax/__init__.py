@@ -27,6 +27,7 @@ from horovod_tpu.compat import ensure_jax_compat as _ensure_jax_compat
 _ensure_jax_compat()
 
 import horovod_tpu as _hvd
+from horovod_tpu import compression as _wire
 from horovod_tpu import (  # noqa: F401
     init, shutdown, is_initialized, rank, local_rank, cross_rank, size,
     local_size, cross_size, is_homogeneous,
@@ -105,7 +106,25 @@ def _host_callback(fn, tensor):
 
 
 class Compression:
-    """Gradient compression codecs (reference: tensorflow/compression.py)."""
+    """Gradient compression codecs (reference: tensorflow/compression.py).
+
+    Two families share this namespace:
+
+    * legacy tensor codecs (``fp16``/``bf16`` classes below) cast the
+      TENSOR before the collective and back after — reduction then
+      accumulates in the narrow dtype;
+    * wire modes (``wire_bf16``/``wire_int8``, =
+      ``horovod_tpu.compression.Compression``) re-encode only the bytes
+      each transport hop moves, keeping the f32 accumulator — the
+      preferred, negotiated, cache-keyed path (docs/COMPRESSION.md).
+      Strings ('bf16', 'int8') and ``HVD_TPU_COMPRESSION`` select these.
+    """
+
+    # Wire modes (docs/COMPRESSION.md): negotiated per tensor, f32
+    # accumulation, selectable by string everywhere compression= is
+    # accepted.
+    wire_bf16 = _wire.Compression.bf16
+    wire_int8 = _wire.Compression.int8
 
     class none:
         @staticmethod
@@ -142,22 +161,50 @@ class Compression:
 
 
 def allreduce(tensor, average=True, name=None, axis_name=AXIS_NAME,
-              compression=Compression.none, prescale_factor=1.0,
+              compression=None, prescale_factor=1.0,
               postscale_factor=1.0):
-    """Allreduce across ranks (and, in-jit, across the mapped axis)."""
+    """Allreduce across ranks (and, in-jit, across the mapped axis).
+
+    ``compression``: a wire mode ('none'/'bf16'/'int8', a
+    ``horovod_tpu.compression`` mode, or None = HVD_TPU_COMPRESSION) —
+    or a legacy tensor codec (``Compression.fp16``/``.bf16``), which
+    keeps its historical cast-the-tensor semantics. Wire modes keep f32
+    accumulation on both data planes: in-jit, bf16 and int8 run the
+    EQuARX-style ``ring_allreduce`` with encode/decode fused into each
+    hop (narrow bytes on the link, f32 dequant-add); on the host plane
+    the mode rides the negotiation into the native ring
+    (docs/COMPRESSION.md).
+    """
+    legacy = compression is not None and hasattr(compression, "compress")
+    mode = _wire.Compression.none if legacy else _wire.resolve(compression)
     if _is_traced(tensor):
         if _axis_in_scope(axis_name):
-            # XLA/ICI plane: psum over the mapped axis; XLA emits an
-            # AllReduce that rides the TPU interconnect.
-            compressed, ctx = compression.compress(tensor)
+            # XLA/ICI plane. none/legacy: psum over the mapped axis; XLA
+            # emits an AllReduce that rides the TPU interconnect.
+            compressed, ctx = (compression.compress(tensor) if legacy
+                               else (tensor, None))
             if prescale_factor != 1.0:
                 compressed = compressed * prescale_factor
-            summed = jax.lax.psum(compressed, axis_name)
+            if mode.mode != _wire.NONE and \
+                    compressed.dtype == jnp.float32:
+                # Compressed modes ride the explicit ppermute ring: each
+                # hop ships the narrow payload but dequantizes and ADDS
+                # IN F32, preserving the f32-accumulation contract. (A
+                # bf16-operand psum would NOT: XLA's AllReduce reduction
+                # computation for a bf16 operand is add(bf16,bf16), so
+                # every pairwise add rounds — error grows with world
+                # size.)
+                from horovod_tpu.parallel.ring import ring_allreduce
+                summed = ring_allreduce(compressed, axis_name,
+                                        compression=mode)
+            else:
+                summed = jax.lax.psum(compressed, axis_name)
             if average:
                 summed = summed / jax.lax.psum(1, axis_name)
             if postscale_factor != 1.0:
                 summed = summed * postscale_factor
-            return compression.decompress(summed, ctx)
+            return compression.decompress(summed, ctx) if legacy \
+                else summed.astype(tensor.dtype)
         if _multi_process():
             # Plain jit, no mapped axis: ride the host core via an ordered
             # callback (the reference's "CPU op inside the graph" shape).
@@ -167,22 +214,27 @@ def allreduce(tensor, average=True, name=None, axis_name=AXIS_NAME,
                 return np.asarray(_ops.allreduce(
                     np.asarray(arr), op_name, average=average,
                     prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor)).astype(arr.dtype)
+                    postscale_factor=postscale_factor,
+                    compression=mode)).astype(arr.dtype)
 
-            compressed, ctx = compression.compress(tensor)
-            return compression.decompress(
-                _host_callback(_cb, compressed), ctx)
+            compressed, ctx = (compression.compress(tensor) if legacy
+                               else (tensor, None))
+            reduced = _host_callback(_cb, compressed)
+            return compression.decompress(reduced, ctx) if legacy \
+                else reduced
         _require_init_traced()
         # Single process: allreduce is identity up to scaling.
         scale = prescale_factor * postscale_factor
         return tensor * scale if scale != 1.0 else tensor
-    compressed, ctx = compression.compress(tensor)
+    compressed, ctx = (compression.compress(tensor) if legacy
+                       else (tensor, None))
     arr = np.asarray(compressed)
     out = _ops.allreduce(arr, name or _auto_name("allreduce"),
                          average=average, prescale_factor=prescale_factor,
-                         postscale_factor=postscale_factor)
+                         postscale_factor=postscale_factor,
+                         compression=mode)
     result = jnp.asarray(out)
-    return compression.decompress(result, ctx)
+    return compression.decompress(result, ctx) if legacy else result
 
 
 def allgather(tensor, name=None, axis_name=AXIS_NAME):
@@ -254,9 +306,13 @@ def _broadcast_one(tensor, root_rank, name, axis_name):
 
 
 def allreduce_gradients(grads, average=True, name_prefix="grad",
-                        compression=Compression.none, axis_name=AXIS_NAME):
+                        compression=None, axis_name=AXIS_NAME):
     """Allreduces a pytree of gradients (order-stable naming so all ranks
-    negotiate the same tensors)."""
+    negotiate the same tensors). ``compression`` as in :func:`allreduce`
+    (wire modes negotiate per leaf; the core fuses same-mode leaves into
+    one ring pass)."""
+    legacy = compression is not None and hasattr(compression, "compress")
+    mode = _wire.Compression.none if legacy else _wire.resolve(compression)
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if leaves and _is_traced(leaves[0]):
         reduced = [allreduce(g, average=average, axis_name=axis_name,
@@ -266,16 +322,17 @@ def allreduce_gradients(grads, average=True, name_prefix="grad",
     # cycle, then synchronize in order.
     handles = []
     for i, g in enumerate(leaves):
-        comp, ctx = compression.compress(g)
+        comp, ctx = compression.compress(g) if legacy else (g, None)
         arr = np.asarray(comp)
         postscale = 1.0 / _hvd.size() if average else 1.0
         handles.append((_ops.allreduce_async(arr, "%s.%d" % (name_prefix, i),
-                                             postscale_factor=postscale),
+                                             postscale_factor=postscale,
+                                             compression=mode),
                         ctx))
     reduced = []
     for h, ctx in handles:
         out = jnp.asarray(_ops.synchronize(h))
-        reduced.append(compression.decompress(out, ctx))
+        reduced.append(compression.decompress(out, ctx) if legacy else out)
     return jax.tree_util.tree_unflatten(treedef, reduced)
 
 
@@ -302,7 +359,7 @@ def broadcast_optimizer_state(opt_state, root_rank=0,
                                 name_prefix=name_prefix)
 
 
-def DistributedOptimizer(optimizer, compression=Compression.none,
+def DistributedOptimizer(optimizer, compression=None,
                          average=True, name_prefix="grad",
                          axis_name=AXIS_NAME):
     """Wraps an optax GradientTransformation so every update first averages
@@ -310,7 +367,10 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
     tensorflow/__init__.py:231-258).
 
     Works both inside a jitted+shard_map'd step (psum plane) and eagerly on
-    host arrays (core plane).
+    host arrays (core plane). ``compression='bf16'``/``'int8'`` (or
+    ``HVD_TPU_COMPRESSION``) shrinks the gradient bytes every hop moves
+    — see :func:`allreduce` and docs/COMPRESSION.md, including when NOT
+    to compress (integer/embedding gradients; hvd-lint flags those).
     """
     import optax
 

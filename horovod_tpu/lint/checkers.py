@@ -9,7 +9,8 @@ import collections
 from . import walker
 from .rules import ERROR, WARNING, make_finding, register
 from .walker import (COLLECTIVES, INITIAL_BROADCASTS, PREFIX_NAMED,
-                     TRAIN_MARKERS, describe_expr, expr_nondeterministic,
+                     TRAIN_MARKERS, describe_expr, expr_embedding_lookup,
+                     expr_integer_valued, expr_nondeterministic,
                      expr_rank_dependent, literal_name)
 
 
@@ -60,6 +61,76 @@ def check_checkpoint_rank_guard(model):
                     "it unconditionally on every rank"
                     % (site.func, cond.source))
                 break
+
+
+def _compression_mode_requested(site):
+    """The site's compression= expression when it selects a LOSSY wire
+    mode, else None. 'none'/Compression.none/None literals are clean;
+    anything else (strings, Compression attrs, variables) counts — a
+    dynamic mode may be lossy, and the cost of a false negative is
+    silent corruption."""
+    import ast
+    node = site.kwargs.get("compression")
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and \
+            node.value in (None, "none", "", 0):
+        return None
+    if isinstance(node, ast.Attribute) and node.attr == "none":
+        return None
+    return node
+
+
+@register("compression-on-integer-tensor", ERROR,
+          "lossy gradient compression applied to an integer or "
+          "embedding-lookup tensor")
+def check_compression_on_integer_tensor(model):
+    """bf16/int8 wire compression quantizes: an integer tensor (ids,
+    counts, masks, argmax results) decodes to DIFFERENT integers, and
+    embedding-lookup rows have per-block magnitude spreads that
+    quantization flattens — both corrupt silently (the run completes,
+    the numbers are wrong). The native core degrades non-f32 dtypes to
+    'none' at enqueue as a backstop, but int ids cast to f32 (or
+    embedding gradients) sail through — flag them at the call site."""
+    for site in model.call_sites:
+        comp_node = _compression_mode_requested(site)
+        if comp_node is None:
+            continue
+        # The tensor argument: positional 0 for the tensor-taking
+        # collectives, grads= / positional 0 for allreduce_gradients.
+        tensor_node = None
+        if site.args:
+            tensor_node = site.args[0]
+        for kw_name in ("tensor", "grads"):
+            if kw_name in site.kwargs:
+                tensor_node = site.kwargs[kw_name]
+        if tensor_node is None:
+            continue
+        comp_text = describe_expr(model, comp_node)
+        if expr_integer_valued(model, tensor_node):
+            yield make_finding(
+                model, site.node, "compression-on-integer-tensor",
+                "`%s` applies lossy compression `%s` to the integer "
+                "tensor `%s`: quantize/dequantize returns DIFFERENT "
+                "integers (ids, counts and masks corrupt silently — the "
+                "job keeps running on wrong values). Pass "
+                "compression='none' here (an explicit none overrides "
+                "HVD_TPU_COMPRESSION; merely deleting the argument "
+                "falls back to the env default), or keep the tensor in "
+                "its integer dtype so the core's dtype filter rides it "
+                "uncompressed"
+                % (site.func, comp_text, describe_expr(model, tensor_node)))
+        elif expr_embedding_lookup(model, tensor_node):
+            yield make_finding(
+                model, site.node, "compression-on-integer-tensor",
+                "`%s` applies lossy compression `%s` to embedding-lookup "
+                "data `%s`: looked-up rows (and their sparse gradients) "
+                "mix near-zero and hot rows in one quantization block, "
+                "exactly where block-scaled int8 loses the small values; "
+                "use compression='none' for embedding planes "
+                "(hvd.jax.sparse already ships indices+values compactly)"
+                % (site.func, comp_text, describe_expr(model, tensor_node)),
+                severity=WARNING)
 
 
 @register("missing-initial-broadcast", WARNING,

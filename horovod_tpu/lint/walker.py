@@ -95,6 +95,23 @@ NONDET_CALLS = {
 }
 NONDET_BASES = {"random"}  # random.random(), np.random.*, ...
 
+# Calls that produce integer-valued tensors (argmax/argmin/randint/
+# bincount/unique...) — quantizing these silently corrupts. `astype`/
+# `dtype=` arguments are additionally inspected textually for int/bool.
+INT_PRODUCING_CALLS = {
+    "argmax", "argmin", "argsort", "randint", "bincount", "searchsorted",
+    "digitize", "count_nonzero", "nonzero",
+}
+
+# Calls that read embedding tables by index: their gradients are
+# index-selected rows whose magnitudes vary wildly per block, the case
+# EQuARX-style block quantization handles worst (and lossy compression
+# of the LOOKUP ids themselves is outright corruption).
+EMBEDDING_LOOKUP_CALLS = {
+    "take", "take_along_axis", "embedding_lookup",
+    "embedding_lookup_sparse", "gather",
+}
+
 HOROVOD_ROOT = "horovod_tpu"
 # Module names whose attributes we also accept when imported without an
 # alias map hit (plain `horovod` scripts being migrated).
@@ -153,6 +170,8 @@ class Model(object):
         self.hvd_members = set()        # collective names imported directly
         self.rank_vars = set()          # variables holding rank-like values
         self.unordered_vars = {}        # var -> "set"|"dict"
+        self.int_vars = set()           # variables holding integer tensors
+        self.embed_vars = set()         # variables from embedding lookups
         self.call_sites = []
         self.suppressed = {}            # line -> set of rule ids ({"*"}=all)
         self.uses_elastic = False
@@ -293,6 +312,58 @@ def expr_nondeterministic(model, node):
     return False
 
 
+def _dtype_text_is_integer(node):
+    """True when a dtype-ish AST expr textually names an int/bool dtype
+    (`jnp.int32`, `np.dtype('int64')`, `"int32"`, `bool_`, ...)."""
+    for sub in ast.walk(node):
+        text = None
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            text = sub.value
+        elif isinstance(sub, ast.Attribute):
+            text = sub.attr
+        elif isinstance(sub, ast.Name):
+            text = sub.id
+        if text and (text.startswith(("int", "uint")) or
+                     text.startswith("bool")):
+            return True
+    return False
+
+
+def expr_integer_valued(model, node):
+    """True when the expression provably produces an integer/bool
+    tensor: an astype/dtype= naming an int dtype, an int-producing call
+    (argmax/randint/...), or a variable assigned from one."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in model.int_vars:
+            return True
+        if not isinstance(sub, ast.Call):
+            continue
+        _, attr = _call_base_attr(sub.func)
+        if attr == "astype" and sub.args and \
+                _dtype_text_is_integer(sub.args[0]):
+            return True
+        if attr in INT_PRODUCING_CALLS:
+            return True
+        for kw in sub.keywords:
+            if kw.arg == "dtype" and _dtype_text_is_integer(kw.value):
+                return True
+    return False
+
+
+def expr_embedding_lookup(model, node):
+    """True when the expression flows from an embedding-style indexed
+    read (take/gather/embedding_lookup) or a variable assigned from
+    one."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in model.embed_vars:
+            return True
+        if isinstance(sub, ast.Call):
+            _, attr = _call_base_attr(sub.func)
+            if attr in EMBEDDING_LOOKUP_CALLS:
+                return True
+    return False
+
+
 def describe_expr(model, node):
     """Short source snippet for messages."""
     try:
@@ -415,6 +486,16 @@ class _Walker(ast.NodeVisitor):
                 self.m.unordered_vars[tgt.id] = kind
             else:
                 self.m.unordered_vars.pop(tgt.id, None)
+            # Integer / embedding-lookup provenance (one-level, like the
+            # rank_vars dataflow) for compression-on-integer-tensor.
+            if expr_integer_valued(self.m, val):
+                self.m.int_vars.add(tgt.id)
+            else:
+                self.m.int_vars.discard(tgt.id)
+            if expr_embedding_lookup(self.m, val):
+                self.m.embed_vars.add(tgt.id)
+            else:
+                self.m.embed_vars.discard(tgt.id)
 
     # control flow ----------------------------------------------------------
 

@@ -57,6 +57,11 @@ namespace hvdtpu {
 #define HVD_TPU_MAX_FRAME_BYTES_ENV "HVD_TPU_MAX_FRAME_BYTES"
 #define HVD_TPU_RECONNECT_ENV "HVD_TPU_RECONNECT_SECONDS"
 #define HVD_TPU_FAULT_SPEC_ENV "HVD_TPU_FAULT_SPEC"
+// Wire-compression default for host-plane allreduces (compression.h /
+// docs/COMPRESSION.md): "none" (default), "bf16", or "int8". Per-call
+// compression= arguments override it; Python resolves the env once per
+// call so the mode rides the Request and is validated cross-rank.
+#define HVD_TPU_COMPRESSION_ENV "HVD_TPU_COMPRESSION"
 
 enum class StatusType : int32_t {
   OK = 0,
@@ -148,6 +153,9 @@ struct TensorTableEntry {
   int32_t root_rank = 0;
   double prescale_factor = 1.0;
   double postscale_factor = 1.0;
+  // Effective wire-compression mode (compression.h CompressionMode as
+  // u8; already dtype-filtered at enqueue).
+  uint8_t compression = 0;
   // Allgather result storage (core-owned) — set after execution.
   std::shared_ptr<std::vector<char>> gathered;
   std::shared_ptr<std::vector<int64_t>> gathered_sizes;

@@ -6,6 +6,7 @@
 #include <sstream>
 #include <unordered_set>
 
+#include "compression.h"
 #include "logging.h"
 #include "metrics.h"
 #include "parameter_manager.h"
@@ -116,6 +117,22 @@ Response Controller::ConstructResponse(const std::string& name) {
       error_found = true;
       break;
     }
+    if (req.compression() != first.compression()) {
+      // Lossy codecs must be job-uniform: a rank decoding bf16 frames
+      // as raw f32 would be silent corruption, so reject by name,
+      // naming BOTH ranks and their modes.
+      error << "Mismatched compression modes: rank " << first.request_rank()
+            << " requested "
+            << CompressionModeName(
+                   static_cast<CompressionMode>(first.compression()))
+            << " while rank " << req.request_rank() << " requested "
+            << CompressionModeName(
+                   static_cast<CompressionMode>(req.compression()))
+            << "; pass the same compression= (or HVD_TPU_COMPRESSION) on "
+            << "every rank.";
+      error_found = true;
+      break;
+    }
   }
 
   if (!error_found && (first.request_type() == Request::ALLREDUCE ||
@@ -182,6 +199,7 @@ Response Controller::ConstructResponse(const std::string& name) {
   }
   response.set_tensor_type(first.tensor_type());
   response.set_devices(first.device());
+  response.set_compression(first.compression());
   switch (first.request_type()) {
     case Request::ALLREDUCE: {
       response.set_response_type(Response::ALLREDUCE);
@@ -223,6 +241,7 @@ void Controller::FuseResponses(std::deque<Response>& responses,
         bool merged = false;
         if (next.response_type() == Response::ALLREDUCE &&
             next.tensor_type() == response.tensor_type() &&
+            next.compression() == response.compression() &&
             next.devices() == response.devices()) {
           int64_t next_bytes = 0;
           for (int64_t n : next.tensor_sizes()) next_bytes += n * dtype_size;

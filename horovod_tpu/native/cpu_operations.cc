@@ -162,13 +162,42 @@ static void PartitionChunks(int64_t count, int n, std::vector<int64_t>* counts,
 
 // Reduce-scatter leg of a ring allreduce: after n-1 steps ring rank r owns
 // chunk (r+1) % n, reduced over the whole ring.
+//
+// With cmp != NONE (dtype f32 by negotiation) this is the EQuARX-style
+// dequant-reduce-requant pipeline: the accumulator chunk in `buf` stays
+// f32; each hop encodes the outgoing chunk (requant), ships the small
+// payload, and the receiver decodes (dequant) and ReduceSums in f32 —
+// so wire bytes shrink while the sum never accumulates in the narrow
+// format. CRC framing in RingExchangeOn covers the compressed payload.
 static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
                                   const std::vector<int64_t>& counts,
                                   const std::vector<int64_t>& offsets,
-                                  DataType dtype) {
+                                  DataType dtype, CompressionMode cmp) {
   int n = ctx.RingSize(ring);
   int rank = ctx.RingRank(ring);
   std::size_t elem = DataTypeSize(dtype);
+  if (cmp != CompressionMode::NONE) {
+    float* f = reinterpret_cast<float*>(buf);
+    std::vector<char> send_c(CompressedSize(counts[0], cmp));
+    std::vector<char> recv_c(CompressedSize(counts[0], cmp));
+    std::vector<float> tmp(static_cast<std::size_t>(counts[0]));
+    for (int step = 0; step < n - 1; ++step) {
+      int send_chunk = (rank - step + n) % n;
+      int recv_chunk = (rank - step - 1 + n) % n;
+      std::size_t send_len = CompressedSize(counts[send_chunk], cmp);
+      std::size_t recv_len = CompressedSize(counts[recv_chunk], cmp);
+      CompressBuffer(f + offsets[send_chunk], counts[send_chunk], cmp,
+                     send_c.data());
+      if (!ctx.RingExchangeOn(ring, send_c.data(), send_len, recv_c.data(),
+                              recv_len)) {
+        return RingLost(ctx, "ring reduce-scatter exchange failed");
+      }
+      DecompressBuffer(recv_c.data(), counts[recv_chunk], cmp, tmp.data());
+      ReduceSum(f + offsets[recv_chunk], tmp.data(), counts[recv_chunk],
+                dtype);
+    }
+    return Status::OK();
+  }
   std::vector<char> tmp(static_cast<std::size_t>(counts[0]) * elem);
   for (int step = 0; step < n - 1; ++step) {
     int send_chunk = (rank - step + n) % n;
@@ -186,13 +215,44 @@ static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
 
 // Allgather leg: circulates the fully-reduced chunks (owned per the
 // reduce-scatter leg above) until every ring member has all of them.
+//
+// Compressed variant: each owner encodes its reduced chunk ONCE, decodes
+// its own copy back (so the owner holds exactly what everyone else will
+// decode), and the ring then forwards the encoded payloads VERBATIM —
+// no per-hop requantization, so there is no hop-count-dependent drift
+// and every rank ends with bitwise-identical chunk values.
 static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
                                    const std::vector<int64_t>& counts,
                                    const std::vector<int64_t>& offsets,
-                                   DataType dtype) {
+                                   DataType dtype, CompressionMode cmp) {
   int n = ctx.RingSize(ring);
   int rank = ctx.RingRank(ring);
   std::size_t elem = DataTypeSize(dtype);
+  if (cmp != CompressionMode::NONE) {
+    // Two rotating payload buffers: step s only ever forwards the chunk
+    // received at step s-1, so O(1) encoded chunks suffice (matching
+    // the uncompressed path's single tmp), not one per rank.
+    float* f = reinterpret_cast<float*>(buf);
+    int owned = (rank + 1) % n;
+    std::vector<char> send_c(CompressedSize(counts[0], cmp));
+    std::vector<char> recv_c(CompressedSize(counts[0], cmp));
+    CompressBuffer(f + offsets[owned], counts[owned], cmp, send_c.data());
+    DecompressBuffer(send_c.data(), counts[owned], cmp, f + offsets[owned]);
+    for (int step = 0; step < n - 1; ++step) {
+      int send_chunk = (rank + 1 - step + n) % n;
+      int recv_chunk = (rank - step + n) % n;
+      if (!ctx.RingExchangeOn(ring, send_c.data(),
+                              CompressedSize(counts[send_chunk], cmp),
+                              recv_c.data(),
+                              CompressedSize(counts[recv_chunk], cmp))) {
+        return RingLost(ctx, "ring allgather exchange failed");
+      }
+      DecompressBuffer(recv_c.data(), counts[recv_chunk], cmp,
+                       f + offsets[recv_chunk]);
+      std::swap(send_c, recv_c);
+    }
+    return Status::OK();
+  }
   for (int step = 0; step < n - 1; ++step) {
     int send_chunk = (rank + 1 - step + n) % n;
     int recv_chunk = (rank - step + n) % n;
@@ -207,15 +267,15 @@ static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
 }
 
 Status RingAllreduceOn(TcpContext& ctx, Ring ring, void* buffer, int64_t count,
-                       DataType dtype) {
+                       DataType dtype, CompressionMode cmp) {
   int n = ctx.RingSize(ring);
   if (n == 1 || count == 0) return Status::OK();
   std::vector<int64_t> counts, offsets;
   PartitionChunks(count, n, &counts, &offsets);
   char* buf = static_cast<char*>(buffer);
-  Status s = RingReduceScatterOn(ctx, ring, buf, counts, offsets, dtype);
+  Status s = RingReduceScatterOn(ctx, ring, buf, counts, offsets, dtype, cmp);
   if (!s.ok()) return s;
-  return RingAllgatherPhaseOn(ctx, ring, buf, counts, offsets, dtype);
+  return RingAllgatherPhaseOn(ctx, ring, buf, counts, offsets, dtype, cmp);
 }
 
 bool CpuRingAllreduce::Enabled(const std::vector<TensorTableEntry>& entries,
@@ -224,8 +284,8 @@ bool CpuRingAllreduce::Enabled(const std::vector<TensorTableEntry>& entries,
 }
 
 Status CpuRingAllreduce::ReduceBuffer(void* buffer, int64_t count,
-                                      DataType dtype) {
-  return RingAllreduceOn(ctx_, Ring::GLOBAL, buffer, count, dtype);
+                                      DataType dtype, CompressionMode cmp) {
+  return RingAllreduceOn(ctx_, Ring::GLOBAL, buffer, count, dtype, cmp);
 }
 
 Status CpuRingAllreduce::Execute(std::vector<TensorTableEntry>& entries,
@@ -262,8 +322,26 @@ Status CpuRingAllreduce::Execute(std::vector<TensorTableEntry>& entries,
     }
   }
 
+  // Belt-and-braces dtype filter: the negotiated mode is already
+  // effective (dtype-filtered at enqueue), and fused responses only
+  // merge same-mode tensors.
+  CompressionMode cmp = EffectiveCompression(
+      static_cast<CompressionMode>(response.compression()),
+      entries[0].dtype);
+  {
+    Metrics& m = GlobalMetrics();
+    if (cmp == CompressionMode::BF16) {
+      m.allreduce_bf16_total.fetch_add(1, std::memory_order_relaxed);
+    } else if (cmp == CompressionMode::INT8) {
+      m.allreduce_int8_total.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      m.allreduce_uncompressed_total.fetch_add(1,
+                                               std::memory_order_relaxed);
+    }
+  }
+
   timeline.ActivityStartAll(response.tensor_names(), ActivityName());
-  Status s = ReduceBuffer(buffer, total_elements, entries[0].dtype);
+  Status s = ReduceBuffer(buffer, total_elements, entries[0].dtype, cmp);
   timeline.ActivityEndAll(response.tensor_names());
   if (!s.ok()) return s;
 
@@ -295,7 +373,8 @@ bool CpuHierarchicalAllreduce::Enabled(
 }
 
 Status CpuHierarchicalAllreduce::ReduceBuffer(void* buffer, int64_t count,
-                                              DataType dtype) {
+                                              DataType dtype,
+                                              CompressionMode cmp) {
   // Two-level composite (reference: nccl_operations.cc:150-346):
   //   1. local-ring reduce-scatter — local rank lr ends up owning chunk
   //      (lr+1) % ls, reduced over the local group;
@@ -312,15 +391,16 @@ Status CpuHierarchicalAllreduce::ReduceBuffer(void* buffer, int64_t count,
   char* buf = static_cast<char*>(buffer);
 
   Status s = RingReduceScatterOn(ctx_, Ring::LOCAL, buf, counts, offsets,
-                                 dtype);
+                                 dtype, cmp);
   if (!s.ok()) return s;
 
   int owned = (lr + 1) % ls;
   s = RingAllreduceOn(ctx_, Ring::CROSS, buf + offsets[owned] * elem,
-                      counts[owned], dtype);
+                      counts[owned], dtype, cmp);
   if (!s.ok()) return s;
 
-  return RingAllgatherPhaseOn(ctx_, Ring::LOCAL, buf, counts, offsets, dtype);
+  return RingAllgatherPhaseOn(ctx_, Ring::LOCAL, buf, counts, offsets, dtype,
+                              cmp);
 }
 
 bool CpuRingAllgather::Enabled(const std::vector<TensorTableEntry>& entries,

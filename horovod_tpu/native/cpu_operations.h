@@ -24,6 +24,7 @@
 #include <vector>
 
 #include "collective_operations.h"
+#include "compression.h"
 #include "tcp_context.h"
 
 namespace hvdtpu {
@@ -39,8 +40,11 @@ class CpuRingAllreduce : public AllreduceOp {
 
  protected:
   // In-place reduction of the fused buffer; overridden by the hierarchical
-  // variant. Named activity is used for the timeline.
-  virtual Status ReduceBuffer(void* buffer, int64_t count, DataType dtype);
+  // variant. Named activity is used for the timeline. `cmp` is the
+  // negotiated wire-compression mode: the buffer stays f32; each ring
+  // hop encodes only the bytes it puts on the wire (compression.h).
+  virtual Status ReduceBuffer(void* buffer, int64_t count, DataType dtype,
+                              CompressionMode cmp);
   virtual const char* ActivityName() const { return "ALLREDUCE_RING"; }
 
   TcpContext& ctx_;
@@ -53,7 +57,8 @@ class CpuHierarchicalAllreduce : public CpuRingAllreduce {
                const Response& response) const override;
 
  protected:
-  Status ReduceBuffer(void* buffer, int64_t count, DataType dtype) override;
+  Status ReduceBuffer(void* buffer, int64_t count, DataType dtype,
+                      CompressionMode cmp) override;
   const char* ActivityName() const override {
     return "ALLREDUCE_HIERARCHICAL";
   }
@@ -98,9 +103,12 @@ class CpuBroadcast : public BroadcastOp {
 void ReduceSum(void* dst, const void* src, int64_t count, DataType dtype);
 // Elementwise scale in place (used for prescale/postscale/average).
 void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor);
-// In-place ring allreduce of `count` elements on the chosen ring.
+// In-place ring allreduce of `count` elements on the chosen ring, with
+// per-hop wire compression (cmp != NONE requires dtype == f32 — the
+// negotiation's EffectiveCompression guarantees it).
 Status RingAllreduceOn(TcpContext& ctx, Ring ring, void* buffer, int64_t count,
-                       DataType dtype);
+                       DataType dtype,
+                       CompressionMode cmp = CompressionMode::NONE);
 
 }  // namespace hvdtpu
 

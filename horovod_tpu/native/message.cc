@@ -145,6 +145,7 @@ void Request::SerializeTo(std::string* out) const {
   for (int64_t d : tensor_shape_) PutI64(out, d);
   PutF64(out, prescale_factor_);
   PutF64(out, postscale_factor_);
+  PutU8(out, compression_);
 }
 
 std::size_t Request::ParseFrom(const char* data, std::size_t len) {
@@ -164,6 +165,7 @@ std::size_t Request::ParseFrom(const char* data, std::size_t len) {
     tensor_shape_.push_back(d);
   }
   if (!r.GetF64(&prescale_factor_) || !r.GetF64(&postscale_factor_)) return 0;
+  if (!r.GetU8(&compression_)) return 0;
   return r.consumed(data);
 }
 
@@ -244,6 +246,7 @@ std::string Response::tensor_names_string() const {
 void Response::SerializeTo(std::string* out) const {
   PutU8(out, static_cast<uint8_t>(response_type_));
   PutU8(out, static_cast<uint8_t>(tensor_type_));
+  PutU8(out, compression_);
   PutI32(out, devices_);
   PutStr(out, error_message_);
   PutU32(out, static_cast<uint32_t>(tensor_names_.size()));
@@ -256,8 +259,8 @@ std::size_t Response::ParseFrom(const char* data, std::size_t len) {
   Reader r(data, len);
   uint8_t rt, tt;
   uint32_t nn, ns;
-  if (!r.GetU8(&rt) || !r.GetU8(&tt) || !r.GetI32(&devices_) ||
-      !r.GetStr(&error_message_) || !r.GetU32(&nn))
+  if (!r.GetU8(&rt) || !r.GetU8(&tt) || !r.GetU8(&compression_) ||
+      !r.GetI32(&devices_) || !r.GetStr(&error_message_) || !r.GetU32(&nn))
     return 0;
   response_type_ = static_cast<ResponseType>(rt);
   tensor_type_ = static_cast<DataType>(tt);

@@ -70,6 +70,12 @@ class Request {
   double postscale_factor() const { return postscale_factor_; }
   void set_postscale_factor(double f) { postscale_factor_ = f; }
 
+  // Wire-compression mode (compression.h CompressionMode as u8). Part of
+  // the negotiated contract: the coordinator rejects mixed-mode ranks by
+  // name, and the response cache treats a mode change as a miss.
+  uint8_t compression() const { return compression_; }
+  void set_compression(uint8_t c) { compression_ = c; }
+
   void SerializeTo(std::string* out) const;
   // Returns bytes consumed, 0 on error.
   std::size_t ParseFrom(const char* data, std::size_t len);
@@ -84,6 +90,7 @@ class Request {
   std::vector<int64_t> tensor_shape_;
   double prescale_factor_ = 1.0;
   double postscale_factor_ = 1.0;
+  uint8_t compression_ = 0;  // CompressionMode::NONE
 };
 
 // One entry of a rank's collective call history (divergence.h): enough to
@@ -176,6 +183,11 @@ class Response {
   int32_t devices() const { return devices_; }
   void set_devices(int32_t d) { devices_ = d; }
 
+  // Negotiated wire-compression mode the executing ops apply per hop
+  // (compression.h). Fusion only merges same-mode responses.
+  uint8_t compression() const { return compression_; }
+  void set_compression(uint8_t c) { compression_ = c; }
+
   void SerializeTo(std::string* out) const;
   std::size_t ParseFrom(const char* data, std::size_t len);
 
@@ -186,6 +198,7 @@ class Response {
   std::vector<int64_t> tensor_sizes_;
   DataType tensor_type_ = DataType::HVD_FLOAT32;
   int32_t devices_ = -1;
+  uint8_t compression_ = 0;  // CompressionMode::NONE
 };
 
 class ResponseList {

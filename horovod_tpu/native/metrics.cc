@@ -65,6 +65,9 @@ const char* SummaryFieldName(int field) {
     case SUM_CKPT_WRITES: return "ckpt_writes_total";
     case SUM_CKPT_WRITE_FAILURES: return "ckpt_write_failures_total";
     case SUM_LAST_DURABLE_STEP: return "last_durable_step";
+    case SUM_COMPRESSION_BYTES_IN: return "compression_bytes_in_total";
+    case SUM_COMPRESSION_BYTES_OUT: return "compression_bytes_out_total";
+    case SUM_NET_RING_BYTES_SENT: return "net_ring_bytes_sent_total";
   }
   return "unknown";
 }
@@ -89,7 +92,12 @@ Metrics::Metrics()
       // store with injected slow-fsync faults).
       ckpt_write_seconds({1e-3, 5e-3, 2.5e-2, 0.1, 0.5, 1.0, 2.5, 5.0,
                           10.0, 30.0, 60.0, 120.0},
-                         1e6) {}
+                         1e6),
+      // One encode/decode call spans a ring chunk: ~us for KB chunks up
+      // to ~100ms for a full 64MB fusion buffer on one core.
+      compression_seconds({1e-6, 1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 1e-2,
+                           5e-2, 0.1, 0.5, 1.0},
+                          1e9) {}
 
 void Metrics::Configure(int world_size_in, int rank_in) {
   world_size.store(world_size_in, std::memory_order_relaxed);
@@ -144,6 +152,12 @@ std::vector<double> Metrics::Summary() const {
   v[SUM_CKPT_WRITE_FAILURES] =
       static_cast<double>(ckpt_write_failures_total.load());
   v[SUM_LAST_DURABLE_STEP] = static_cast<double>(last_durable_step.load());
+  v[SUM_COMPRESSION_BYTES_IN] =
+      static_cast<double>(compression_bytes_in_total.load());
+  v[SUM_COMPRESSION_BYTES_OUT] =
+      static_cast<double>(compression_bytes_out_total.load());
+  v[SUM_NET_RING_BYTES_SENT] =
+      static_cast<double>(net_ring_bytes_sent_total.load());
   return v;
 }
 
@@ -255,6 +269,24 @@ std::string Metrics::SnapshotJson() const {
   AppendKV(&out, "fault_corrupt_total", fault_corrupt_total.load(), &first);
   AppendKV(&out, "fault_close_total", fault_close_total.load(), &first);
   AppendKV(&out, "fault_stall_total", fault_stall_total.load(), &first);
+  AppendKV(&out, "compression_bytes_in_total",
+           compression_bytes_in_total.load(), &first);
+  AppendKV(&out, "compression_bytes_out_total",
+           compression_bytes_out_total.load(), &first);
+  AppendKV(&out, "compression_bf16_total", compression_bf16_total.load(),
+           &first);
+  AppendKV(&out, "compression_int8_total", compression_int8_total.load(),
+           &first);
+  AppendKV(&out, "allreduce_uncompressed_total",
+           allreduce_uncompressed_total.load(), &first);
+  AppendKV(&out, "allreduce_bf16_total", allreduce_bf16_total.load(),
+           &first);
+  AppendKV(&out, "allreduce_int8_total", allreduce_int8_total.load(),
+           &first);
+  AppendKV(&out, "net_ring_bytes_sent_total",
+           net_ring_bytes_sent_total.load(), &first);
+  AppendKV(&out, "net_ring_bytes_recv_total",
+           net_ring_bytes_recv_total.load(), &first);
   AppendKV(&out, "ckpt_writes_total", ckpt_writes_total.load(), &first);
   AppendKV(&out, "ckpt_write_failures_total",
            ckpt_write_failures_total.load(), &first);
@@ -285,6 +317,7 @@ std::string Metrics::SnapshotJson() const {
   AppendHistogram(&out, "cycle_bytes", cycle_bytes, &first);
   AppendHistogram(&out, "fusion_fill_ratio", fusion_fill_ratio, &first);
   AppendHistogram(&out, "ckpt_write_seconds", ckpt_write_seconds, &first);
+  AppendHistogram(&out, "compression_seconds", compression_seconds, &first);
   out.append("},\"rank_lag_seconds\":[");
   {
     std::lock_guard<std::mutex> lk(rank_mutex_);

@@ -93,6 +93,12 @@ enum SummaryField : int {
   SUM_CKPT_WRITES,
   SUM_CKPT_WRITE_FAILURES,
   SUM_LAST_DURABLE_STEP,
+  // Wire compression (docs/COMPRESSION.md). Appended last; an older
+  // worker's summary simply lacks the tail and the job view / hvd-top
+  // render "-" for it instead of misaligning.
+  SUM_COMPRESSION_BYTES_IN,
+  SUM_COMPRESSION_BYTES_OUT,
+  SUM_NET_RING_BYTES_SENT,
   SUM_FIELD_COUNT
 };
 const char* SummaryFieldName(int field);
@@ -134,6 +140,23 @@ class Metrics {
   std::atomic<uint64_t> fault_close_total{0};
   std::atomic<uint64_t> fault_stall_total{0};
 
+  // --- wire compression (compression.cc / cpu_operations.cc) ---
+  // Codec throughput: f32 bytes entering the compressor vs bytes put on
+  // the wire (the ratio is the live compression factor), plus encode-op
+  // counts per mode and allreduce executions per negotiated mode.
+  std::atomic<uint64_t> compression_bytes_in_total{0};
+  std::atomic<uint64_t> compression_bytes_out_total{0};
+  std::atomic<uint64_t> compression_bf16_total{0};   // encode calls
+  std::atomic<uint64_t> compression_int8_total{0};   // encode calls
+  std::atomic<uint64_t> allreduce_uncompressed_total{0};
+  std::atomic<uint64_t> allreduce_bf16_total{0};
+  std::atomic<uint64_t> allreduce_int8_total{0};
+  // Data-ring wire accounting (frame headers included): the quantity
+  // the compression stage shrinks, measured at the socket layer —
+  // bench.py --compression reads the A/B from these.
+  std::atomic<uint64_t> net_ring_bytes_sent_total{0};
+  std::atomic<uint64_t> net_ring_bytes_recv_total{0};
+
   // --- durable checkpoints (elastic/durable.py via the C API) ---
   std::atomic<uint64_t> ckpt_writes_total{0};          // published snapshots
   std::atomic<uint64_t> ckpt_write_failures_total{0};  // degraded writes
@@ -160,6 +183,7 @@ class Metrics {
   MetricHistogram cycle_bytes;          // payload bytes executed per work cycle
   MetricHistogram fusion_fill_ratio;    // fused payload / fusion threshold
   MetricHistogram ckpt_write_seconds;   // durable shard write+publish time
+  MetricHistogram compression_seconds;  // one encode/decode call's CPU time
 
   // Whether the metrics PLANE (wire piggyback, forced sync cycles, HTTP
   // serving) is live — HVD_TPU_METRICS=1 or HVD_TPU_METRICS_PORT set.

@@ -23,6 +23,7 @@
 #include "bayesian_optimization.h"
 #include "collective_operations.h"
 #include "common.h"
+#include "compression.h"
 #include "controller.h"
 #include "cpu_operations.h"
 #include "global_state.h"
@@ -452,7 +453,8 @@ bool InitializeHorovodOnce() {
 Status EnqueueTensor(Request::RequestType type, const char* name,
                      const void* data, void* output, int ndim,
                      const int64_t* shape, int dtype, int root_rank,
-                     double prescale, double postscale, int handle) {
+                     double prescale, double postscale, int compression,
+                     int handle) {
   if (!g_state.initialization_done.load() ||
       g_state.initialization_failed.load()) {
     return Status::PreconditionError("Horovod-TPU has not been initialized.");
@@ -468,6 +470,13 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
   TensorShape tensor_shape;
   for (int i = 0; i < ndim; ++i) tensor_shape.AddDim(shape[i]);
 
+  // The EFFECTIVE mode enters negotiation: non-f32 payloads ride
+  // uncompressed, computed identically on every rank from the dtype, so
+  // a bf16 request for an int64 tensor cannot desync the ring.
+  uint8_t effective = static_cast<uint8_t>(EffectiveCompression(
+      static_cast<CompressionMode>(compression),
+      static_cast<DataType>(dtype)));
+
   Request message;
   message.set_request_rank(g_state.controller->rank());
   message.set_request_type(type);
@@ -478,6 +487,7 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
   message.set_device(HOST_DEVICE_ID);
   message.set_prescale_factor(prescale);
   message.set_postscale_factor(postscale);
+  message.set_compression(effective);
 
   TensorTableEntry entry;
   entry.tensor_name = name;
@@ -488,6 +498,7 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
   entry.root_rank = root_rank;
   entry.prescale_factor = prescale;
   entry.postscale_factor = postscale;
+  entry.compression = effective;
   entry.callback = [handle](const Status& status,
                             const TensorTableEntry& done_entry) {
     LOG(TRACE) << "done " << done_entry.tensor_name << " handle " << handle
@@ -715,14 +726,40 @@ void horovod_tpu_autotune_params(double* fusion_mb, double* cycle_ms,
 int horovod_tpu_enqueue_allreduce(const char* name, const void* data,
                                   void* output, int ndim, const int64_t* shape,
                                   int dtype, double prescale,
-                                  double postscale) {
+                                  double postscale, int compression) {
   int handle = g_handles.AllocateHandle();
   Status s = EnqueueTensor(Request::ALLREDUCE, name, data, output, ndim, shape,
-                           dtype, 0, prescale, postscale, handle);
+                           dtype, 0, prescale, postscale, compression,
+                           handle);
   if (!s.ok()) {
     g_handles.MarkDone(handle, s);
   }
   return handle;
+}
+
+// Compression-mode helpers for the Python binding: parse the canonical
+// spelling ("none"/"bf16"/"int8"; numeric strings accepted) and expose
+// the mode a given dtype would actually ride the wire with.
+int horovod_tpu_parse_compression(const char* s) {
+  return static_cast<int>(ParseCompressionMode(s));
+}
+// The HVD_TPU_COMPRESSION job default, for bindings without their own
+// per-call compression plumbing (tf_ops.cc, torch_cext.c). Read fresh
+// each call — negotiation validates it cross-rank anyway.
+int horovod_tpu_default_compression() {
+  return static_cast<int>(
+      ParseCompressionMode(std::getenv(HVD_TPU_COMPRESSION_ENV)));
+}
+int horovod_tpu_effective_compression(int compression, int dtype) {
+  return static_cast<int>(
+      EffectiveCompression(static_cast<CompressionMode>(compression),
+                           static_cast<DataType>(dtype)));
+}
+// Wire bytes `count` f32 elements occupy under `compression`
+// (compression.cc layout — tests pin the size math against this).
+int64_t horovod_tpu_compressed_size(int64_t count, int compression) {
+  return static_cast<int64_t>(CompressedSize(
+      count, static_cast<CompressionMode>(compression)));
 }
 
 int horovod_tpu_enqueue_allgather(const char* name, const void* data, int ndim,
@@ -731,7 +768,7 @@ int horovod_tpu_enqueue_allgather(const char* name, const void* data, int ndim,
   // The op writes the gathered result into core-owned buffers; the entry
   // callback surfaces them through the handle for copy-out.
   Status s = EnqueueTensor(Request::ALLGATHER, name, data, nullptr, ndim,
-                           shape, dtype, 0, 1.0, 1.0, handle);
+                           shape, dtype, 0, 1.0, 1.0, 0, handle);
   if (!s.ok()) {
     g_handles.MarkDone(handle, s);
   }
@@ -743,7 +780,7 @@ int horovod_tpu_enqueue_broadcast(const char* name, const void* data,
                                   int dtype, int root_rank) {
   int handle = g_handles.AllocateHandle();
   Status s = EnqueueTensor(Request::BROADCAST, name, data, output, ndim, shape,
-                           dtype, root_rank, 1.0, 1.0, handle);
+                           dtype, root_rank, 1.0, 1.0, 0, handle);
   if (!s.ok()) {
     g_handles.MarkDone(handle, s);
   }

@@ -34,7 +34,8 @@ ResponseCache::CacheState ResponseCache::cached(const Request& request) const {
               e.shape == request.tensor_shape() &&
               e.root_rank == request.root_rank() &&
               e.prescale_factor == request.prescale_factor() &&
-              e.postscale_factor == request.postscale_factor();
+              e.postscale_factor == request.postscale_factor() &&
+              e.compression == request.compression();
   // Response type must match the request type too.
   same = same && static_cast<int>(e.response.response_type()) ==
                      static_cast<int>(request.request_type());
@@ -85,6 +86,7 @@ void ResponseCache::put(const Response& response, TensorQueue& tensor_queue) {
     single.set_response_type(response.response_type());
     single.set_tensor_type(response.tensor_type());
     single.set_devices(response.devices());
+    single.set_compression(response.compression());
     single.add_tensor_name(name);
     CacheEntry entry;
     // Capture validation params from the table entry if it still exists;
@@ -96,6 +98,7 @@ void ResponseCache::put(const Response& response, TensorQueue& tensor_queue) {
       entry.root_rank = te.root_rank;
       entry.prescale_factor = te.prescale_factor;
       entry.postscale_factor = te.postscale_factor;
+      entry.compression = te.compression;
       if (response.response_type() == Response::ALLGATHER) {
         single.set_tensor_sizes(response.tensor_sizes());
       } else {

@@ -67,6 +67,10 @@ class ResponseCache {
     int32_t root_rank;
     double prescale_factor;
     double postscale_factor;
+    // Wire-compression mode is part of the cache key: a hit with a
+    // different mode is INVALID (renegotiate), never a silent reuse of
+    // a response negotiated under another codec.
+    uint8_t compression = 0;
   };
 
   void put_entry(const std::string& name, CacheEntry entry);

@@ -1095,6 +1095,15 @@ bool TcpContext::RingExchangeOn(Ring ring, const void* send_buf,
                                                    std::memory_order_relaxed);
     return false;
   }
+  // Data-ring wire accounting (headers included): the quantity the
+  // compression stage shrinks, counted at the socket layer so a
+  // bench/test A/B measures actual bytes moved, not payload intent.
+  GlobalMetrics().net_ring_bytes_sent_total.fetch_add(
+      static_cast<uint64_t>(send_len) + kFrameHeaderBytes,
+      std::memory_order_relaxed);
+  GlobalMetrics().net_ring_bytes_recv_total.fetch_add(
+      static_cast<uint64_t>(recv_len) + kFrameHeaderBytes,
+      std::memory_order_relaxed);
   return true;
 }
 
@@ -1126,6 +1135,9 @@ bool TcpContext::RingBroadcast(void* buf, std::size_t len, int root) {
       SetLastError(Channel::RING, ring_next_.last_error());
       return false;
     }
+    GlobalMetrics().net_ring_bytes_sent_total.fetch_add(
+        static_cast<uint64_t>(len) + kFrameHeaderBytes,
+        std::memory_order_relaxed);
     return true;
   }
   // Non-root: read the header, forward it downstream if we forward at
@@ -1211,6 +1223,14 @@ bool TcpContext::RingBroadcast(void* buf, std::size_t len, int root) {
     GlobalMetrics().net_crc_errors_total.fetch_add(1,
                                                    std::memory_order_relaxed);
     return false;
+  }
+  GlobalMetrics().net_ring_bytes_recv_total.fetch_add(
+      static_cast<uint64_t>(len) + kFrameHeaderBytes,
+      std::memory_order_relaxed);
+  if (forward) {
+    GlobalMetrics().net_ring_bytes_sent_total.fetch_add(
+        static_cast<uint64_t>(len) + kFrameHeaderBytes,
+        std::memory_order_relaxed);
   }
   return true;
 }

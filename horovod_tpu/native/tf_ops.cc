@@ -26,7 +26,9 @@
 extern "C" {
 int horovod_tpu_enqueue_allreduce(const char* name, const void* data,
                                   void* output, int ndim, const int64_t* shape,
-                                  int dtype, double prescale, double postscale);
+                                  int dtype, double prescale, double postscale,
+                                  int compression);
+int horovod_tpu_default_compression();
 int horovod_tpu_enqueue_allgather(const char* name, const void* data, int ndim,
                                   const int64_t* shape, int dtype);
 int horovod_tpu_enqueue_broadcast(const char* name, const void* data,
@@ -128,10 +130,13 @@ class HorovodTpuAllreduceOp : public AsyncOpKernel {
     std::vector<int64_t> dims = ShapeVec(input);
     // `average` divides by the communicator size at run (not trace) time.
     double post = average_ ? postscale_ / horovod_tpu_size() : postscale_;
+    // Wire compression rides the job-wide env default here (the TF
+    // binding's Compression codecs stay tensor-level); negotiation
+    // validates the mode cross-rank like any other param.
     int handle = horovod_tpu_enqueue_allreduce(
         op_name_.c_str(), DataPtr(input), MutableDataPtr(output),
         static_cast<int>(dims.size()), dims.data(), hvd_dtype, prescale_,
-        post);
+        post, horovod_tpu_default_compression());
     FinishAsync(ctx, done, handle, input);
   }
 

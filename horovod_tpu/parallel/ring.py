@@ -1,7 +1,13 @@
-"""Long-context sequence parallelism: ring attention and Ulysses.
+"""Long-context sequence parallelism (ring attention, Ulysses) and the
+compressed ring allreduce.
 
 Not present in the reference (SURVEY.md §5.7 — it never sees activations);
 first-class here because long context shapes the core design on TPU.
+
+* :func:`ring_allreduce` — explicit ``lax.ppermute`` ring allreduce with
+  EQuARX-style wire compression fused into the per-hop compute
+  (quantize/dequantize as part of each hop, not a pre/post pass), for
+  gradient bytes on the ICI/DCN links (docs/COMPRESSION.md).
 
 * :func:`ring_attention` — blockwise (flash-style) attention where each
   device holds a sequence shard and k/v blocks rotate around the ICI ring
@@ -436,6 +442,107 @@ def zigzag_unshard(x, n, axis=1):
         out[r] = pairs[2 * r]
         out[2 * n - 1 - r] = pairs[2 * r + 1]
     return jnp.concatenate(out, axis=axis)
+
+
+def ring_allreduce(x, axis_name, compression="none"):
+    """Explicit ring allreduce (sum) over `axis_name` with wire
+    compression fused into the per-hop compute (EQuARX-style; PAPERS.md
+    arxiv 2506.17615). Runs inside shard_map/pmap over a mapped axis.
+
+    The array is flattened and split into one chunk per rank. Phase 1
+    (reduce-scatter, n-1 hops): each hop ENCODES the outgoing chunk
+    (requant), ships the small payload via ``lax.ppermute``, DECODES the
+    incoming one (dequant) and adds it in f32 — the accumulator never
+    lives in the narrow format. Phase 2 (allgather, n-1 hops): the owner
+    encodes its reduced chunk once, decodes its own copy back (so every
+    rank ends with the identical dequantized values), and the encoded
+    payload then travels the ring VERBATIM — each hop's ppermute of
+    chunk k+1 has no data dependence on the local decode of chunk k, so
+    XLA overlaps the dequantize with the neighbor transfer (the
+    pipelining trick ring_attention uses for its k/v blocks).
+
+    compression: 'none' | 'bf16' | 'int8' (or a
+    `horovod_tpu.compression` mode). bf16 halves the bytes each hop
+    moves; int8 cuts them ~3.9x with one f32 scale per 256-element
+    block riding in-band (the (q, scales) pair IS the payload). Only
+    f32 inputs compress; other dtypes ride 'none'.
+
+    Returns the SUM over the axis in x's dtype/shape (callers divide
+    for an average). With compression='none' this is numerically a
+    psum (up to f32 sum order); prefer plain psum there — this path
+    exists for the compressed modes.
+    """
+    from horovod_tpu import compression as _comp
+
+    mode = _comp.resolve(compression)
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    orig_shape, orig_dtype = x.shape, x.dtype
+    if mode.mode != _comp.NONE and orig_dtype != jnp.float32:
+        mode = _comp.Compression.none
+    # Only the compressed f32 path needs an f32 working copy; degraded
+    # dtypes (int32/int64/f64...) stay in their own dtype so large ints
+    # and f64 sum exactly, like psum would.
+    work_dtype = jnp.float32 if mode.mode != _comp.NONE else orig_dtype
+    flat = x.astype(work_dtype).reshape(-1)
+    if n == 1:
+        return flat.reshape(orig_shape).astype(orig_dtype)
+    # Chunk length: rank-uniform, padded to the int8 block so every
+    # chunk quantizes on block boundaries.
+    c = -(-flat.size // n)
+    c = -(-c // _comp.BLOCK) * _comp.BLOCK
+    chunks = jnp.pad(flat, (0, n * c - flat.size)).reshape(n, c)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def enc(v):
+        if mode.mode == _comp.BF16:
+            return (v.astype(jnp.bfloat16),)
+        if mode.mode == _comp.INT8:
+            return _comp.quantize_int8_jax(v)
+        return (v,)
+
+    def dec(payload):
+        if mode.mode == _comp.BF16:
+            return payload[0].astype(jnp.float32)
+        if mode.mode == _comp.INT8:
+            return _comp.dequantize_int8_jax(*payload)
+        return payload[0]
+
+    def ship(payload):
+        return tuple(lax.ppermute(p, axis_name, perm) for p in payload)
+
+    # Reduce-scatter: after n-1 hops this rank's chunk (idx+1)%n holds
+    # the full sum. Each hop requantizes the freshly-reduced outgoing
+    # chunk and dequant-adds the incoming one in f32.
+    def rs_body(s, chunks):
+        send_i = (idx - s) % n
+        recv_i = (idx - s - 1) % n
+        incoming = ship(enc(jnp.take(chunks, send_i, axis=0)))
+        upd = jnp.take(chunks, recv_i, axis=0) + dec(incoming)
+        return lax.dynamic_update_index_in_dim(chunks, upd, recv_i, 0)
+
+    chunks = lax.fori_loop(0, n - 1, rs_body, chunks)
+
+    # Allgather: encode the owned chunk once; every rank decodes the
+    # SAME bytes (the owner re-decodes its own copy), so results are
+    # rank-identical — no per-hop requantization drift.
+    owned = (idx + 1) % n
+    payload = enc(jnp.take(chunks, owned, axis=0))
+    chunks = lax.dynamic_update_index_in_dim(chunks, dec(payload), owned, 0)
+
+    def ag_body(s, carry):
+        chunks, payload = carry
+        recv_i = (idx - s) % n
+        # ppermute first: the transfer of this hop's payload and the
+        # decode of the previous hop's chunk have no data dependence.
+        incoming = ship(payload)
+        chunks = lax.dynamic_update_index_in_dim(chunks, dec(incoming),
+                                                 recv_i, 0)
+        return chunks, incoming
+
+    chunks, _ = lax.fori_loop(0, n - 1, ag_body, (chunks, payload))
+    out = chunks.reshape(-1)[:flat.size]
+    return out.reshape(orig_shape).astype(orig_dtype)
 
 
 def ulysses_attention(q, k, v, axis_name, causal=True, scale=None,

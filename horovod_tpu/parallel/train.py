@@ -89,10 +89,17 @@ def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
     where params are replicated, batch is sharded on dim 0, and
     opt_state is replicated (plain) or dim-0-sharded (zero1).
     """
-    if zero1 and compression not in (None, hvd_jax.Compression.none):
+    from horovod_tpu import compression as _wire
+    # Explicitly-requested compression + zero1 is a contradiction (the
+    # scatter path is uncompressed); compression=None stays None so the
+    # HVD_TPU_COMPRESSION default can engage on the plain path.
+    explicit_none = compression is hvd_jax.Compression.none or (
+        compression is not None and
+        not hasattr(compression, "compress") and
+        _wire.resolve(compression) == _wire.Compression.none)
+    if zero1 and compression is not None and not explicit_none:
         raise ValueError("zero1 and gradient compression are mutually "
                          "exclusive (the scatter path is uncompressed)")
-    compression = compression or hvd_jax.Compression.none
     # Library helper, not a training script: the caller owns the initial
     # parameter sync (place() replicates params over the mesh, and host
     # checkpoint restore broadcasts before entering the step).

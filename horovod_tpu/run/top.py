@@ -44,6 +44,66 @@ def _fmt_rate(v, scale=1.0, suffix=""):
     return "%.1f%s" % (v, suffix)
 
 
+def _int_field(field):
+    """Column renderer for a plain integer counter; '-' when an older
+    worker's summary predates the field (mixed-version elastic jobs)."""
+    def fmt(cur, prev, dt, ctx):
+        if field not in cur:
+            return "-"
+        return "%d" % int(cur[field])
+    return fmt
+
+
+def _cmp_ratio(cur, prev, dt, ctx):
+    """Live wire-compression factor (docs/COMPRESSION.md): f32 bytes
+    into the codec / bytes put on the wire. '-' when the worker
+    predates the compression fields OR compression never engaged."""
+    if "compression_bytes_out_total" not in cur:
+        return "-"
+    out_b = float(cur.get("compression_bytes_out_total", 0.0))
+    in_b = float(cur.get("compression_bytes_in_total", 0.0))
+    if out_b <= 0 or in_b <= 0:
+        return "-"
+    return "%.1fx" % (in_b / out_b)
+
+
+# Column schema: (header, width, renderer(cur, prev, dt, ctx) -> str).
+# Every cell renders through this table, so a worker whose summary lacks
+# a NEWER field (elastic job mid-rolling-upgrade) shows '-' in that one
+# column instead of shifting every column after it.
+_COLUMNS = [
+    ("cyc/s", 9,
+     lambda cur, prev, dt, ctx: _fmt_rate(_rate(cur, prev, "cycles_total",
+                                                dt))),
+    ("cyc_ms", 9, lambda cur, prev, dt, ctx: "%.2f" % ctx["cyc_ms"]),
+    ("ops/s", 8,
+     lambda cur, prev, dt, ctx: _fmt_rate(
+         _rate(cur, prev, "tensors_performed_total", dt))),
+    ("B/s", 9,
+     lambda cur, prev, dt, ctx: _fmt_rate(
+         _rate(cur, prev, "bytes_performed_total", dt))),
+    ("fused_B", 9,
+     lambda cur, prev, dt, ctx: _fmt_rate(cur.get("fused_bytes_total",
+                                                  0.0))),
+    ("cache%", 7, lambda cur, prev, dt, ctx: "%.1f%%" % ctx["cache_pct"]),
+    ("queue", 6, _int_field("queue_depth")),
+    ("stall", 6, _int_field("stall_warnings_total")),
+    ("diverr", 6, _int_field("divergence_errors_total")),
+    # Transport health (docs/CHAOS.md): detected corrupt frames, I/O
+    # deadline expiries, and control-star reconnects survived.
+    ("crc", 5, _int_field("net_crc_errors_total")),
+    ("nto", 5, _int_field("net_timeouts_total")),
+    ("rcn", 5, _int_field("net_reconnects_total")),
+    # Durable checkpoints: the newest step this rank knows is safely on
+    # disk (-1 = durability off / nothing written yet; '-' = the worker
+    # predates the field) — docs/ELASTIC.md.
+    ("dur", 7, _int_field("last_durable_step")),
+    # Wire compression factor (codec bytes in / wire bytes out).
+    ("cmp", 6, _cmp_ratio),
+    ("lag_s", 9, lambda cur, prev, dt, ctx: "%.2f" % ctx["lag_total"]),
+]
+
+
 def render(job, prev_job, dt, endpoint):
     """One frame: header + per-rank table + straggler verdict."""
     per_rank = job.get("per_rank") or {}
@@ -54,10 +114,8 @@ def render(job, prev_job, dt, endpoint):
     lines.append("hvd-top — %s — size %d, generation %d — %s" % (
         endpoint, int(job.get("size", 0)), int(job.get("generation", 0)),
         time.strftime("%H:%M:%S")))
-    header = ("%4s %9s %9s %8s %9s %9s %7s %6s %6s %6s %5s %5s %5s %7s %9s"
-              % ("rank", "cyc/s", "cyc_ms", "ops/s", "B/s", "fused_B",
-                 "cache%", "queue", "stall", "diverr", "crc", "nto",
-                 "rcn", "dur", "lag_s"))
+    header = "%4s " % "rank" + " ".join(
+        "%*s" % (width, name) for name, width, _ in _COLUMNS)
     lines.append(header)
     lines.append("-" * len(header))
 
@@ -83,31 +141,11 @@ def render(job, prev_job, dt, endpoint):
         if prev_job is not None and lag_delta > max_lag_delta:
             max_lag_delta, straggler = lag_delta, ri
         faults_total += int(cur.get("faults_injected_total", 0))
-        lines.append("%4s %9s %9.2f %8s %9s %9s %6.1f%% %6d %6d %6d %5d "
-                     "%5d %5d %7d %9.2f"
-                     % (r,
-                        _fmt_rate(cyc_rate),
-                        cyc_ms,
-                        _fmt_rate(_rate(cur, prev, "tensors_performed_total",
-                                        dt)),
-                        _fmt_rate(_rate(cur, prev, "bytes_performed_total",
-                                        dt)),
-                        _fmt_rate(cur.get("fused_bytes_total", 0.0)),
-                        cache_pct,
-                        int(cur.get("queue_depth", 0)),
-                        int(cur.get("stall_warnings_total", 0)),
-                        int(cur.get("divergence_errors_total", 0)),
-                        # Transport health (docs/CHAOS.md): detected
-                        # corrupt frames, I/O deadline expiries, and
-                        # control-star reconnects survived.
-                        int(cur.get("net_crc_errors_total", 0)),
-                        int(cur.get("net_timeouts_total", 0)),
-                        int(cur.get("net_reconnects_total", 0)),
-                        # Durable checkpoints: the newest step this rank
-                        # knows is safely on disk (-1 = durability off /
-                        # nothing written yet) — docs/ELASTIC.md.
-                        int(cur.get("last_durable_step", -1)),
-                        lag_total))
+        ctx = {"cyc_ms": cyc_ms, "cache_pct": cache_pct,
+               "lag_total": lag_total}
+        lines.append("%4s " % r + " ".join(
+            "%*s" % (width, fn(cur, prev, dt, ctx))
+            for _, width, fn in _COLUMNS))
     if faults_total:
         lines.append("! fault injection active: %d fault(s) injected "
                      "across the job (HVD_TPU_FAULT_SPEC set)"

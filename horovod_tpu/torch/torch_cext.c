@@ -17,7 +17,9 @@
 extern int horovod_tpu_enqueue_allreduce(const char* name, const void* data,
                                          void* output, int ndim,
                                          const int64_t* shape, int dtype,
-                                         double prescale, double postscale);
+                                         double prescale, double postscale,
+                                         int compression);
+extern int horovod_tpu_default_compression(void);
 extern int horovod_tpu_enqueue_broadcast(const char* name, const void* data,
                                          void* output, int ndim,
                                          const int64_t* shape, int dtype,
@@ -62,9 +64,11 @@ static PyObject* cext_enqueue_allreduce(PyObject* self, PyObject* args) {
   if (parse_shape(shape_obj, shape, &ndim) != 0) return NULL;
   int handle;
   Py_BEGIN_ALLOW_THREADS
+  /* Wire compression follows the HVD_TPU_COMPRESSION job default (the
+     torch binding's Compression codecs stay tensor-level). */
   handle = horovod_tpu_enqueue_allreduce(
       name, (const void*)(uintptr_t)data_ptr, (void*)(uintptr_t)out_ptr,
-      ndim, shape, dtype, pre, post);
+      ndim, shape, dtype, pre, post, horovod_tpu_default_compression());
   Py_END_ALLOW_THREADS
   return PyLong_FromLong(handle);
 }

@@ -1,0 +1,65 @@
+"""Compression A/B bench worker (bench.py --compression): allreduces a
+gradient-bundle-sized f32 payload HVD_TPU_BENCH_ITERS times under
+HVD_TPU_COMPRESSION, then reports wall time per op and the socket-layer
+wire counters as one `COMPRESSION_BENCH {...}` JSON line per rank.
+
+Values are verified every iteration (rank-offset ramp) so a codec
+regression fails the bench rather than biasing it."""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import ops
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    iters = int(os.environ.get("HVD_TPU_BENCH_ITERS", "20"))
+    mb = float(os.environ.get("HVD_TPU_BENCH_MB", "4"))
+    mode = os.environ.get("HVD_TPU_COMPRESSION", "none") or "none"
+    elems = int(mb * 1024 * 1024 / 4)
+    base = (np.arange(elems, dtype=np.float32) % 997) / 31.0
+    want = base * n + sum(range(n))
+    tol = {"none": 1e-5, "bf16": 2e-2, "int8": 4e-2}[mode]
+
+    def counters():
+        return hvd.metrics()["counters"]
+
+    # Warmup (connection buffers, fusion path, cache entry).
+    out = ops.allreduce(base + r, "cmpbench.warm")
+    assert out.shape == base.shape
+
+    c0 = counters()
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = ops.allreduce(base + r, "cmpbench.%d" % i)
+        err = np.max(np.abs(out - want)) / np.max(np.abs(want))
+        assert err < tol, (mode, i, err)
+    dt = time.perf_counter() - t0
+    c1 = counters()
+
+    row = {
+        "rank": r, "size": n, "mode": mode, "iters": iters,
+        "payload_mb": mb,
+        "us_per_op": round(dt / iters * 1e6, 1),
+        "ring_bytes_sent": c1["net_ring_bytes_sent_total"] -
+                           c0["net_ring_bytes_sent_total"],
+        "ring_bytes_recv": c1["net_ring_bytes_recv_total"] -
+                           c0["net_ring_bytes_recv_total"],
+        "codec_bytes_in": c1["compression_bytes_in_total"] -
+                          c0["compression_bytes_in_total"],
+        "codec_bytes_out": c1["compression_bytes_out_total"] -
+                           c0["compression_bytes_out_total"],
+    }
+    print("COMPRESSION_BENCH %s" % json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Mixed-compression negotiation rejection (docs/COMPRESSION.md): rank 0
+requests bf16 while every other rank requests int8 for the SAME tensor.
+The coordinator must reject the op with an error NAMING both ranks and
+both modes — on every rank, promptly, never a hang or a silently
+mis-decoded frame.
+
+Run: python -m horovod_tpu.run.run -np 2 -- python tests/compression_mixed_worker.py
+"""
+
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import ops
+from horovod_tpu.common.ops import HorovodInternalError
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n >= 2
+    x = np.ones(100, np.float32)
+
+    mode = "bf16" if r == 0 else "int8"
+    try:
+        ops.allreduce(x, "mixed", compression=mode)
+    except HorovodInternalError as e:
+        msg = str(e)
+        assert "Mismatched compression modes" in msg, msg
+        assert "bf16" in msg and "int8" in msg, msg
+        assert "rank 0" in msg, msg
+        print("rank %d: mixed-mode rejected with both modes named" % r,
+              flush=True)
+    else:
+        raise SystemExit("mixed-mode allreduce unexpectedly succeeded")
+
+    # The error is per-tensor, not fatal: a subsequent uniform-mode op
+    # on the same communicator completes.
+    out = ops.allreduce(x, "uniform", compression="int8")
+    assert np.allclose(out, n, atol=0.1), out
+    print("rank %d: mixed worker passed" % r, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -213,6 +213,50 @@ def test_chaos_control_reconnect_without_restart(run_launcher):
     assert out.count("negotiation fuzz passed") == 2
 
 
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+def test_chaos_compression_corrupt_frame(run_launcher, mode):
+    """Compression-on variant of the corrupt-frame acceptance e2e: the
+    ring payloads are now ENCODED (bf16/int8 + in-band scales), and the
+    CRC covers the compressed frame — so a mid-stream corruption is
+    still a detected checksum mismatch surfacing as the recoverable
+    connection-lost error, and every completed collective returned
+    correct (codec-bounded) values. Invariant unchanged: verified-
+    correct completion or a prompt cause-naming failure."""
+    env = dict(CHAOS_ENV)
+    env["HVD_TPU_COMPRESSION"] = mode
+    env["HVD_TPU_FAULT_SPEC"] = \
+        "seed=24;rank=1,chan=ring,dir=send,frame=10,action=corrupt"
+    env["HVD_TPU_CHAOS_EXPECT_FAILURE"] = "1"
+    t0 = time.monotonic()
+    result = run_launcher(2, "chaos_worker.py", extra_env=env,
+                          timeout=DEADLINE + 30)
+    elapsed = time.monotonic() - t0
+    out = result.stdout + result.stderr
+    assert elapsed < DEADLINE, "took %.0fs" % elapsed
+    assert result.returncode == 0, out[-3000:]
+    assert "chaos: connection lost surfaced cleanly" in out
+    assert "checksum mismatch" in out
+    assert "SILENT CORRUPTION" not in out
+
+
+def test_chaos_compression_reconnect(run_launcher):
+    """Compression-on variant of the reconnect spec: a killed control
+    connection heals under backoff while every allreduce rides the int8
+    wire — the run completes with all values verified by the worker."""
+    env = dict(CHAOS_ENV)
+    env["HVD_TPU_COMPRESSION"] = "int8"
+    env["HVD_TPU_RECONNECT_SECONDS"] = "10"
+    env["HVD_TPU_FAULT_SPEC"] = \
+        "seed=25;rank=1,chan=control,dir=send,frame=4,action=close"
+    result = run_launcher(2, "negotiation_fuzz_worker.py", extra_env=env,
+                          timeout=DEADLINE + 30)
+    out = result.stdout + result.stderr
+    assert result.returncode == 0, out[-3000:]
+    assert "fault injected: close" in out
+    assert "control connection re-established" in out
+    assert out.count("negotiation fuzz passed") == 2
+
+
 def test_chaos_reconnect_metrics_counted(run_launcher):
     """The recovery counters (docs/METRICS.md) record the healed fault:
     reconnect attempts/successes and the injected-fault tally are

@@ -62,6 +62,11 @@ BAD_CORPUS = {
         if hvd.rank() == 0:
             checkpoint.save("/ckpt", tree, step=5)
     """,
+    "compression-on-integer-tensor": """
+        import horovod_tpu.jax as hvd
+        ids = tokens.astype(jnp.int32)
+        hvd.allreduce(ids, name="ids", compression="int8")
+    """,
 }
 
 # --- known-good twins: the corrected version of each snippet ----------------
@@ -110,6 +115,11 @@ GOOD_CORPUS = {
         if hvd.rank() == 0:
             print("saved")
     """,
+    "compression-on-integer-tensor": """
+        import horovod_tpu.jax as hvd
+        grads = jax.grad(loss)(params)
+        hvd.allreduce(grads, name="g", compression="int8")
+    """,
 }
 
 
@@ -121,6 +131,38 @@ def test_known_bad_flags(rule):
 @pytest.mark.parametrize("rule", sorted(GOOD_CORPUS))
 def test_known_good_clean(rule):
     assert rules_of(GOOD_CORPUS[rule]) == []
+
+
+def test_compression_on_embedding_lookup_is_warning():
+    findings = lint_source(textwrap.dedent("""
+        import horovod_tpu.jax as hvd
+        rows = jnp.take(emb, token_ids, axis=0)
+        hvd.allreduce(rows, name="emb", compression="bf16")
+    """))
+    ours = [f for f in findings
+            if f.rule == "compression-on-integer-tensor"]
+    assert len(ours) == 1 and ours[0].severity == "warning", findings
+
+
+def test_compression_integer_via_dataflow_and_dtype_kwarg():
+    # One-level dataflow: the int provenance survives the assignment.
+    assert "compression-on-integer-tensor" in rules_of("""
+        import horovod_tpu as hvd
+        mask = np.zeros(100, dtype=np.int64)
+        hvd.allreduce(mask, name="m", compression="int8")
+    """)
+    # Float tensors with compression are clean...
+    assert rules_of("""
+        import horovod_tpu as hvd
+        g = np.zeros(100, dtype=np.float32)
+        hvd.allreduce(g, name="g", compression="int8")
+    """) == []
+    # ...and compression='none' on an integer tensor is clean too.
+    assert rules_of("""
+        import horovod_tpu as hvd
+        ids = tokens.astype(np.int32)
+        hvd.allreduce(ids, name="ids", compression="none")
+    """) == []
 
 
 def test_uniform_size_condition_not_flagged():
