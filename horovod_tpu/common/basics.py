@@ -167,6 +167,9 @@ class HorovodBasics:
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_double]
+        lib.horovod_tpu_drain_metrics.restype = None
+        lib.horovod_tpu_drain_metrics.argtypes = [
+            ctypes.c_int64, ctypes.c_int64]
         lib.horovod_tpu_job_metrics_json.restype = ctypes.c_char_p
         lib.horovod_tpu_job_metrics_json.argtypes = []
         lib.horovod_tpu_autotune_params.restype = None
@@ -274,6 +277,13 @@ class HorovodBasics:
         self.lib.horovod_tpu_ckpt_metrics(
             int(writes), int(failures), int(nbytes), int(restores),
             int(restore_failures), int(last_step), float(write_seconds))
+
+    def drain_metrics(self, requested=0, draining=-2):
+        """Reports graceful-drain accounting into the native registry
+        (docs/FLEET.md): `requested` is a counter delta; `draining` the
+        absolute posture gauge (1 = victim of the current drain epoch,
+        0 = survivor, -1 = reset; < -1 = leave unchanged)."""
+        self.lib.horovod_tpu_drain_metrics(int(requested), int(draining))
 
     def compressed_size(self, count, mode):
         """Wire bytes `count` f32 elements occupy under compression

@@ -17,6 +17,28 @@ import subprocess
 import time
 
 
+def plan_spawns(available, live_per_host, room):
+    """Hosts to spawn new workers on, one list entry per worker — the
+    pure placement rule shared by the single-job elastic driver's
+    growth path and the fleet controller's pool
+    (``horovod_tpu/fleet/placement.py`` re-exports it).
+
+    ``available``: {host: slots} — the spawnable inventory (already
+    blacklist-filtered). ``live_per_host``: {host: live worker count}.
+    ``room``: how many more workers may be added. Hosts are walked in
+    sorted order so the plan is deterministic across supervisors."""
+    if room <= 0:
+        return []
+    plan = []
+    for host, slots in sorted(available.items()):
+        free = slots - live_per_host.get(host, 0)
+        for _ in range(max(0, free)):
+            if len(plan) >= room:
+                return plan
+            plan.append(host)
+    return plan
+
+
 class HostDiscovery:
     """Interface: report the currently-available hosts."""
 
@@ -119,6 +141,18 @@ class HostManager:
         backoff = min(self._cooldown * (2 ** (count - 1)),
                       self._max_backoff)
         self._failures[host] = (count, now + backoff, now)
+
+    def record_release(self, host):
+        """A worker on `host` exited VOLUNTARILY — planned drain,
+        preemption hand-back, controller-requested shrink. Unlike
+        :meth:`record_failure` this must NOT start (or extend) the
+        backoff blacklist: a drained host is healthy by definition and
+        re-enters the spawnable pool immediately. It is not success
+        evidence either — a pre-existing failure streak (from an
+        earlier real crash) keeps its cooldown untouched, so a flaky
+        host can't launder its blacklist through a planned drain."""
+        # Deliberately records nothing: voluntary exit is neither
+        # failure evidence nor post-failure health proof.
 
     def record_success(self, host, started_at=None):
         """Clears the failure streak — but only on evidence that

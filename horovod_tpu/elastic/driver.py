@@ -29,12 +29,13 @@ import json
 import os
 import signal
 import sys
+import threading
 import time
 
 from horovod_tpu.run import rendezvous, util
 
-from .discovery import HostManager
-from .state import KEY_STATE, SCOPE_ELASTIC
+from .discovery import HostManager, plan_spawns
+from .state import EXIT_DRAINED, KEY_DRAIN, KEY_STATE, SCOPE_ELASTIC
 
 _Slot = collections.namedtuple("_Slot", ["hostname", "rank"])
 
@@ -55,7 +56,8 @@ class ElasticDriver:
     def __init__(self, command, discovery, min_np, max_np,
                  np_initial=None, ssh_port=None, start_timeout=60,
                  verbose=False, env=None, ckpt_dir=None,
-                 restart_from_ckpt=False):
+                 restart_from_ckpt=False, drain_grace=None,
+                 health_sink=None):
         if min_np < 1 or max_np < min_np:
             raise ValueError("need 1 <= min_np <= max_np (got %d..%d)"
                              % (min_np, max_np))
@@ -74,6 +76,11 @@ class ElasticDriver:
             "HVD_TPU_CKPT_MAX_RESTARTS", "3"))
         cooldown = float(os.environ.get("HVD_TPU_ELASTIC_COOLDOWN", "10"))
         self._hosts = HostManager(discovery, cooldown=cooldown)
+        # Optional mirror for host-health evidence (record_failure /
+        # record_success): the fleet controller passes its
+        # PlacementPool here so one tenant's crashing host blacklists
+        # fleet-wide, not just within the observing job.
+        self._health_sink = health_sink
         self._discovery_interval = float(
             os.environ.get("HVD_TPU_ELASTIC_DISCOVERY_INTERVAL", "1.0"))
 
@@ -84,6 +91,29 @@ class ElasticDriver:
         self._published_size = 0
         self._job_done = False
         self._late_rcs = []
+
+        # Graceful drain (docs/FLEET.md): the supervisor-side half of
+        # the protocol. `_drain_epoch` numbers the published requests;
+        # `_drain_victims` holds the worker ids the current epoch
+        # covers (escalated with SIGKILL at `_drain_deadline`);
+        # `_draining_all` marks a whole-job drain, whose completion
+        # makes run() return EXIT_DRAINED instead of tearing down.
+        self._drain_grace = drain_grace
+        self._drain_epoch = 0
+        self._drain_completed = 0
+        self._drain_victims = set()
+        self._drain_deadline = None
+        self._draining_all = False
+        self._term_requested = False
+        self._abort = False
+        # Guards the drain bookkeeping: request_drain runs on the
+        # FLEET CONTROLLER's thread while the run loop's tombstone
+        # check runs on the driver thread — without the lock, the loop
+        # slipping between the epoch bump and the victim registration
+        # would tombstone the brand-new epoch as already-completed, and
+        # the live drain record would then never be tombstoned (late
+        # replacement workers would keep re-acting on it).
+        self._drain_lock = threading.Lock()
 
         self._secret = rendezvous.make_secret()
         self._server = rendezvous.RendezvousServer(key=self._secret)
@@ -223,6 +253,9 @@ class ElasticDriver:
                     w.healthy = True
                     self._hosts.record_success(w.hostname,
                                                started_at=w.started)
+                    if self._health_sink is not None:
+                        self._health_sink.record_success(
+                            w.hostname, started_at=w.started)
                 continue
             del self._workers[wid]
             if rc == 0:
@@ -232,6 +265,23 @@ class ElasticDriver:
                 if self._verbose:
                     sys.stderr.write(
                         "[elastic] worker %d finished\n" % wid)
+            elif rc == EXIT_DRAINED or wid in self._drain_victims:
+                # Voluntary exit (graceful drain / preemption hand-back,
+                # incl. a victim the grace escalation had to SIGKILL):
+                # the host is healthy by definition — it re-enters the
+                # spawnable pool immediately instead of tripping the
+                # failure blacklist's backoff cooldown. Membership still
+                # changed, so survivors repartition at a new generation.
+                self._drain_victims.discard(wid)
+                self._hosts.record_release(w.hostname)
+                sys.stderr.write(
+                    "[elastic] worker %d on %s drained (%s); host "
+                    "released without blacklist\n"
+                    % (wid, w.hostname,
+                       "rc=%d" % rc if rc == EXIT_DRAINED
+                       else "escalated, rc=%d" % rc))
+                if not self._job_done:
+                    changed = True
             elif self._job_done:
                 self._late_rcs.append(rc)
             else:
@@ -240,26 +290,23 @@ class ElasticDriver:
                     "blacklisting host with backoff\n"
                     % (wid, w.hostname, rc))
                 self._hosts.record_failure(w.hostname)
+                if self._health_sink is not None:
+                    self._health_sink.record_failure(w.hostname)
                 changed = True
         return changed
 
     def _plan_growth(self):
         """Hosts with free, non-blacklisted slots to spawn on (one entry
-        per new worker), capped at max_np."""
-        room = self._max_np - len(self._workers)
-        if room <= 0 or self._job_done:
+        per new worker), capped at max_np. The planning rule itself is
+        the shared `plan_spawns` — the fleet controller plans multi-job
+        placements with the same function."""
+        if self._job_done or self._draining_all:
             return []
         live_per_host = collections.Counter(
             w.hostname for w in self._workers.values())
-        plan = []
-        for host, slots in sorted(
-                self._hosts.available_hosts_and_slots().items()):
-            free = slots - live_per_host.get(host, 0)
-            for _ in range(max(0, free)):
-                if len(plan) >= room:
-                    return plan
-                plan.append(host)
-        return plan
+        return plan_spawns(self._hosts.available_hosts_and_slots(),
+                           live_per_host,
+                           self._max_np - len(self._workers))
 
     def _kill_all(self):
         for w in self._workers.values():
@@ -267,6 +314,63 @@ class ElasticDriver:
                 os.killpg(os.getpgid(w.proc.pid), signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 pass
+
+    # -- graceful drain (supervisor side; docs/FLEET.md) -------------------
+    def request_drain(self, victims="all", grace=None):
+        """Publishes a drain request: the victim workers finish their
+        in-flight step, force a durable commit of exactly that step,
+        and exit with EXIT_DRAINED (elastic/run.py honors it at the
+        next commit's agreement allreduce). `victims` is "all" or a
+        list of worker ids; `grace` the seconds before the driver
+        escalates to SIGKILL. Thread-safe enough for the fleet
+        controller's call pattern (one supervisor thread per job plus
+        the controller thread requesting drains)."""
+        if grace is None:
+            grace = self._drain_grace if self._drain_grace else 30.0
+        with self._drain_lock:
+            self._drain_epoch += 1
+            if victims == "all":
+                self._drain_victims.update(self._workers)
+                self._draining_all = True
+                wire_victims = "all"
+            else:
+                wire_victims = [str(v) for v in victims]
+                self._drain_victims.update(int(v) for v in wire_victims)
+            self._server.put_local(SCOPE_ELASTIC, KEY_DRAIN, json.dumps({
+                "epoch": self._drain_epoch,
+                "workers": wire_victims,
+                "grace": grace,
+            }))
+            self._drain_deadline = time.monotonic() + grace
+        sys.stderr.write(
+            "[elastic] drain epoch %d requested for worker(s) %s "
+            "(grace %.0fs)\n" % (self._drain_epoch, wire_victims, grace))
+
+    def draining(self):
+        """True while a drain epoch has victims that have not exited."""
+        return bool(self._drain_victims)
+
+    def _escalate_drain(self):
+        """SIGKILLs drain victims that outlived the grace window (a
+        worker wedged in a collective cannot reach its next commit to
+        notice the request). Their exits still count as voluntary —
+        the ESCALATION was planned, the host is not failure-suspect."""
+        if self._drain_deadline is None or \
+                time.monotonic() < self._drain_deadline:
+            return
+        for wid in sorted(self._drain_victims):
+            w = self._workers.get(wid)
+            if w is None:
+                self._drain_victims.discard(wid)
+                continue
+            sys.stderr.write(
+                "[elastic] drain grace expired; escalating to SIGKILL "
+                "for worker %d\n" % wid)
+            try:
+                os.killpg(os.getpgid(w.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        self._drain_deadline = None
 
     # -- durable-checkpoint restart (--restart-from-ckpt) -----------------
     def _report_last_durable(self):
@@ -349,8 +453,40 @@ class ElasticDriver:
         self._publish()
         return True
 
+    # -- fleet-controller surface (horovod_tpu/fleet/controller.py) --------
+    def live_per_host(self):
+        """{host: live worker count} — the controller's occupancy view
+        (snapshot read; safe from another thread under the GIL)."""
+        counts = collections.Counter(
+            w.hostname for w in self._workers.values())
+        return dict(counts)
+
+    def live_workers(self):
+        """Sorted live worker ids (chaos kill-victim candidates)."""
+        return sorted(self._workers)
+
+    def worker_pid(self, wid):
+        w = self._workers.get(wid)
+        return w.proc.pid if w is not None else None
+
+    def resize(self, max_np):
+        """Moves the growth ceiling (the fleet controller shrinks it
+        before a partial drain so the driver does not regrow into the
+        slots it is handing back, and raises it again when capacity is
+        leased back)."""
+        self._max_np = max(1, int(max_np))
+
+    def terminate(self):
+        """Hard teardown from the controller (fleet shutdown): the run
+        loop kills the workers and returns 1 at its next tick."""
+        self._abort = True
+
     # -- main loop ---------------------------------------------------------
-    def run(self):
+    def run(self, install_signal_handlers=True):
+        """Supervises the job; returns its exit code. The fleet
+        controller runs one driver per job in a worker THREAD and
+        passes install_signal_handlers=False (signal.signal is
+        main-thread-only; the controller owns the process's signals)."""
         local_addr = self._base_env.get("HVD_TPU_RENDEZVOUS_HOST")
         self._hosts.refresh()
         hosts = self._hosts.available_hosts_and_slots()
@@ -359,8 +495,21 @@ class ElasticDriver:
             local_addr = (rendezvous.routable_ip(remote[0]) if remote
                           else "127.0.0.1")
         self._addr = "%s:%d" % (local_addr, self._server.start())
+        if not install_signal_handlers:
+            try:
+                return self._run_loop()
+            finally:
+                self._server.stop()
 
         def on_signal(signum, frame):
+            if signum == signal.SIGTERM and self._drain_grace:
+                # Preemption-style SIGTERM (fleet controller, cluster
+                # manager): drain instead of killing — workers finish
+                # the in-flight step, durable-commit it, and exit
+                # cleanly; the loop escalates at grace expiry and
+                # run() returns EXIT_DRAINED.
+                self._term_requested = True
+                return
             self._publish(status="shutdown")
             self._kill_all()
             sys.exit(1)
@@ -388,8 +537,11 @@ class ElasticDriver:
         below_min_since = None
         last_discovery = 0.0
         while True:
-            if plan and self._job_done:
-                plan = []  # completion won the race against a planned grow
+            if plan and (self._job_done or self._draining_all):
+                # Completion (or a whole-job drain) won the race against
+                # a planned grow — spawning into a finished/draining job
+                # would strand a worker outside the drain epoch.
+                plan = []
             if plan:
                 # Spawn first (allocating the new worker ids), then
                 # publish one assignment covering old + new workers.
@@ -402,11 +554,43 @@ class ElasticDriver:
                 self._publish()
                 plan = []
             time.sleep(0.1)
+            if self._abort:
+                self._publish(status="shutdown")
+                self._teardown_workers()
+                return 1
+            if self._term_requested and not self._draining_all:
+                self.request_drain("all")
+            self._escalate_drain()
             changed = self._reap()
+            with self._drain_lock:
+                if not self._drain_victims:
+                    self._drain_deadline = None
+                    if self._drain_epoch > self._drain_completed and \
+                            not self._draining_all:
+                        # Tombstone the completed epoch: a replacement
+                        # spawned AFTER a partial drain must fast-forward
+                        # past the stale record instead of re-acting on
+                        # it (elastic/run.py reads `done` as
+                        # already-honored).
+                        self._drain_completed = self._drain_epoch
+                        self._server.put_local(
+                            SCOPE_ELASTIC, KEY_DRAIN, json.dumps({
+                                "epoch": self._drain_epoch, "workers": [],
+                                "grace": 0, "done": True}))
             if self._job_done:
                 if not self._workers:
                     return max(self._late_rcs, default=0)
                 continue  # let the rest finish; no more respawns
+            if self._draining_all and not self._workers:
+                # Whole-job drain complete: every worker durable-
+                # committed and handed its host back. EXIT_DRAINED (not
+                # 1) tells the supervisor this was the requested
+                # preemption, restorable from the durable lineage.
+                self._publish(status="shutdown")
+                self._report_last_durable()
+                sys.stderr.write(
+                    "[elastic] drain complete; job preempted cleanly\n")
+                return EXIT_DRAINED
             if not changed and self._reinit_requested():
                 sys.stderr.write("[elastic] reinit requested by a worker; "
                                  "bumping generation\n")
@@ -425,6 +609,11 @@ class ElasticDriver:
             # not gated: a dead worker must repartition immediately.
             plan = self._plan_growth() if self._generation_ready() else []
 
+            if self._draining_all:
+                # Victims are exiting by design; the below-min teardown
+                # and restart-from-ckpt paths must not fire on the way
+                # down (the drain-complete check above owns the exit).
+                continue
             if len(self._workers) + len(plan) < self._min_np:
                 plan = []
                 if not self._workers:
@@ -466,12 +655,15 @@ class ElasticDriver:
 
 def run_elastic(np_, discovery, command, min_np, max_np, ssh_port=None,
                 start_timeout=60, verbose=False, env=None,
-                ckpt_dir=None, restart_from_ckpt=False):
+                ckpt_dir=None, restart_from_ckpt=False,
+                drain_grace=None):
     """Launcher entry: supervise `command` elastically. Returns exit
-    code."""
+    code (EXIT_DRAINED after a SIGTERM-driven graceful drain when
+    `drain_grace` is set)."""
     driver = ElasticDriver(command, discovery, min_np, max_np,
                            np_initial=np_, ssh_port=ssh_port,
                            start_timeout=start_timeout, verbose=verbose,
                            env=env, ckpt_dir=ckpt_dir,
-                           restart_from_ckpt=restart_from_ckpt)
+                           restart_from_ckpt=restart_from_ckpt,
+                           drain_grace=drain_grace)
     return driver.run()

@@ -654,6 +654,33 @@ class DurableCheckpointer:
             self._cv.notify()
         return True
 
+    def force_enqueue(self, committed, step):
+        """Unconditional enqueue into the STICKY slot, bypassing the
+        ``_due`` cadence — the graceful-drain path (docs/FLEET.md):
+        every rank force-writes the drained step's shard regardless of
+        its local skip/cadence state, so the manifest for exactly the
+        drained commit completes (not an older sticky anchor). Sticky
+        placement means the publisher waits its full timeout and a
+        racing non-sticky snapshot cannot displace it."""
+        if committed is None:
+            return False
+        step = int(step)
+        job = (committed, step, self._generation(), self._rank(),
+               self._size(), True)
+        with self._cv:
+            self._pending_sticky = job
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._writer_loop, name="hvd-durable-ckpt",
+                    daemon=True)
+                self._thread.start()
+            self._cv.notify()
+        # Keep the cadence bookkeeping coherent: the drained step's
+        # window counts as written, so a post-drain survivor does not
+        # immediately double-write it.
+        self._last_step_bucket = step // self.every_n_commits
+        return True
+
     def _take_pending_locked(self):
         """Next job for the writer: the sticky slot first (it is always
         the older of the two), then the newest snapshot."""

@@ -29,7 +29,8 @@ import time
 
 from horovod_tpu.common.ops import HorovodInternalError
 
-from .state import KEY_STATE, SCOPE_ELASTIC, HostsUpdatedInterrupt
+from .state import (EXIT_DRAINED, KEY_DRAIN, KEY_STATE, SCOPE_ELASTIC,
+                    DrainRequested, HostsUpdatedInterrupt)
 
 # Env keys owned by a single generation's topology; scrubbed before
 # re-rendezvous so nothing stale leaks into the next generation.
@@ -55,6 +56,139 @@ class JobCompleted(Exception):
 def _is_elastic():
     return os.environ.get("HVD_TPU_ELASTIC") == "1" and \
         os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain (docs/FLEET.md): the supervisor (elastic driver, fleet
+# controller, or the static launcher under --drain-grace) publishes a
+# drain request at scope ``elastic`` key ``drain``::
+#
+#     {"epoch": n, "workers": "all" | ["3", "7"], "grace": seconds}
+#
+# Workers notice it at their next commit. Because ranks poll on their own
+# clocks, the ACTION is synchronized with a 1-element indicator allreduce
+# inside every commit of a drain-enabled job: every rank raises
+# DrainRequested at the same step, every rank force-writes that step's
+# durable shard (so the manifest completes), then the victims exit with
+# EXIT_DRAINED and the survivors re-initialize without rollback.
+
+_drain_state = {"done_epoch": 0, "last_poll": 0.0, "pending": None}
+
+
+def _drain_poll_enabled():
+    """Rank-uniform gate for the per-commit agreement allreduce: set at
+    spawn time by the launcher/driver (never from a locally-observed
+    event, which would be rank-divergent)."""
+    return (os.environ.get("HVD_TPU_ELASTIC") == "1"
+            or os.environ.get("HVD_TPU_DRAIN_ENABLE") == "1") and \
+        bool(os.environ.get("HVD_TPU_RENDEZVOUS_ADDR"))
+
+
+def _read_drain_record():
+    addr = os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+    if not addr:
+        return None
+    from horovod_tpu.run import rendezvous
+    try:
+        raw = rendezvous.get(addr, SCOPE_ELASTIC, KEY_DRAIN)
+        if raw is None:
+            return None
+        rec = json.loads(raw.decode())
+        epoch = int(rec.get("epoch", 0))
+        if rec.get("done"):
+            # Tombstone of a completed epoch (the driver publishes it
+            # once every victim exited): fast-forward so a replacement
+            # that never lived through the drain does not act on it.
+            _drain_state["done_epoch"] = max(_drain_state["done_epoch"],
+                                             epoch)
+            return None
+        if epoch <= _drain_state["done_epoch"]:
+            return None  # already honored (this process survived it)
+        return rec
+    except Exception:
+        return None
+
+
+def drain_requested():
+    """Lightweight local poll: True when an unhonored drain request
+    covering THIS worker is currently published. For custom training
+    loops that cannot use ``ElasticState.commit()``; the commit path
+    uses the synchronized agreement in :func:`poll_drain_agreement`."""
+    if not _drain_poll_enabled():
+        return False
+    rec = _read_drain_record()
+    if rec is None:
+        return False
+    victims = rec.get("workers", "all")
+    wid = os.environ.get("HVD_TPU_WORKER_ID")
+    return victims == "all" or (wid is not None and
+                                str(wid) in [str(v) for v in victims])
+
+
+def _drain_metrics(requested=0, draining=-2):
+    """Best-effort native drain accounting (drains_requested_total
+    counter + draining gauge ride the summary wire into /job and the
+    hvd-top ``drn`` column). ``draining`` is absolute: 1 victim,
+    0 survivor, -1 reset, < -1 leave unchanged."""
+    try:
+        from horovod_tpu.common.basics import get_basics
+        get_basics().drain_metrics(requested, draining)
+    except Exception:
+        pass
+
+
+def poll_drain_agreement():
+    """Called from ``State.commit()``. Returns ``(victims, epoch,
+    grace)`` when a drain has been agreed across ranks, else None.
+
+    The local KV read is rate-limited (HVD_TPU_ELASTIC_CHECK_INTERVAL),
+    but the indicator allreduce runs at EVERY commit when drain polling
+    is enabled — it must be rank-uniform, and commits are the elastic
+    contract's rank-uniform points. An agreement where this rank has
+    not yet seen the record itself re-reads the KV synchronously (a
+    peer proved the record exists)."""
+    if not _drain_poll_enabled():
+        return None
+    st = _drain_state
+    now = time.monotonic()
+    interval = float(os.environ.get("HVD_TPU_ELASTIC_CHECK_INTERVAL",
+                                    "0.5"))
+    if st["pending"] is None and now - st["last_poll"] >= interval:
+        st["last_poll"] = now
+        st["pending"] = _read_drain_record()
+    local = 1.0 if st["pending"] is not None else 0.0
+    agreed = local
+    import horovod_tpu as hvd
+    if hvd.is_initialized() and hvd.size() > 1:
+        import numpy as np
+        out = hvd.allreduce(np.array([local], dtype=np.float64),
+                            "_hvd_drain_poll")
+        agreed = float(np.asarray(out).reshape(-1)[0])
+    if agreed < 0.5:
+        return None
+    rec = st["pending"]
+    if rec is None:
+        # A peer saw the request first; the record is committed to the
+        # KV (peers only learn of drains by reading it), so a short
+        # bounded re-read closes the gap.
+        deadline = time.monotonic() + 5.0
+        while rec is None and time.monotonic() < deadline:
+            rec = _read_drain_record()
+            if rec is None:
+                time.sleep(0.05)
+    if rec is None:
+        # Degraded: agreement fired but the record is unreadable. Not
+        # acting keeps this rank safe either way — as a victim the
+        # supervisor escalates at grace expiry, as a survivor the
+        # peers' exits surface as a recoverable connection loss.
+        _log("drain agreed by peers but the drain record is "
+             "unreadable; continuing until the supervisor escalates")
+        return None
+    st["pending"] = None
+    epoch = int(rec.get("epoch", 1))
+    st["done_epoch"] = max(st["done_epoch"], epoch)
+    return (rec.get("workers", "all"), epoch,
+            float(rec.get("grace", 30.0)))
 
 
 def current_generation():
@@ -266,6 +400,36 @@ def run(func):
                 reset = "error"
                 min_generation = current_generation() + 1
                 _request_reinit(current_generation())
+            except DrainRequested as e:
+                # Every rank reaches this handler at the SAME step (the
+                # agreement allreduce in commit()), so the forced
+                # durable write below is manifest-complete: rank 0's
+                # publisher finds every sibling shard for the drained
+                # step instead of timing out on a skewed one.
+                wid = os.environ.get("HVD_TPU_WORKER_ID")
+                victims = e.victims
+                is_victim = victims == "all" or (
+                    wid is not None and
+                    str(wid) in [str(v) for v in victims])
+                durable = getattr(state, "_durable", None)
+                step = getattr(state, "step", None)
+                if durable is not None:
+                    durable.force_enqueue(state._committed,
+                                          state._durable_step())
+                _drain_metrics(requested=1,
+                               draining=1 if is_victim else 0)
+                if is_victim:
+                    _log("drain (epoch %d): writing durable snapshot "
+                         "of step %s, then exiting with EXIT_DRAINED"
+                         % (e.epoch, step))
+                    if durable is not None:
+                        _flush_durable(state, timeout=e.grace)
+                    sys.exit(EXIT_DRAINED)
+                _log("drain (epoch %d): peer worker(s) %s leaving; "
+                     "re-initializing at the post-drain generation "
+                     "without rollback" % (e.epoch, victims))
+                reset = "update"
+                min_generation = current_generation() + 1
             except HostsUpdatedInterrupt as e:
                 _log("membership changed (generation %d); re-initializing"
                      % e.generation)

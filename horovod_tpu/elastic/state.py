@@ -29,6 +29,12 @@ import numpy as np
 
 SCOPE_ELASTIC = "elastic"
 KEY_STATE = "state"
+# Graceful-drain protocol (docs/FLEET.md): the driver/launcher publishes
+# a drain request here; workers notice it at their next commit, force a
+# durable snapshot of exactly that commit, and the victims exit with
+# EXIT_DRAINED so supervisors can tell a planned hand-back from a crash.
+KEY_DRAIN = "drain"
+EXIT_DRAINED = 83
 
 
 def _tree_flatten(obj, path=""):
@@ -64,6 +70,26 @@ def _tree_map_leaves(obj, leaves_iter):
                 else tuple(vals)
         return vals
     return next(leaves_iter)
+
+
+class DrainRequested(Exception):
+    """Raised from ``commit()`` when a graceful-drain request covers
+    this process (or a peer): the snapshot for the current step has
+    already been saved, the agreement allreduce has confirmed every
+    rank raises at the SAME step, and the ``@run`` wrapper now forces
+    a durable write of exactly this commit before the victims exit
+    with ``EXIT_DRAINED`` (survivors re-initialize without rollback).
+
+    ``victims`` is ``"all"`` or a list of worker-id strings; ``epoch``
+    is the drain request's sequence number; ``grace`` the seconds the
+    supervisor allows before it escalates to a hard kill."""
+
+    def __init__(self, victims, epoch, grace):
+        super().__init__("drain requested (epoch %s, victims %s)"
+                         % (epoch, victims))
+        self.victims = victims
+        self.epoch = epoch
+        self.grace = grace
 
 
 class HostsUpdatedInterrupt(Exception):
@@ -148,6 +174,7 @@ class State:
         if self._durable is not None:
             self._durable.maybe_enqueue(self._committed,
                                         self._durable_step())
+        self.check_drain()
         self.check_host_updates()
 
     # -- durability (elastic/durable.py; docs/ELASTIC.md "Durability") -----
@@ -203,6 +230,24 @@ class State:
             return leaf
         leaves = iter([conv(l) for _, l in _tree_flatten(value)])
         return _tree_map_leaves(value, leaves)
+
+    # -- graceful-drain polling (docs/FLEET.md) ----------------------------
+    def check_drain(self):
+        """Raises :class:`DrainRequested` when a drain request has been
+        agreed across ranks. The agreement runs at EVERY commit of a
+        drain-enabled job (``HVD_TPU_ELASTIC=1`` or
+        ``HVD_TPU_DRAIN_ENABLE=1``) — a tiny rank-uniform indicator
+        allreduce — so every rank raises at the same step and the
+        forced durable snapshot is manifest-complete (all ranks write
+        the drained step's shard). Commits being rank-uniform by the
+        elastic contract is what makes the extra collective safe."""
+        # NB: `from . import run` would grab the package attribute
+        # `run` — the DECORATOR the package __init__ re-exports — not
+        # the submodule; import the function explicitly.
+        from .run import poll_drain_agreement
+        agreed = poll_drain_agreement()
+        if agreed is not None:
+            raise DrainRequested(*agreed)
 
     # -- membership-change polling ----------------------------------------
     def check_host_updates(self):

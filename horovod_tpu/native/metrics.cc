@@ -68,6 +68,8 @@ const char* SummaryFieldName(int field) {
     case SUM_COMPRESSION_BYTES_IN: return "compression_bytes_in_total";
     case SUM_COMPRESSION_BYTES_OUT: return "compression_bytes_out_total";
     case SUM_NET_RING_BYTES_SENT: return "net_ring_bytes_sent_total";
+    case SUM_DRAINS_REQUESTED: return "drains_requested_total";
+    case SUM_DRAINING: return "draining";
   }
   return "unknown";
 }
@@ -158,6 +160,9 @@ std::vector<double> Metrics::Summary() const {
       static_cast<double>(compression_bytes_out_total.load());
   v[SUM_NET_RING_BYTES_SENT] =
       static_cast<double>(net_ring_bytes_sent_total.load());
+  v[SUM_DRAINS_REQUESTED] =
+      static_cast<double>(drains_requested_total.load());
+  v[SUM_DRAINING] = static_cast<double>(draining.load());
   return v;
 }
 
@@ -294,6 +299,8 @@ std::string Metrics::SnapshotJson() const {
   AppendKV(&out, "ckpt_restores_total", ckpt_restores_total.load(), &first);
   AppendKV(&out, "ckpt_restore_failures_total",
            ckpt_restore_failures_total.load(), &first);
+  AppendKV(&out, "drains_requested_total", drains_requested_total.load(),
+           &first);
   out.append("},\"gauges\":{");
   first = true;
   AppendKV(&out, "queue_depth", static_cast<double>(queue_depth.load()),
@@ -309,6 +316,7 @@ std::string Metrics::SnapshotJson() const {
            static_cast<double>(fusion_threshold_bytes.load()), &first);
   AppendKV(&out, "last_durable_step",
            static_cast<double>(last_durable_step.load()), &first);
+  AppendKV(&out, "draining", static_cast<double>(draining.load()), &first);
   out.append("},\"histograms\":{");
   first = true;
   AppendHistogram(&out, "cycle_seconds", cycle_seconds, &first);

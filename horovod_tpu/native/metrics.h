@@ -93,12 +93,18 @@ enum SummaryField : int {
   SUM_CKPT_WRITES,
   SUM_CKPT_WRITE_FAILURES,
   SUM_LAST_DURABLE_STEP,
-  // Wire compression (docs/COMPRESSION.md). Appended last; an older
-  // worker's summary simply lacks the tail and the job view / hvd-top
-  // render "-" for it instead of misaligning.
+  // Wire compression (docs/COMPRESSION.md). Appended after the durable
+  // fields; an older worker's summary simply lacks the tail and the job
+  // view / hvd-top render "-" for it instead of misaligning.
   SUM_COMPRESSION_BYTES_IN,
   SUM_COMPRESSION_BYTES_OUT,
   SUM_NET_RING_BYTES_SENT,
+  // Graceful drain (docs/FLEET.md). Appended last, same
+  // forward-compatibility rule: drain requests this worker honored and
+  // whether it is currently draining (1) / surviving a peer's drain (0)
+  // / has never seen one (-1).
+  SUM_DRAINS_REQUESTED,
+  SUM_DRAINING,
   SUM_FIELD_COUNT
 };
 const char* SummaryFieldName(int field);
@@ -164,6 +170,9 @@ class Metrics {
   std::atomic<uint64_t> ckpt_restores_total{0};        // successful restores
   std::atomic<uint64_t> ckpt_restore_failures_total{0};
 
+  // --- graceful drain (elastic/run.py via the C API; docs/FLEET.md) ---
+  std::atomic<uint64_t> drains_requested_total{0};  // agreed drain epochs
+
   // --- gauges (instantaneous; reset per generation) ---
   std::atomic<int64_t> queue_depth{0};
   std::atomic<int64_t> pending_negotiation{0};
@@ -175,6 +184,11 @@ class Metrics {
   // Deliberately survives Configure(): an elastic re-init does not
   // un-write a checkpoint.
   std::atomic<int64_t> last_durable_step{-1};
+  // Drain posture: -1 = never saw a drain, 1 = this worker is the
+  // victim of the current drain epoch (about to durable-commit and
+  // exit), 0 = it survived a peer's drain. Survives Configure() like
+  // last_durable_step — a post-drain re-init does not erase history.
+  std::atomic<int64_t> draining{-1};
 
   // --- histograms ---
   MetricHistogram cycle_seconds;        // background work-cycle duration

@@ -670,6 +670,18 @@ void horovod_tpu_ckpt_metrics(int64_t writes, int64_t failures,
   if (write_seconds >= 0.0) m.ckpt_write_seconds.Observe(write_seconds);
 }
 
+// Graceful-drain accounting (elastic/run.py's drain handler reports
+// through here; docs/FLEET.md). `requested` is a delta; `draining` is
+// the absolute posture gauge (1 = victim, 0 = survivor, < -1 is
+// ignored so callers can update one without the other). Relaxed
+// atomics — safe from any thread, any time.
+void horovod_tpu_drain_metrics(int64_t requested, int64_t draining) {
+  auto& m = GlobalMetrics();
+  if (requested > 0) m.drains_requested_total.fetch_add(
+      static_cast<uint64_t>(requested), std::memory_order_relaxed);
+  if (draining >= -1) m.draining.store(draining, std::memory_order_relaxed);
+}
+
 // This rank's collective call-sequence fingerprint: seq = number of
 // collectives enqueued since init, digest = rolling FNV-1a over each
 // call's (op, dtype, shape-rank, name). Ranks that executed identical

@@ -20,6 +20,7 @@ back, and failing hosts are blacklisted with exponential backoff.
 """
 
 import argparse
+import json
 import os
 import shlex
 import signal
@@ -29,6 +30,11 @@ import tempfile
 import time
 
 from . import rendezvous, util
+
+# Workers that honor a graceful drain exit with this code
+# (docs/FLEET.md) — the launcher must read it as a planned hand-back,
+# not a failure.
+from horovod_tpu.elastic.state import EXIT_DRAINED  # noqa: E402
 
 
 def check_build(out=sys.stdout):
@@ -158,6 +164,17 @@ def make_parser():
                              "newest durable checkpoint instead of "
                              "tearing the job down (bounded by "
                              "HVD_TPU_CKPT_MAX_RESTARTS, default 3)")
+    parser.add_argument("--drain-grace", type=float, default=None,
+                        metavar="SECONDS",
+                        help="graceful drain window (docs/FLEET.md): on "
+                             "SIGTERM the launcher publishes a drain "
+                             "request instead of killing — workers "
+                             "finish the in-flight step, force a "
+                             "durable commit, and exit cleanly (code "
+                             "83) — and only escalates to a hard tree "
+                             "kill after SECONDS. Needs the dynamic "
+                             "rendezvous KV (np > 1 without "
+                             "--start-port), or elastic mode")
     parser.add_argument("--ssh-port", type=int, default=None)
     parser.add_argument("--start-timeout", type=int, default=60,
                         help="seconds to wait for all ranks to connect")
@@ -401,8 +418,11 @@ def launch(slots, rank_envs, command, ssh_port=None, verbose=False):
 
 
 def run_command(np, hosts, command, start_port=0, ssh_port=None,
-                start_timeout=60, verbose=False, env=None):
-    """Programmatic entry: launch and wait; returns max exit code."""
+                start_timeout=60, verbose=False, env=None,
+                drain_grace=None):
+    """Programmatic entry: launch and wait; returns max exit code
+    (EXIT_DRAINED after a SIGTERM-driven graceful drain when
+    `drain_grace` is set)."""
     host_list = util.parse_hosts(hosts) if isinstance(hosts, str) else hosts
     slots = util.allocate_slots(host_list, np)
 
@@ -415,6 +435,11 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
 
     base_env = dict(env if env is not None else os.environ)
     base_env.setdefault("HVD_TPU_START_TIMEOUT", str(start_timeout))
+    if drain_grace:
+        # Rank-uniform drain-polling gate (elastic/run.py): set at spawn
+        # time for EVERY worker, so the per-commit agreement allreduce
+        # is enabled identically across the job.
+        base_env["HVD_TPU_DRAIN_ENABLE"] = "1"
 
     # Local slots must be advertised with an address the *other hosts*
     # can reach; 127.0.0.1 is only valid when every slot is local.
@@ -490,7 +515,20 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
     procs = launch(slots, rank_envs, command, ssh_port=ssh_port,
                    verbose=verbose)
 
+    # Graceful drain (docs/FLEET.md): a SIGTERM with --drain-grace set
+    # publishes a drain request on the rendezvous KV instead of killing
+    # — workers finish the in-flight step, force a durable commit, and
+    # exit EXIT_DRAINED; the launcher escalates to the middleman's
+    # kill_tree only after the grace window. Needs the KV server, so
+    # the static port table and np==1 fall back to the immediate kill.
+    drain = {"requested": False, "published_at": None,
+             "escalated": False}
+
     def kill_all(signum, frame):
+        if (signum == signal.SIGTERM and drain_grace
+                and server is not None and not drain["requested"]):
+            drain["requested"] = True
+            return  # the poll loop publishes and supervises the drain
         for p in procs:
             try:
                 os.killpg(os.getpgid(p.pid), signal.SIGTERM)
@@ -506,8 +544,35 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
         # summary names.
         exit_code = 0
         first_fail = None  # (slot, rc, log_path)
+        drained_ranks = []
         pending = set(range(len(procs)))
         while pending:
+            if drain["requested"] and drain["published_at"] is None:
+                from horovod_tpu.elastic.state import (KEY_DRAIN,
+                                                       SCOPE_ELASTIC)
+                server.put_local(SCOPE_ELASTIC, KEY_DRAIN, json.dumps({
+                    "epoch": 1, "workers": "all",
+                    "grace": drain_grace}))
+                drain["published_at"] = time.monotonic()
+                sys.stderr.write(
+                    "[launcher] SIGTERM: drain requested (grace %.0fs); "
+                    "workers will durable-commit and exit\n"
+                    % drain_grace)
+            if (drain["published_at"] is not None
+                    and not drain["escalated"]
+                    and time.monotonic() - drain["published_at"]
+                    > drain_grace):
+                drain["escalated"] = True
+                sys.stderr.write(
+                    "[launcher] drain grace expired; escalating to "
+                    "kill_tree for %d remaining worker(s)\n"
+                    % sum(1 for p in procs if p.poll() is None))
+                for q in procs:
+                    if q.poll() is None:
+                        try:
+                            os.killpg(os.getpgid(q.pid), signal.SIGTERM)
+                        except (ProcessLookupError, PermissionError):
+                            pass
             progressed = False
             for i in sorted(pending):
                 rc = procs[i].poll()
@@ -515,12 +580,22 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
                     continue
                 pending.discard(i)
                 progressed = True
-                if rc != 0:
-                    exit_code = max(exit_code, rc if rc > 0 else 1)
-                    if first_fail is None:
-                        first_fail = (slots[i], rc, log_paths[i])
+                if rc == 0:
+                    continue
+                if drain["requested"] and (
+                        rc == EXIT_DRAINED or drain["escalated"]):
+                    # Voluntary exit under an active drain (or the
+                    # launcher's own escalation kill): planned, not a
+                    # failure.
+                    drained_ranks.append(slots[i].rank)
+                    continue
+                exit_code = max(exit_code, rc if rc > 0 else 1)
+                if first_fail is None:
+                    first_fail = (slots[i], rc, log_paths[i])
+                    if not drain["requested"]:
                         # One failed rank: tear down the rest (they
-                        # would hang in negotiation otherwise).
+                        # would hang in negotiation otherwise). Under a
+                        # drain the peers are already on their way out.
                         for q in procs:
                             if q.poll() is None:
                                 try:
@@ -531,6 +606,24 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
                                     pass
             if pending and not progressed:
                 time.sleep(0.05)
+        if drain["requested"] and exit_code == 0:
+            sys.stderr.write(
+                "[launcher] drain complete: %d worker(s) exited "
+                "cleanly under the drain%s\n"
+                % (len(drained_ranks),
+                   " (after escalation)" if drain["escalated"] else ""))
+            ckpt_dir = os.environ.get("HVD_TPU_CKPT_DIR")
+            if ckpt_dir:
+                from horovod_tpu.elastic.durable import \
+                    describe_last_durable
+                sys.stderr.write(
+                    "[launcher] %s\n" % describe_last_durable(ckpt_dir))
+            if drained_ranks:
+                # EXIT_DRAINED (not 0) so a supervisor can tell a
+                # preempted job from a completed one; ranks that
+                # finished before the drain landed still count as a
+                # completed job.
+                return EXIT_DRAINED
         if first_fail is not None:
             slot, rc, log_path = first_fail
             where = ("" if util.is_local_host(slot.hostname)
@@ -673,7 +766,8 @@ def main(argv=None):
                            start_timeout=args.start_timeout,
                            verbose=args.verbose,
                            ckpt_dir=os.environ.get("HVD_TPU_CKPT_DIR"),
-                           restart_from_ckpt=args.restart_from_ckpt)
+                           restart_from_ckpt=args.restart_from_ckpt,
+                           drain_grace=args.drain_grace)
     if args.restart_from_ckpt:
         parser.error("--restart-from-ckpt needs elastic mode (give "
                      "--min-np / --max-np / --host-discovery-script); "
@@ -681,9 +775,14 @@ def main(argv=None):
                      "the job")
     if args.num_proc is None:
         parser.error("-np is required")
+    if args.drain_grace and args.start_port:
+        parser.error("--drain-grace needs the dynamic rendezvous KV to "
+                     "publish the drain request; it is incompatible "
+                     "with --start-port's static port table")
     return run_command(args.num_proc, hosts, command,
                        start_port=args.start_port, ssh_port=args.ssh_port,
-                       start_timeout=args.start_timeout, verbose=args.verbose)
+                       start_timeout=args.start_timeout, verbose=args.verbose,
+                       drain_grace=args.drain_grace)
 
 
 if __name__ == "__main__":

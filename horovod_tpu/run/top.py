@@ -98,6 +98,9 @@ _COLUMNS = [
     # disk (-1 = durability off / nothing written yet; '-' = the worker
     # predates the field) — docs/ELASTIC.md.
     ("dur", 7, _int_field("last_durable_step")),
+    # Graceful drain (docs/FLEET.md): drain requests this worker agreed
+    # to honor (victims force a durable commit then exit EXIT_DRAINED).
+    ("drn", 5, _int_field("drains_requested_total")),
     # Wire compression factor (codec bytes in / wire bytes out).
     ("cmp", 6, _cmp_ratio),
     ("lag_s", 9, lambda cur, prev, dt, ctx: "%.2f" % ctx["lag_total"]),
@@ -163,21 +166,106 @@ def render(job, prev_job, dt, endpoint):
     return "\n".join(lines)
 
 
+def fetch_fleet(endpoint, timeout=5):
+    url = endpoint
+    if not url.startswith("http"):
+        url = "http://" + url
+    if not url.rstrip("/").endswith("/fleet"):
+        url = url.rstrip("/") + "/fleet"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+# Fleet column schema, same tolerance rule as _COLUMNS: a missing field
+# renders '-' instead of shifting the row (docs/FLEET.md).
+_FLEET_COLUMNS = [
+    ("state", 10, lambda j: str(j.get("state", "-"))),
+    ("prio", 5, lambda j: "%d" % j.get("priority", 0)),
+    ("live", 5, lambda j: "%d" % j.get("live", 0)),
+    ("want", 5, lambda j: "%d" % j.get("np", 0)),
+    ("min", 4, lambda j: "%d" % j.get("min_np", 0)),
+    ("leased", 7, lambda j: "%d" % j.get("leased", 0)),
+    ("drains", 7, lambda j: "%d" % j.get("drains", 0)),
+    ("preempt", 8, lambda j: "%d" % j.get("preemptions", 0)),
+    ("restore", 8, lambda j: "%d" % j.get("restores", 0)),
+    ("restart", 8, lambda j: "%d" % j.get("restarts", 0)),
+    ("dur", 6, lambda j: "-" if j.get("last_durable_step") is None
+     else "%d" % j["last_durable_step"]),
+    ("age_s", 8, lambda j: "-" if j.get("age_seconds") is None
+     else "%.1f" % j["age_seconds"]),
+]
+
+
+def render_fleet(fleet, endpoint):
+    """One frame of the cross-job view: per-job table + host states +
+    the drain/preemption counters (docs/FLEET.md)."""
+    jobs = fleet.get("jobs") or {}
+    hosts = fleet.get("hosts") or {}
+    counters = fleet.get("counters") or {}
+    lines = ["hvd-fleet — %s — t=%.0fs — %d job(s), %d free slot(s) — %s"
+             % (endpoint, float(fleet.get("t", 0.0)), len(jobs),
+                int(fleet.get("free_slots", 0)),
+                time.strftime("%H:%M:%S"))]
+    width = max([len(n) for n in jobs] + [4])
+    header = "%-*s " % (width, "job") + " ".join(
+        "%*s" % (w, name) for name, w, _ in _FLEET_COLUMNS)
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name in sorted(jobs):
+        j = jobs[name]
+        lines.append("%-*s " % (width, name) + " ".join(
+            "%*s" % (w, fn(j)) for _, w, fn in _FLEET_COLUMNS))
+    by_state = {}
+    for h in hosts.values():
+        by_state[h.get("state", "?")] = by_state.get(
+            h.get("state", "?"), 0) + 1
+    lines.append("hosts: " + (", ".join(
+        "%d %s" % (n, s) for s, n in sorted(by_state.items()))
+        or "none discovered"))
+    lines.append(
+        "fleet: %d admitted, %d preempted, %d restored, %d drain(s), "
+        "%d kill(s) injected, %d oversubscription refusal(s), "
+        "%d occupancy violation(s)"
+        % (counters.get("fleet_admissions_total", 0),
+           counters.get("fleet_preemptions_total", 0),
+           counters.get("fleet_restores_total", 0),
+           counters.get("fleet_drains_requested_total", 0),
+           counters.get("fleet_kills_injected_total", 0),
+           counters.get("fleet_oversubscription_refusals_total", 0),
+           counters.get("fleet_occupancy_violations_total", 0)))
+    draining = sorted(n for n, j in jobs.items()
+                      if j.get("state") == "draining")
+    if draining:
+        lines.append("! draining: %s (durable-committing, then hosts "
+                     "return to the pool)" % ", ".join(draining))
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="hvd-top",
         description="Live per-rank view of a horovod_tpu job's metrics "
-                    "plane (poll rank 0's /job endpoint).")
+                    "plane (poll rank 0's /job endpoint), or — with "
+                    "--fleet — the cross-job view of a fleet "
+                    "controller's /fleet endpoint.")
     ap.add_argument("endpoint", nargs="?", default="localhost:9400",
                     help="coordinator metrics endpoint: host:port, URL, "
                          "or the --metrics-port base (rank 0 serves the "
-                         "job view there). Default: localhost:9400")
+                         "job view there). With --fleet: the hvd-fleet "
+                         "--port endpoint. Default: localhost:9400")
+    ap.add_argument("--fleet", action="store_true",
+                    help="cross-job fleet view: poll a fleet "
+                         "controller's /fleet endpoint instead of a "
+                         "job's /job endpoint (docs/FLEET.md)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="poll interval seconds (default 2)")
     ap.add_argument("--once", action="store_true",
                     help="print one snapshot and exit (no screen "
                          "clearing; for scripts/tests)")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        return _fleet_loop(args)
 
     prev_job, prev_t = None, None
     try:
@@ -211,6 +299,30 @@ def main(argv=None):
             sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
             sys.stdout.flush()
             prev_job, prev_t = job, now
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _fleet_loop(args):
+    try:
+        while True:
+            try:
+                fleet = fetch_fleet(args.endpoint)
+            except Exception as e:
+                msg = "hvd-top: cannot reach fleet at %s: %s" % (
+                    args.endpoint, e)
+                print(msg, file=sys.stderr)
+                if args.once:
+                    return 1
+                time.sleep(args.interval)
+                continue
+            frame = render_fleet(fleet, args.endpoint)
+            if args.once:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
             time.sleep(args.interval)
     except KeyboardInterrupt:
         return 0

@@ -1,0 +1,62 @@
+"""Emits the seeded fleet-churn jobfile the sanitizer smokes run
+(`make check-tsan` / `check-asan` in native/Makefile; docs/FLEET.md).
+
+Two jobs on a localhost:4 pool — `hi` (priority 5) and `lo` (priority
+0) — whose workers the Makefile's HVD_TPU_FLEET_CHAOS_SPEC then churns
+with a seeded SIGKILL and a forced preemption of `lo`, driving the
+crash-recovery AND drain/restore paths through the sanitized native
+core. The fleet must finish rc 0 with every job completed.
+
+Usage::
+
+    python tests/fleet_churn_jobfile.py BASE_DIR [PRELOAD ENV...]
+
+``BASE_DIR`` holds the per-job checkpoint dirs. When ``PRELOAD`` (a
+sanitizer runtime .so) is given, the worker command is prefixed with
+``env LD_PRELOAD=PRELOAD ENV...`` — the sanitizer must be preloaded
+into the WORKER python only (the controller process forks; see the
+Makefile's launch notes), exactly like the other sanitizer runs.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    if len(sys.argv) < 2:
+        sys.stderr.write(__doc__)
+        return 2
+    base = os.path.abspath(sys.argv[1])
+    preload = sys.argv[2] if len(sys.argv) > 2 else ""
+    extra_env = sys.argv[3:]
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "fleet_worker.py")
+    command = []
+    if preload:
+        command += ["env", "LD_PRELOAD=%s" % preload]
+        command += list(extra_env)
+        command += ["HVD_TPU_METRICS=1"]
+    command += [sys.executable, worker]
+
+    def job(name, priority, steps, np_=2, min_np=1):
+        return {
+            "name": name, "command": command, "np": np_,
+            "min_np": min_np, "priority": priority,
+            "ckpt_dir": os.path.join(base, "ckpt-%s" % name),
+            "env": {"FLEET_TEST_JOB": name,
+                    "FLEET_TEST_TOTAL_STEPS": str(steps),
+                    "FLEET_TEST_STEP_SLEEP": "0.15"},
+        }
+
+    print(json.dumps({
+        "hosts": "localhost:4",
+        "drain_grace": 60,
+        "jobs": [job("hi", priority=5, steps=25),
+                 job("lo", priority=0, steps=60)],
+    }, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

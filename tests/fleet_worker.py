@@ -1,0 +1,81 @@
+"""Fleet e2e worker: deterministic quadratic training with durable
+commits, shared by the fleet chaos / drain-durability tests
+(docs/FLEET.md).
+
+Runs under a fleet-controller-owned elastic driver (or plain
+``horovodrun_tpu``). Every commit prints a CRC32C fingerprint of the
+full state and the first line inside ``train()`` prints the state the
+(re)entry STARTED from, so tests can assert a preempted/killed job
+resumes bitwise-identically to a state it committed earlier — the
+checkpoint-lineage invariant.
+
+Knobs (env):
+  FLEET_TEST_JOB          job name echoed in every line   (default "?")
+  FLEET_TEST_TOTAL_STEPS  total optimization steps        (default 30)
+  FLEET_TEST_COMMIT_EVERY commit cadence in steps         (default 1)
+  FLEET_TEST_STEP_SLEEP   per-step sleep seconds          (default 0.1)
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.elastic import durable
+
+JOB = os.environ.get("FLEET_TEST_JOB", "?")
+TOTAL_STEPS = int(os.environ.get("FLEET_TEST_TOTAL_STEPS", "30"))
+COMMIT_EVERY = int(os.environ.get("FLEET_TEST_COMMIT_EVERY", "1"))
+STEP_SLEEP = float(os.environ.get("FLEET_TEST_STEP_SLEEP", "0.1"))
+LR = 0.05
+TARGET = 3.0
+
+WID = os.environ.get("HVD_TPU_WORKER_ID", "?")
+
+
+def state_crc(state):
+    crc = durable.crc32c(np.ascontiguousarray(state.w).tobytes())
+    return durable.crc32c(("step=%d" % state.step).encode(), crc)
+
+
+@elastic.run
+def train(state):
+    print("job %s worker %s start step %d crc %08x size %d"
+          % (JOB, WID, state.step, state_crc(state), hvd.size()),
+          flush=True)
+    while state.step < TOTAL_STEPS:
+        grad_local = 2.0 * (state.w - TARGET)
+        grad = np.asarray(hvd.allreduce(grad_local, "grad", average=True))
+        state.w = state.w - LR * grad
+        state.step += 1
+        if state.step % COMMIT_EVERY == 0:
+            # Print BEFORE commit(): commit saves the snapshot first and
+            # only then checks for drain/membership interrupts, so the
+            # printed crc is exactly the state any rollback, durable
+            # force-write, or resume must reproduce — even when commit()
+            # raises and the line after it would never run.
+            print("job %s worker %s commit step %d crc %08x"
+                  % (JOB, WID, state.step, state_crc(state)), flush=True)
+            state.commit()
+        time.sleep(STEP_SLEEP)
+    return float(np.sum((state.w - TARGET) ** 2))
+
+
+def main():
+    state = elastic.ElasticState(w=np.zeros(4, np.float64), step=0)
+    final_loss = train(state)
+    if final_loss is None:  # job finished before this worker could join
+        print("job %s worker %s superseded (job already complete)"
+              % (JOB, WID), flush=True)
+        return 0
+    print("job %s worker %s done step %d crc %08x loss %.6f"
+          % (JOB, WID, state.step, state_crc(state), final_loss),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

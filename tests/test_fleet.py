@@ -1,0 +1,714 @@
+"""hvd-fleet tests (ISSUE 7; docs/FLEET.md).
+
+Unit layer: the shared placement library (plan_spawns + PlacementPool
+lease ledger), voluntary-release vs failure-blacklist semantics, the
+fleet chaos grammar, fleet metrics rendering, and the controller's
+admission / preemption / grow planning against fake drivers.
+
+E2E layer: ``--drain-grace`` SIGTERM drains a static job through a
+durable commit of exactly the drained step (resume verified at equal
+AND smaller world size); a fleet preemption drains, reclaims, and
+restores a job observably (/fleet + hvd-top --fleet + fleet_*
+counters); and the seeded chaos schedule (arrivals + SIGKILLs +
+preemption over 3 concurrent jobs) upholds the lineage invariant:
+every job completes or resumes bitwise-consistently with a state it
+committed, and no host is ever oversubscribed.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+from horovod_tpu.elastic.state import EXIT_DRAINED
+from horovod_tpu.fleet.chaos import FleetChaos, FleetChaosError
+from horovod_tpu.fleet.controller import (DRAINING, PENDING, RUNNING,
+                                          FleetController, JobSpec)
+from horovod_tpu.fleet.metrics import FleetMetrics, render_prometheus
+from horovod_tpu.fleet.placement import PlacementPool, plan_spawns
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Placement library
+
+def test_plan_spawns_fills_free_slots_in_sorted_host_order():
+    plan = plan_spawns({"b": 2, "a": 2}, {"a": 1}, room=10)
+    assert plan == ["a", "b", "b"]
+
+
+def test_plan_spawns_respects_room_and_zero():
+    assert plan_spawns({"a": 4}, {}, room=2) == ["a", "a"]
+    assert plan_spawns({"a": 4}, {}, room=0) == []
+    assert plan_spawns({}, {}, room=3) == []
+
+
+def test_plan_spawns_ignores_overfull_hosts():
+    # More live workers than slots (mid-drain overlap) must not
+    # produce a negative contribution.
+    assert plan_spawns({"a": 1, "b": 1}, {"a": 3}, room=2) == ["b"]
+
+
+def test_pool_gang_lease_all_or_nothing():
+    pool = PlacementPool(FixedHosts({"a": 2, "b": 2}))
+    pool.refresh()
+    assert pool.free_slots() == 4
+    grant = pool.lease("j1", 3)
+    assert sum(grant.values()) == 3
+    # j2 wants a gang of 2 but only 1 slot is free: NOTHING is leased.
+    assert pool.lease("j2", 2) == {}
+    assert pool.free_slots() == 1
+    # min_slots relaxes the gang: 1 of 2 is acceptable.
+    assert sum(pool.lease("j2", 2, min_slots=1).values()) == 1
+    assert pool.free_slots() == 0
+
+
+def test_pool_release_reenters_immediately():
+    pool = PlacementPool(FixedHosts({"a": 2}))
+    pool.refresh()
+    pool.lease("j1", 2)
+    assert pool.free_slots() == 0
+    pool.release("j1", "a", 1)
+    assert pool.free_slots() == 1  # no cooldown on voluntary release
+    pool.release("j1")
+    assert pool.free_slots() == 2
+    assert pool.lease_of("j1") == {}
+
+
+def test_pool_refuses_oversubscription():
+    pool = PlacementPool(FixedHosts({"a": 2}))
+    pool.refresh()
+    assert sum(pool.lease("j1", 2).values()) == 2
+    assert pool.lease("j2", 1) == {}
+    assert pool.leased_slots_of("j2") == 0
+
+
+def test_pool_occupancy_invariant_uses_raw_inventory():
+    pool = PlacementPool(FixedHosts({"a": 2, "b": 1}))
+    pool.refresh()
+    assert pool.check_occupancy({"j1": {"a": 2}, "j2": {"b": 1}}) == []
+    assert pool.check_occupancy({"j1": {"a": 2}, "j2": {"a": 1}}) == ["a"]
+    # Blacklisting a host must not turn its still-draining workers into
+    # a false violation: capacity reference is the RAW inventory.
+    pool.record_failure("a")
+    assert pool.check_occupancy({"j1": {"a": 2}}) == []
+
+
+def test_pool_host_states():
+    pool = PlacementPool(FixedHosts({"a": 2, "b": 2, "c": 1}))
+    pool.refresh()
+    pool.lease("j1", 2)  # lands on "a" (sorted order)
+    pool.record_failure("c")
+    states = pool.host_states()
+    assert states["a"]["state"] == "leased"
+    assert states["a"]["by_job"] == {"j1": 2}
+    assert states["b"]["state"] == "free"
+    assert states["c"]["state"] == "blacklisted"
+
+
+# ---------------------------------------------------------------------------
+# Voluntary release vs failure blacklist (satellite fix)
+
+def test_record_release_never_blacklists():
+    mgr = HostManager(FixedHosts({"a": 2}), cooldown=10.0,
+                      clock=lambda: 100.0)
+    mgr.refresh()
+    mgr.record_release("a")
+    assert not mgr.is_blacklisted("a")
+    assert mgr.available_hosts_and_slots() == {"a": 2}
+
+
+def test_record_release_keeps_existing_failure_streak():
+    clock = {"t": 0.0}
+    mgr = HostManager(FixedHosts({"a": 1}), cooldown=10.0,
+                      clock=lambda: clock["t"])
+    mgr.refresh()
+    mgr.record_failure("a")
+    assert mgr.is_blacklisted("a")
+    # A planned drain on a flaky host must not launder the blacklist.
+    mgr.record_release("a")
+    assert mgr.is_blacklisted("a")
+    clock["t"] = 5.0
+    mgr.record_failure("a")  # second consecutive failure: 2x backoff
+    assert mgr.blacklisted_until("a") == pytest.approx(25.0)
+
+
+# ---------------------------------------------------------------------------
+# Chaos grammar
+
+def test_chaos_spec_parse():
+    c = FleetChaos("seed=7;job=b,at=3,action=arrive;"
+                   "job=a,at=5,action=kill,count=2,every=2;"
+                   "at=8,action=preempt")
+    assert c.seed == 7
+    assert c.arrival_override("b") == 3.0
+    assert c.arrival_override("a") is None
+    assert [e.action for e in c.due(5.9)] == ["kill"]
+    assert [e.action for e in c.due(8.5)] == ["kill", "preempt"]
+    assert c.due(100.0) == []  # counts exhausted
+
+
+def test_chaos_pick_is_seed_deterministic():
+    picks1 = [FleetChaos("seed=3;at=0,action=kill").pick(["a", "b", "c"])
+              for _ in range(1)]
+    picks2 = [FleetChaos("seed=3;at=0,action=kill").pick(["c", "b", "a"])
+              for _ in range(1)]
+    assert picks1 == picks2  # candidates sorted; same seed, same pick
+
+
+@pytest.mark.parametrize("spec", [
+    "garbage",
+    "action=explode",
+    "seed=x",
+    "job=a",  # no action
+    "at=-1,action=kill",
+    "action=kill,count=0",
+    "action=arrive",  # arrive needs an explicit job
+    "action=kill,frobnicate=1",
+])
+def test_chaos_spec_rejects_garbage(spec):
+    with pytest.raises(FleetChaosError):
+        FleetChaos(spec)
+
+
+# ---------------------------------------------------------------------------
+# Fleet metrics
+
+def test_fleet_metrics_snapshot_and_prometheus():
+    m = FleetMetrics()
+    m.inc("fleet_admissions_total")
+    m.inc("fleet_preemptions_total", 2)
+    m.set_gauge("fleet_jobs_running", 3)
+    m.observe("fleet_drain_seconds", 0.7)
+    snap = m.snapshot()
+    assert snap["counters"]["fleet_admissions_total"] == 1
+    assert snap["counters"]["fleet_preemptions_total"] == 2
+    assert snap["gauges"]["fleet_jobs_running"] == 3
+    h = snap["histograms"]["fleet_drain_seconds"]
+    assert h["count"] == 1 and h["sum"] == pytest.approx(0.7)
+    text = render_prometheus(m)
+    assert "hvdtpu_fleet_admissions_total 1" in text
+    assert "hvdtpu_fleet_drain_seconds_bucket" in text
+    assert 'le="+Inf"' in text
+
+
+# ---------------------------------------------------------------------------
+# JobSpec / controller planning (fake drivers, no processes)
+
+def test_jobspec_validation():
+    with pytest.raises(ValueError):
+        JobSpec("j", ["x"], np=1, min_np=2)
+    with pytest.raises(ValueError):
+        JobSpec.from_dict({"name": "j", "command": "x", "np": 1,
+                           "bogus": True})
+    spec = JobSpec.from_dict({"name": "j", "command": "python t.py",
+                              "np": 2})
+    assert spec.command == ["python", "t.py"]
+    assert spec.max_np == 2
+
+
+class _FakeDriver:
+    """Controller-facing surface of ElasticDriver, slot-accurate."""
+
+    def __init__(self, pool, job_name, np_now):
+        self._pool = pool
+        self._job = job_name
+        self._wids = list(range(np_now))
+        self.max_np = np_now
+        self.drain_requests = []
+        self._draining = False
+
+    def live_per_host(self):
+        out, left = {}, len(self._wids)
+        for host, slots in sorted(self._pool.lease_of(self._job).items()):
+            take = min(slots, left)
+            if take:
+                out[host] = take
+                left -= take
+        return out
+
+    def live_workers(self):
+        return sorted(self._wids)
+
+    def worker_pid(self, wid):
+        return None
+
+    def resize(self, max_np):
+        self.max_np = max_np
+
+    def request_drain(self, victims, grace=None):
+        self.drain_requests.append((victims, grace))
+        if victims == "all":
+            self._wids = []
+        else:
+            self._wids = [w for w in self._wids
+                          if str(w) not in [str(v) for v in victims]]
+        self._draining = False  # fake: drain completes instantly
+
+    def draining(self):
+        return self._draining
+
+    def terminate(self):
+        pass
+
+
+def _fake_controller(hosts, monkeypatch):
+    controller = FleetController(FixedHosts(hosts))
+    controller._start = time.monotonic()
+    controller.pool.refresh()
+
+    def fake_start(job, granted):
+        job.driver = _FakeDriver(controller.pool, job.name,
+                                 sum(granted.values()))
+
+    monkeypatch.setattr(controller, "_start_driver", fake_start)
+    return controller
+
+
+def test_gang_admission_and_backoff(monkeypatch):
+    controller = _fake_controller({"h": 4}, monkeypatch)
+    a = controller.submit(JobSpec("a", ["x"], np=3, min_np=3))
+    b = controller.submit(JobSpec("b", ["x"], np=2, min_np=2))
+    now = time.monotonic()
+    assert controller._try_admit(a, now)
+    assert a.state == RUNNING
+    assert controller.pool.leased_slots_of("a") == 3
+    # b's gang of 2 cannot fit into the single free slot: nothing
+    # leased, backoff armed, retry counter bumped.
+    assert not controller._try_admit(b, now)
+    assert b.state == PENDING
+    assert controller.pool.leased_slots_of("b") == 0
+    assert b.next_try > now
+    assert controller.metrics.get("fleet_admission_retries_total") == 1
+
+
+def test_preemption_prefers_shrink_over_kill(monkeypatch):
+    controller = _fake_controller({"h": 4}, monkeypatch)
+    a = controller.submit(JobSpec("a", ["x"], np=4, min_np=1,
+                                  priority=0))
+    b = controller.submit(JobSpec("b", ["x"], np=2, min_np=2,
+                                  priority=5))
+    now = time.monotonic()
+    assert controller._try_admit(a, now)
+    assert controller._preempt_for(b)
+    # a was SHRUNK (drain of its 2 youngest workers), not killed.
+    assert a.state == RUNNING
+    assert a.driver.drain_requests[0][0] == [2, 3]
+    assert a.driver.max_np == 2
+    # The fake drain completed instantly; reconciliation frees slots.
+    controller._finish_shrinks(time.monotonic())
+    assert controller.pool.free_slots() == 2
+    assert controller._try_admit(b, time.monotonic())
+    assert controller.metrics.get("fleet_shrinks_total") == 1
+
+
+def test_preemption_full_when_shrink_cannot_cover(monkeypatch):
+    controller = _fake_controller({"h": 2}, monkeypatch)
+    a = controller.submit(JobSpec("a", ["x"], np=2, min_np=2,
+                                  priority=0))
+    b = controller.submit(JobSpec("b", ["x"], np=2, min_np=2,
+                                  priority=5))
+    now = time.monotonic()
+    assert controller._try_admit(a, now)
+    assert controller._preempt_for(b)
+    assert a.state == DRAINING
+    assert a.driver.drain_requests[0][0] == "all"
+
+
+def test_no_preemption_of_equal_or_higher_priority(monkeypatch):
+    controller = _fake_controller({"h": 2}, monkeypatch)
+    a = controller.submit(JobSpec("a", ["x"], np=2, min_np=1,
+                                  priority=5))
+    b = controller.submit(JobSpec("b", ["x"], np=2, min_np=1,
+                                  priority=5))
+    now = time.monotonic()
+    assert controller._try_admit(a, now)
+    assert not controller._preempt_for(b)
+    assert a.state == RUNNING and not a.driver.drain_requests
+
+
+def test_no_grow_while_higher_priority_waits(monkeypatch):
+    controller = _fake_controller({"h": 4}, monkeypatch)
+    a = controller.submit(JobSpec("a", ["x"], np=4, min_np=1,
+                                  priority=0))
+    b = controller.submit(JobSpec("b", ["x"], np=4, min_np=4,
+                                  priority=5))
+    now = time.monotonic()
+    assert controller._try_admit(a, now)
+    controller._shrink(a, 1, b)
+    controller._finish_shrinks(time.monotonic())
+    assert controller.pool.free_slots() == 3
+    # b (min_np=4) still cannot fit, but it outranks a: a must NOT eat
+    # the free slots back while b waits.
+    controller._grow_running(time.monotonic())
+    assert controller.pool.leased_slots_of("a") == 1
+    # Once b is gone (failed/done), a grows back toward max_np.
+    b.state = "failed"
+    controller._grow_running(time.monotonic())
+    assert controller.pool.leased_slots_of("a") == 4
+    assert a.driver.max_np == 4
+    assert controller.metrics.get("fleet_grows_total") == 3
+
+
+def test_reap_mid_shrink_clears_stale_shrink_state(monkeypatch):
+    # A job that dies (or is fully drained) while a partial shrink is
+    # still pending must not carry shrink_target into its next
+    # incarnation: a stale target would make _finish_shrinks release
+    # slots freshly leased to the restarted driver.
+    controller = _fake_controller({"h": 4}, monkeypatch)
+    a = controller.submit(JobSpec("a", ["x"], np=4, min_np=1,
+                                  priority=0, max_restarts=1))
+    b = controller.submit(JobSpec("b", ["x"], np=2, min_np=2,
+                                  priority=5))
+    now = time.monotonic()
+    assert controller._try_admit(a, now)
+    controller._shrink(a, 2, b)
+    assert a.shrink_target == 2 and a.drain_started is not None
+    # a dies mid-shrink (driver thread finished with rc=1).
+    a.rc = 1
+    a.thread = type("T", (), {"join": lambda self, timeout=None: None,
+                              "is_alive": lambda self: False})()
+    controller._reap_job(a, time.monotonic())
+    assert a.state == PENDING and a.restarts == 1
+    assert a.shrink_target is None and a.drain_started is None
+    # Restarted at full size: _finish_shrinks must not steal the fresh
+    # lease out from under the new driver.
+    assert controller._try_admit(a, time.monotonic())
+    controller._finish_shrinks(time.monotonic())
+    assert controller.pool.leased_slots_of("a") == 4
+    assert controller.metrics.get("fleet_shrinks_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# E2E helpers
+
+LOG_COMMIT = re.compile(
+    r"job (\S+) worker (\S+) commit step (\d+) crc ([0-9a-f]{8})")
+LOG_START = re.compile(
+    r"job (\S+) worker (\S+) start step (\d+) crc ([0-9a-f]{8}) size (\d+)")
+LOG_DONE = re.compile(
+    r"job (\S+) worker (\S+) done step (\d+) crc ([0-9a-f]{8})")
+
+
+def _fleet_env(extra=None):
+    from tests.conftest import clean_worker_env
+    env = clean_worker_env(extra)
+    return env
+
+
+def _wait_for(predicate, timeout, what, poll=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(poll)
+    raise AssertionError("timed out after %ss waiting for %s"
+                         % (timeout, what))
+
+
+def _read(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+def assert_lineage_consistent(out):
+    """The chaos/restore invariant: every (re)entry at step > 0 must
+    carry the crc of a state that job COMMITTED earlier — bitwise
+    consistency with the checkpoint lineage."""
+    committed = {}  # (job, step) -> set of crcs
+    checked = 0
+    for line in out.splitlines():
+        m = LOG_COMMIT.search(line)
+        if m:
+            committed.setdefault((m.group(1), int(m.group(3))),
+                                 set()).add(m.group(4))
+            continue
+        m = LOG_START.search(line)
+        if m and int(m.group(3)) > 0:
+            job, step, crc = m.group(1), int(m.group(3)), m.group(4)
+            assert crc in committed.get((job, step), set()), (
+                "job %s resumed at step %d with crc %s, which was "
+                "never committed (lineage: %s)"
+                % (job, step, crc,
+                   sorted(k for k in committed if k[0] == job)))
+            checked += 1
+    return checked
+
+
+# ---------------------------------------------------------------------------
+# E2E: --drain-grace SIGTERM drains through a durable commit of the
+# drained step, and the job resumes from it at equal AND smaller size
+# (satellites 2 + 3)
+
+@pytest.mark.e2e
+def test_drain_grace_durable_commit_and_resume(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    log = str(tmp_path / "out.log")
+    env = _fleet_env({
+        "HVD_TPU_CKPT_DIR": ckpt,
+        # Sparse durable cadence: only the very first commit would be
+        # durable on its own, so the manifest for the DRAINED step can
+        # only exist if the drain force-wrote it (not an older sticky
+        # anchor).
+        "HVD_TPU_CKPT_EVERY_N_COMMITS": "1000",
+        "FLEET_TEST_JOB": "s",
+        "FLEET_TEST_TOTAL_STEPS": "500",
+        "FLEET_TEST_STEP_SLEEP": "0.1",
+    })
+    with open(log, "w") as logf:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.run.run", "-np", "2",
+             "--drain-grace", "30", "--",
+             sys.executable,
+             os.path.join(REPO_ROOT, "tests", "fleet_worker.py")],
+            env=env, stdout=logf, stderr=subprocess.STDOUT,
+            start_new_session=True)
+    try:
+        _wait_for(
+            lambda: len(LOG_COMMIT.findall(_read(log))) >= 10,
+            timeout=90, what="10 commits before the drain")
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=90)
+    finally:
+        if proc.poll() is None:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            proc.wait(timeout=10)
+    out = _read(log)
+    assert rc == EXIT_DRAINED, (rc, out)
+    assert "drain requested" in out
+    assert "exiting with EXIT_DRAINED" in out
+    # Escalation must NOT have fired: the workers drained voluntarily.
+    assert "escalating" not in out
+
+    from horovod_tpu.elastic.durable import last_durable_step
+    drained_step, _ = last_durable_step(ckpt)
+    commits = [(int(s), c) for _, _, s, c in LOG_COMMIT.findall(out)]
+    max_commit = max(s for s, _ in commits)
+    # The durable manifest is for the DRAINED step — the step the
+    # workers were at when the drain landed — not the step-1 anchor the
+    # sparse cadence would have left behind.
+    assert drained_step == max_commit, (drained_step, max_commit)
+    drained_crcs = {c for s, c in commits if s == drained_step}
+
+    # Resume at EQUAL world size (2) and SMALLER world size (1): both
+    # start bitwise-identically from the drained commit. Each resume
+    # gets a pristine copy of the drained lineage — a resumed run
+    # writes its own fresh durable anchor, which would otherwise leak
+    # into the next resume's view.
+    import shutil
+    for np_resume in (2, 1):
+        ckpt_copy = str(tmp_path / ("ckpt-resume-%d" % np_resume))
+        shutil.copytree(ckpt, ckpt_copy)
+        resume_env = dict(env)
+        resume_env["HVD_TPU_CKPT_DIR"] = ckpt_copy
+        resume_env["FLEET_TEST_TOTAL_STEPS"] = str(drained_step + 3)
+        result = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.run.run",
+             "-np", str(np_resume), "--",
+             sys.executable,
+             os.path.join(REPO_ROOT, "tests", "fleet_worker.py")],
+            env=resume_env, timeout=120, capture_output=True, text=True)
+        assert result.returncode == 0, (np_resume, result.stdout,
+                                        result.stderr)
+        starts = LOG_START.findall(result.stdout)
+        assert starts, result.stdout
+        for _, _, step, crc, size in starts:
+            assert int(step) == drained_step, (np_resume, starts)
+            assert crc in drained_crcs, (np_resume, starts, drained_crcs)
+            assert int(size) == np_resume
+
+
+# ---------------------------------------------------------------------------
+# E2E: fleet preemption — drain, reclaim, restore, all observable
+# (tentpole acceptance: /fleet + hvd-top --fleet + fleet_* metrics)
+
+@pytest.mark.e2e
+def test_fleet_preempt_reclaim_restore_observable(tmp_path):
+    jobfile = {
+        "hosts": "localhost:2",
+        "drain_grace": 30,
+        "jobs": [
+            # min_np == np == pool size: the only way to fit "hi" is a
+            # WHOLE-JOB preemption of "lo", and the only way to finish
+            # "lo" afterwards is a full restore from its lineage.
+            {"name": "lo", "command":
+                "%s %s" % (sys.executable,
+                           os.path.join(REPO_ROOT, "tests",
+                                        "fleet_worker.py")),
+             "np": 2, "min_np": 2, "priority": 0,
+             "ckpt_dir": str(tmp_path / "ckpt-lo"),
+             "env": {"FLEET_TEST_JOB": "lo",
+                     "FLEET_TEST_TOTAL_STEPS": "60",
+                     "FLEET_TEST_STEP_SLEEP": "0.2"}},
+            {"name": "hi", "command":
+                "%s %s" % (sys.executable,
+                           os.path.join(REPO_ROOT, "tests",
+                                        "fleet_worker.py")),
+             "np": 2, "min_np": 2, "priority": 10, "arrival": 6.0,
+             "ckpt_dir": str(tmp_path / "ckpt-hi"),
+             "env": {"FLEET_TEST_JOB": "hi",
+                     "FLEET_TEST_TOTAL_STEPS": "8",
+                     "FLEET_TEST_STEP_SLEEP": "0.2"}},
+        ],
+    }
+    jobfile_path = tmp_path / "jobs.json"
+    jobfile_path.write_text(json.dumps(jobfile))
+    log = str(tmp_path / "fleet.log")
+    env = _fleet_env({"HVD_TPU_ELASTIC_COOLDOWN": "2"})
+    with open(log, "w") as logf:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.fleet.cli",
+             "--port", "0", str(jobfile_path)],
+            env=env, stdout=logf, stderr=subprocess.STDOUT,
+            start_new_session=True)
+    try:
+        port = int(_wait_for(
+            lambda: (re.search(r"metrics at http://localhost:(\d+)",
+                               _read(log)) or [None, None])[1],
+            timeout=30, what="controller metrics port"))
+
+        def fleet_view():
+            with urllib.request.urlopen(
+                    "http://localhost:%d/fleet" % port,
+                    timeout=5) as resp:
+                return json.loads(resp.read().decode())
+
+        # The drain → reclaim cycle is OBSERVABLE: at some poll, job lo
+        # is draining or already preempted while hi holds/waits for the
+        # slots.
+        seen_states = set()
+
+        def lo_preempted():
+            view = fleet_view()
+            seen_states.add(view["jobs"]["lo"]["state"])
+            return ("preempted" in seen_states
+                    or "draining" in seen_states)
+
+        _wait_for(lo_preempted, timeout=90,
+                  what="job lo draining/preempted in /fleet")
+
+        # hvd-top --fleet renders the cross-job view against the live
+        # endpoint.
+        top = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "bin", "hvd-top"),
+             "--fleet", "--once", "localhost:%d" % port],
+            env=env, timeout=30, capture_output=True, text=True)
+        assert top.returncode == 0, (top.stdout, top.stderr)
+        assert "lo" in top.stdout and "hi" in top.stdout
+        assert "preempted" in top.stdout or "draining" in top.stdout
+
+        # The fleet_* Prometheus plane records the drain cycle live.
+        with urllib.request.urlopen(
+                "http://localhost:%d/metrics" % port, timeout=5) as resp:
+            prom = resp.read().decode()
+        assert "hvdtpu_fleet_drains_requested_total" in prom
+        assert re.search(
+            r"hvdtpu_fleet_drains_requested_total \d", prom), prom
+        assert "hvdtpu_fleet_drain_seconds" in prom
+        assert "hvdtpu_fleet_jobs_preempted" in prom
+
+        rc = proc.wait(timeout=240)
+    finally:
+        if proc.poll() is None:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            proc.wait(timeout=10)
+    out = _read(log)
+    assert rc == 0, out
+    # Both jobs completed; lo was preempted and restored.
+    assert len(LOG_DONE.findall(out)) >= 2, out
+    assert "preempting job lo" in out
+    assert "job lo preempted" in out
+    assert "job lo restored" in out
+    # The restore resumed bitwise-consistently with the lineage.
+    assert assert_lineage_consistent(out) >= 1
+    # fleet_* metrics recorded the full cycle (the controller logs its
+    # counters through /metrics; check the final ones via the log's
+    # Prometheus scrape is gone with the process, so re-derive from
+    # events above plus the drain/restore latency histograms having
+    # been observed — asserted through the controller's own summary).
+    assert "fleet finished: all 2 job(s) completed" in out
+
+
+# ---------------------------------------------------------------------------
+# E2E: seeded fleet chaos — arrivals + random SIGKILLs + forced
+# preemption over 3 concurrent jobs (acceptance criterion; slow tier)
+
+@pytest.mark.e2e
+@pytest.mark.slow
+def test_fleet_chaos_schedule(tmp_path):
+    worker = os.path.join(REPO_ROOT, "tests", "fleet_worker.py")
+
+    def job(name, np_, min_np, priority, arrival=0.0, steps=40,
+            sleep=0.15):
+        return {"name": name,
+                "command": "%s %s" % (sys.executable, worker),
+                "np": np_, "min_np": min_np, "priority": priority,
+                "arrival": arrival,
+                "ckpt_dir": str(tmp_path / ("ckpt-%s" % name)),
+                "env": {"FLEET_TEST_JOB": name,
+                        "FLEET_TEST_TOTAL_STEPS": str(steps),
+                        "FLEET_TEST_STEP_SLEEP": str(sleep)}}
+
+    jobfile = {
+        "hosts": "localhost:4",
+        "drain_grace": 30,
+        "jobs": [
+            job("a", 2, 1, priority=0, steps=60),
+            job("b", 2, 1, priority=3, steps=40),
+            job("c", 2, 2, priority=8, steps=25),
+        ],
+    }
+    jobfile_path = tmp_path / "jobs.json"
+    jobfile_path.write_text(json.dumps(jobfile))
+    log = str(tmp_path / "fleet.log")
+    env = _fleet_env({
+        "HVD_TPU_ELASTIC_COOLDOWN": "2",
+        # Seeded schedule: b arrives at t=4, c at t=8 (its gang of 2
+        # with min_np=2 forces preemption pressure), a random worker of
+        # a is SIGKILLed twice, and b eats one forced preemption at t=6
+        # — while its ~6s of stepping is guaranteed still in flight
+        # (a late preempt would be dropped against an already-done b).
+        "HVD_TPU_FLEET_CHAOS_SPEC":
+            "seed=1702;job=b,at=4,action=arrive;"
+            "job=c,at=8,action=arrive;"
+            "job=a,at=6,action=kill,count=2,every=5;"
+            "job=b,at=6,action=preempt",
+    })
+    with open(log, "w") as logf:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.fleet.cli",
+             "--port", "0", "--timeout", "420", str(jobfile_path)],
+            env=env, stdout=logf, stderr=subprocess.STDOUT,
+            start_new_session=True)
+    try:
+        rc = proc.wait(timeout=480)
+    finally:
+        if proc.poll() is None:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            proc.wait(timeout=10)
+    out = _read(log)
+    assert rc == 0, out[-4000:]
+    # Every job completed...
+    for name in ("a", "b", "c"):
+        assert re.search(r"job %s worker \S+ done step" % name, out), (
+            "job %s never printed done\n%s" % (name, out[-4000:]))
+    # ...the chaos actually happened...
+    assert out.count("chaos: SIGKILL") >= 1, out
+    assert "chaos: forced preemption of job b" in out
+    # ...every resume was bitwise-consistent with the lineage...
+    assert assert_lineage_consistent(out) >= 1
+    # ...and the pool never double-assigned a host.
+    assert "OCCUPANCY VIOLATION" not in out
+    assert "fleet finished: all 3 job(s) completed" in out
