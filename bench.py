@@ -628,6 +628,133 @@ def compression_main(args):
     return 0
 
 
+def _run_sharded_bench(n, iters, mb, sharded, conv=False, timeout=900):
+    """Launches n local workers running `iters` Adam steps over an
+    `mb`-MB flat parameter buffer, replicated (sharded=False) or
+    ZeRO-sharded (sharded=True); returns per-rank dicts of wall time,
+    data-ring wire counters and optimizer-state bytes."""
+    procs, socks = _spawn_local_workers(
+        n, "sharded_bench_worker.py",
+        {"HVD_TPU_BENCH_ITERS": str(iters),
+         "HVD_TPU_BENCH_MB": str(mb),
+         "HVD_TPU_BENCH_SHARDED": "1" if sharded else "0",
+         "SHARDED_BENCH_CONV": "1" if conv else "0",
+         "JAX_PLATFORMS": "cpu"})
+    outputs = []
+    rows = {}
+    try:
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=timeout)
+            outputs.append(out)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    "sharded bench rank %d (sharded=%s) failed:\n%s"
+                    % (r, sharded, out))
+            m = re.search(r"SHARDED_BENCH (\{.*\})", out)
+            if m:
+                d = json.loads(m.group(1))
+                rows[d["rank"]] = d
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for s in socks:
+            s.close()
+    if 0 not in rows:
+        raise RuntimeError("no SHARDED_BENCH line from rank 0:\n%s"
+                           % (outputs[0] if outputs else "<no output>"))
+    return rows
+
+
+def sharded_update_main(args):
+    """bench.py --sharded-update: A/B the ZeRO-style sharded weight
+    update against the replicated allreduce path at 2 and 4 local
+    ranks (docs/ZERO.md). Acceptance (ISSUE 8): per-rank
+    optimizer-state bytes <= replicated/world_size + one shard of
+    padding, data-ring wire bytes within 5% of the allreduce's, and
+    the 2-rank replicated-vs-sharded convergence run diverging by at
+    most 1e-4 relative loss."""
+    iters, mb = max(10, args.num_iters), 4
+    ab = []
+    for n in (2, 4):
+        repl = _run_sharded_bench(n, iters, mb, sharded=False)
+        shd = _run_sharded_bench(n, iters, mb, sharded=True,
+                                 conv=(n == 2))
+        # Both modes walked the same trajectory (collective regression
+        # guard, not a perf stat).
+        ps_r, ps_s = repl[0]["params_sum"], shd[0]["params_sum"]
+        if abs(ps_s - ps_r) > 1e-3 * max(1.0, abs(ps_r)):
+            raise RuntimeError(
+                "sharded trajectory diverged from replicated at %d "
+                "ranks: params_sum %r vs %r" % (n, ps_s, ps_r))
+        opt_repl = repl[0]["opt_state_bytes"]
+        opt_shard = max(row["opt_state_bytes"] for row in shd.values())
+        # One shard of padding slack: the largest shard (uneven
+        # partitions) may carry ceil(total/n) - floor(total/n) extra
+        # elements per moment; allow a whole extra element row to stay
+        # robust.
+        shard_pad = 2 * 4 * (max(row["shard_elems"]
+                                 for row in shd.values()) -
+                             min(row["shard_elems"]
+                                 for row in shd.values()) + 1)
+        wire_repl = repl[0]["ring_bytes_sent"]
+        wire_shard = shd[0]["ring_bytes_sent"]
+        entry = {
+            "ranks": n, "payload_mb": mb, "iters": iters,
+            "replicated_us_per_step": repl[0]["us_per_step"],
+            "sharded_us_per_step": shd[0]["us_per_step"],
+            "replicated_opt_state_bytes": opt_repl,
+            "sharded_opt_state_bytes_max_rank": opt_shard,
+            "opt_state_reduction": round(opt_repl / max(1, opt_shard),
+                                         3),
+            "replicated_ring_bytes_sent": wire_repl,
+            "sharded_ring_bytes_sent": wire_shard,
+            "wire_ratio_sharded_over_replicated": round(
+                wire_shard / max(1, wire_repl), 4),
+            "reduce_scatter_ops": shd[0]["reduce_scatter_ops"],
+        }
+        if not opt_shard <= opt_repl / n + shard_pad:
+            raise RuntimeError(
+                "sharded optimizer state is not 1/N: %d > %d/%d + %d"
+                % (opt_shard, opt_repl, n, shard_pad))
+        if abs(wire_shard - wire_repl) > 0.05 * wire_repl:
+            raise RuntimeError(
+                "sharded wire bytes not within 5%% of allreduce at %d "
+                "ranks: %d vs %d" % (n, wire_shard, wire_repl))
+        if n == 2:
+            conv = shd[0].get("convergence")
+            if not conv or not conv["loss_match"]:
+                raise RuntimeError(
+                    "sharded convergence diverged from replicated: %s"
+                    % conv)
+            entry["convergence_sharded_vs_replicated"] = conv
+        ab.append(entry)
+        print("sharded-update %d ranks: opt state %.2fx smaller "
+              "(%d -> %d B/rank), wire %.4fx, %.0f -> %.0f us/step"
+              % (n, entry["opt_state_reduction"], opt_repl, opt_shard,
+                 entry["wire_ratio_sharded_over_replicated"],
+                 entry["replicated_us_per_step"],
+                 entry["sharded_us_per_step"]), file=sys.stderr)
+
+    out = dict(ab[0])
+    out.update({
+        "metric": "sharded_update_opt_state_reduction",
+        "unit": "x_opt_state_bytes_replicated_over_sharded_2_ranks",
+        "value": ab[0]["opt_state_reduction"],
+        "ab": ab,
+        # BENCH_r06 predates the sharded update, so the baseline is the
+        # same-run replicated path (the r06-era execution mode).
+        "vs_baseline": ab[0]["opt_state_reduction"],
+        "baseline": "same-run replicated allreduce + full-state Adam "
+                    "(BENCH_r06 predates sharded_update); acceptance: "
+                    "opt bytes <= replicated/N + shard padding, wire "
+                    "within 5% of allreduce, convergence max rel loss "
+                    "divergence <= 1e-4",
+    })
+    emit(out)
+    return 0
+
+
 def _prior_round_value(metric):
     """Newest prior-round row with the same metric name, scanned from
     the BENCH_r*.json / BENCH_ZOO_r*.json artifacts at the repo root
@@ -1035,6 +1162,14 @@ def main():
                          "step time with compression off vs this mode "
                          "(2 local ranks, CPU-only), plus the int8 vs "
                          "fp32 convergence run; prints one JSON line")
+    ap.add_argument("--sharded-update", action="store_true",
+                    help="A/B the ZeRO-style sharded weight update "
+                         "(docs/ZERO.md): step time, optimizer-state "
+                         "bytes (opt_state_bytes gauge) and data-ring "
+                         "wire bytes for reduce-scatter+allgather vs "
+                         "plain allreduce at 2 and 4 local ranks, plus "
+                         "a 2-rank replicated-vs-sharded convergence "
+                         "run; prints one JSON line")
     ap.add_argument("--durable-commit", action="store_true",
                     help="measure ElasticState.commit() latency with "
                          "the durable checkpoint writer off vs on "
@@ -1069,6 +1204,8 @@ def main():
         return scaling_worker(args)
     if args.compression is not None:
         return compression_main(args)
+    if args.sharded_update:
+        return sharded_update_main(args)
     if args.durable_commit:
         return durable_commit_main(args)
     if args.scaling:

@@ -21,6 +21,9 @@ from .common import (  # noqa: F401
     broadcast_async,
     get_basics,
     poll,
+    reduce_scatter,
+    reduce_scatter_async,
+    shard_partition,
     synchronize,
 )
 

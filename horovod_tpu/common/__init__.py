@@ -8,5 +8,8 @@ from .ops import (  # noqa: F401
     broadcast,
     broadcast_async,
     poll,
+    reduce_scatter,
+    reduce_scatter_async,
+    shard_partition,
     synchronize,
 )

@@ -107,6 +107,16 @@ class HorovodBasics:
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_double,
             ctypes.c_double, ctypes.c_int,
         ]
+        lib.horovod_tpu_enqueue_reduce_scatter.restype = ctypes.c_int
+        lib.horovod_tpu_enqueue_reduce_scatter.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_double,
+            ctypes.c_double, ctypes.c_int,
+        ]
+        lib.horovod_tpu_sharded_update_default.restype = ctypes.c_int
+        lib.horovod_tpu_sharded_update_default.argtypes = []
+        lib.horovod_tpu_opt_state_metrics.restype = None
+        lib.horovod_tpu_opt_state_metrics.argtypes = [ctypes.c_int64]
         lib.horovod_tpu_parse_compression.restype = ctypes.c_int
         lib.horovod_tpu_parse_compression.argtypes = [ctypes.c_char_p]
         lib.horovod_tpu_effective_compression.restype = ctypes.c_int
@@ -277,6 +287,15 @@ class HorovodBasics:
         self.lib.horovod_tpu_ckpt_metrics(
             int(writes), int(failures), int(nbytes), int(restores),
             int(restore_failures), int(last_step), float(write_seconds))
+
+    def sharded_update_default(self):
+        """The HVD_TPU_SHARDED_UPDATE job default (docs/ZERO.md)."""
+        return bool(self.lib.horovod_tpu_sharded_update_default())
+
+    def opt_state_metrics(self, nbytes):
+        """Reports this rank's optimizer-state byte count into the
+        native opt_state_bytes gauge (docs/ZERO.md; < 0 = skip)."""
+        self.lib.horovod_tpu_opt_state_metrics(int(nbytes))
 
     def drain_metrics(self, requested=0, draining=-2):
         """Reports graceful-drain accounting into the native registry

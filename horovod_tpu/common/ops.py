@@ -62,6 +62,77 @@ def allreduce_async(tensor, name, prescale_factor=1.0, postscale_factor=1.0,
     return handle
 
 
+def shard_partition(count, n):
+    """(counts, offsets) of the reduce-scatter shard partition: `count`
+    elements into `n` near-equal chunks, chunk i owned by rank i. MUST
+    match native/cpu_operations.cc PartitionChunks — both ends size the
+    shard buffers from this."""
+    base, rem = divmod(int(count), int(n))
+    counts = [base + (1 if i < rem else 0) for i in range(n)]
+    offsets = [0] * n
+    for i in range(1, n):
+        offsets[i] = offsets[i - 1] + counts[i - 1]
+    return counts, offsets
+
+
+def sharded_update_default():
+    """The job-wide ``HVD_TPU_SHARDED_UPDATE`` default, parsed by the
+    native helper so every consumer (framework wrappers, tooling,
+    tests) agrees on the same semantics (strtol: any nonzero value
+    enables, docs/ZERO.md)."""
+    return get_basics().sharded_update_default()
+
+
+def reduce_scatter_async(tensor, name, prescale_factor=1.0,
+                         postscale_factor=1.0, compression=None, out=None):
+    """Starts a reduce-scatter (sum) on a numpy array; returns a handle.
+
+    The tensor is treated as FLAT: its elements are partitioned into
+    ``size()`` near-equal chunks (:func:`shard_partition`) and this
+    rank's result is chunk ``rank()`` of the cross-rank sum — a 1-D
+    array of ``counts[rank]`` elements (the sharded-update gradient leg,
+    docs/ZERO.md). `out`, when given, must be a C-contiguous same-dtype
+    array of exactly that many elements. `compression` rides the
+    negotiation per hop exactly as in :func:`allreduce_async`."""
+    basics = get_basics()
+    mode = _compression.resolve(compression)
+    arr = np.ascontiguousarray(tensor)
+    counts, _ = shard_partition(arr.size, basics.size())
+    my_count = counts[basics.rank()]
+    if out is None:
+        out = np.empty(my_count, dtype=arr.dtype)
+    elif out.size != my_count:
+        raise ValueError("reduce_scatter out has %d elements; this rank's "
+                         "shard needs %d" % (out.size, my_count))
+    elif out.dtype != arr.dtype or not out.flags["C_CONTIGUOUS"]:
+        # The native core memcpys counts[rank]*itemsize bytes straight
+        # into out's base pointer: a narrower dtype or a strided view
+        # would be silent heap corruption, not a wrong answer.
+        raise ValueError("reduce_scatter out must be a C-contiguous %s "
+                         "array (got %s%s)"
+                         % (arr.dtype, out.dtype,
+                            "" if out.flags["C_CONTIGUOUS"]
+                            else ", non-contiguous"))
+    handle = basics.lib.horovod_tpu_enqueue_reduce_scatter(
+        name.encode("utf-8"), arr.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p), arr.ndim, _shape_array(arr),
+        numpy_to_hvd_dtype(arr.dtype), float(prescale_factor),
+        float(postscale_factor), int(mode.mode))
+    _handle_map[handle] = (arr, out)
+    return handle
+
+
+def reduce_scatter(tensor, name, average=False, prescale_factor=1.0,
+                   postscale_factor=1.0, compression=None):
+    """Synchronous reduce-scatter; returns this rank's 1-D shard of the
+    sum (or the average with ``average=True``)."""
+    if average:
+        postscale_factor = postscale_factor / get_basics().size()
+    return synchronize(reduce_scatter_async(
+        tensor, name, prescale_factor, postscale_factor,
+        compression=compression))
+
+
 def allgather_async(tensor, name):
     """Starts an allgather along dim 0; returns a handle."""
     basics = get_basics()

@@ -124,6 +124,26 @@ def resolve(spec):
             "'int8')" % (spec,))
 
 
+def resolve_wire_arg(compression, none_codec=None):
+    """Maps a ``DistributedOptimizer(compression=...)`` argument to a
+    wire :class:`Mode` under sharded mode, shared by all three framework
+    wrappers so the accepted set cannot drift between them: legacy
+    tensor codecs are rejected (they would change the dtype the
+    shard-local optimizer sees), EXCEPT the binding's no-op ``none``
+    codec (``none_codec``), which — being the wrappers' DEFAULT
+    argument — defers to the job-wide ``HVD_TPU_COMPRESSION`` default
+    exactly like passing nothing (to force uncompressed wire under an
+    env default, pass ``compression='none'`` explicitly,
+    docs/ZERO.md)."""
+    if compression is not None and hasattr(compression, "compress"):
+        if none_codec is None or compression is not none_codec:
+            raise ValueError(
+                "sharded_update takes wire compression modes "
+                "('none'/'bf16'/'int8'), not legacy codec objects")
+        compression = None
+    return resolve(compression)
+
+
 def wire_bytes(count, mode):
     """Wire bytes `count` f32 elements occupy under `mode` — the same
     pure function of (count, mode) both ring endpoints size buffers

@@ -237,6 +237,62 @@ def allreduce(tensor, average=True, name=None, axis_name=AXIS_NAME,
     return compression.decompress(result, ctx) if legacy else result
 
 
+def reduce_scatter(tensor, average=True, name=None, axis_name=AXIS_NAME,
+                   compression=None, prescale_factor=1.0,
+                   postscale_factor=1.0):
+    """Reduce-scatter across ranks (docs/ZERO.md): the tensor is
+    flattened, summed (or averaged) across ranks, and this rank keeps
+    only its 1/N shard of the result — the gradient leg of the sharded
+    weight update.
+
+    In-jit over a mapped axis the flat tensor must divide evenly by the
+    axis size (pad first; ``parallel.ring.ring_reduce_scatter`` handles
+    padding and the compressed per-hop ring). On the host plane the
+    shard partition is :func:`horovod_tpu.shard_partition` (uneven sizes
+    allowed). Returns a 1-D array.
+    """
+    mode = _wire.resolve(compression)
+    if _is_traced(tensor):
+        if _axis_in_scope(axis_name):
+            from horovod_tpu.parallel.ring import ring_reduce_scatter
+            flat = tensor.reshape(-1)
+            if prescale_factor != 1.0:
+                flat = flat * prescale_factor
+            shard = ring_reduce_scatter(flat, axis_name, compression=mode)
+            if average:
+                shard = shard / jax.lax.psum(1, axis_name)
+            if postscale_factor != 1.0:
+                shard = shard * postscale_factor
+            return shard.astype(tensor.dtype)
+        if _multi_process():
+            from jax.experimental import io_callback
+            op_name = name or _auto_name("reduce_scatter")
+            counts, _ = _ops.shard_partition(
+                int(np.prod(tensor.shape, dtype=np.int64)), _hvd.size())
+            my_count = counts[_hvd.rank()]
+
+            def _cb(arr):
+                return np.asarray(_ops.reduce_scatter(
+                    np.asarray(arr), op_name, average=average,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    compression=mode)).astype(arr.dtype)
+
+            out_shape = jax.ShapeDtypeStruct((my_count,), tensor.dtype)
+            return io_callback(_cb, out_shape, tensor, ordered=True)
+        _require_init_traced()
+        scale = prescale_factor * postscale_factor
+        flat = tensor.reshape(-1)
+        return flat * scale if scale != 1.0 else flat
+    arr = np.asarray(tensor)
+    out = _ops.reduce_scatter(arr, name or _auto_name("reduce_scatter"),
+                              average=average,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor,
+                              compression=mode)
+    return jnp.asarray(out)
+
+
 def allgather(tensor, name=None, axis_name=AXIS_NAME):
     """Concatenates tensors from all ranks along dim 0.
 
@@ -361,7 +417,7 @@ def broadcast_optimizer_state(opt_state, root_rank=0,
 
 def DistributedOptimizer(optimizer, compression=None,
                          average=True, name_prefix="grad",
-                         axis_name=AXIS_NAME):
+                         axis_name=AXIS_NAME, sharded_update=None):
     """Wraps an optax GradientTransformation so every update first averages
     gradients across ranks (reference: _DistributedOptimizer,
     tensorflow/__init__.py:231-258).
@@ -371,8 +427,29 @@ def DistributedOptimizer(optimizer, compression=None,
     ``HVD_TPU_COMPRESSION``) shrinks the gradient bytes every hop moves
     — see :func:`allreduce` and docs/COMPRESSION.md, including when NOT
     to compress (integer/embedding gradients; hvd-lint flags those).
+
+    ``sharded_update=True`` (job-wide: ``HVD_TPU_SHARDED_UPDATE=1``)
+    switches the host plane to the ZeRO-style sharded weight update
+    (docs/ZERO.md): gradients are flattened into ONE fused buffer and
+    reduce-scattered (same wire bytes as the allreduce they replace —
+    the ring's reduce-scatter leg runs either way), the optimizer
+    applies only to this rank's 1/N shard — so momentum/Adam state
+    shrinks N-fold — and updated parameter shards are allgathered back.
+    Numerically identical to the replicated path for ELEMENTWISE
+    transforms (sgd/momentum/adam/adamw...). Mixed sharded/replicated
+    ranks are rejected at negotiation naming both ranks and modes. For
+    the in-jit XLA plane use ``parallel.make_train_step(zero1=True)``
+    instead. The optimizer state it returns is RANK-LOCAL — read it
+    through :func:`sharded_state_full` (hvd-lint rule
+    ``sharded-update-rank-local-param-read`` flags direct reads).
     """
     import optax
+
+    if sharded_update is None:
+        sharded_update = _ops.sharded_update_default()
+    if sharded_update:
+        return _sharded_distributed_optimizer(optimizer, compression,
+                                              average, name_prefix)
 
     def init_fn(params):
         return optimizer.init(params)
@@ -385,6 +462,181 @@ def DistributedOptimizer(optimizer, compression=None,
         return optimizer.update(updates, state, params)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _flat_f32_concat(tree):
+    """Flattens a pytree of arrays into one f32 vector (the Python-level
+    fusion buffer: leaf offsets in flatten order ARE the shard
+    boundaries' coordinate system)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return np.zeros(0, np.float32), leaves, treedef
+    flat = np.concatenate(
+        [np.ravel(np.asarray(l)).astype(np.float32) for l in leaves])
+    return flat, leaves, treedef
+
+
+def _report_opt_state_bytes(inner_state):
+    """Reports this rank's optimizer-state bytes into the native
+    opt_state_bytes gauge (docs/ZERO.md — the memory claim, observable
+    in hvd-top and the bench A/B)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(inner_state):
+        arr = np.asarray(leaf)
+        total += arr.nbytes
+    _hvd.get_basics().opt_state_metrics(total)
+
+
+def _sharded_distributed_optimizer(optimizer, compression, average,
+                                   name_prefix):
+    """The sharded_update host-plane transformation (docs/ZERO.md).
+
+    State layout: ``{"inner": <optimizer state over this rank's flat
+    shard>, "total": <flat element count>, "world": <world size it was
+    sharded for>, "rank": <owning rank>}``. The inner state's array
+    leaves are SHARDS — 1/N of each momentum/Adam moment.
+    """
+    import optax
+
+    mode = _wire.resolve_wire_arg(compression, Compression.none)
+
+    def _my_shard(flat):
+        counts, offsets = _ops.shard_partition(flat.size, _hvd.size())
+        r = _hvd.rank()
+        return flat[offsets[r]:offsets[r] + counts[r]]
+
+    def init_fn(params):
+        flat, _, _ = _flat_f32_concat(params)
+        inner = optimizer.init(jnp.asarray(_my_shard(flat)))
+        _report_opt_state_bytes(inner)
+        return {"inner": inner, "total": int(flat.size),
+                "world": _hvd.size(), "rank": _hvd.rank()}
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError(
+                "sharded_update needs params: call update(grads, state, "
+                "params) — the updated shard is params + update")
+        if state["world"] != _hvd.size() or state["rank"] != _hvd.rank():
+            raise RuntimeError(
+                "sharded optimizer state was built for rank %d of %d but "
+                "this process is rank %d of %d; after an elastic resize "
+                "restore the last COMMITTED full-form state (the old "
+                "membership's shards are gone) and re-shard it via "
+                "sharded_state_shard() (docs/ZERO.md)"
+                % (state["rank"], state["world"], _hvd.rank(), _hvd.size()))
+        flat_g, _, _ = _flat_f32_concat(updates)
+        if flat_g.size != state["total"]:
+            raise ValueError("gradient tree has %d elements; state was "
+                             "built for %d" % (flat_g.size, state["total"]))
+        # ONE fused reduce-scatter over the flat gradient buffer. The
+        # name deliberately matches the replicated path's first per-leaf
+        # allreduce ("<prefix>.0") so a sharded rank meeting a replicated
+        # peer collides at negotiation and is rejected naming both ranks
+        # and modes (docs/ZERO.md) instead of hanging.
+        g_shard = np.asarray(_ops.reduce_scatter(
+            flat_g, "%s.0" % name_prefix, average=average,
+            compression=mode))
+        flat_p, p_leaves, treedef = _flat_f32_concat(params)
+        p_shard = _my_shard(flat_p)
+        u_shard, inner = optimizer.update(
+            jnp.asarray(g_shard), state["inner"], jnp.asarray(p_shard))
+        new_shard = p_shard + np.asarray(u_shard, np.float32)
+        # Allgather of updated parameter shards: rank order == chunk
+        # order, so the concatenation IS the full flat parameter vector.
+        full_new = np.asarray(_ops.allgather(
+            new_shard, "%s.param_ag" % name_prefix))
+        _report_opt_state_bytes(inner)
+        out_leaves = []
+        off = 0
+        for leaf in p_leaves:
+            arr = np.asarray(leaf)
+            seg = full_new[off:off + arr.size].reshape(arr.shape)
+            off += arr.size
+            out_leaves.append(jnp.asarray(
+                (seg - arr.astype(np.float32)).astype(arr.dtype)))
+        new_state = {"inner": inner, "total": state["total"],
+                     "world": state["world"], "rank": state["rank"]}
+        return jax.tree_util.tree_unflatten(treedef, out_leaves), new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def sharded_state_full(state, name_prefix="shard_state"):
+    """Materializes a sharded optimizer state (from
+    ``DistributedOptimizer(sharded_update=True)``) as its FULL,
+    world-size-independent form: every shard-shaped array leaf of the
+    inner state is allgathered into the full flat array; scalar leaves
+    (Adam's step count) pass through. This is a COLLECTIVE — call it on
+    every rank at the same point (a checkpoint/commit boundary).
+
+    The result re-shards to ANY world size via
+    :func:`sharded_state_shard`, which is how sharded state rides the
+    durable checkpoint layer's re-shard-on-restore contract
+    (docs/ZERO.md). Idempotent: a state already in full form is
+    returned unchanged (no collective)."""
+    if state["world"] == -1:
+        return state
+    if state["world"] != _hvd.size() or state["rank"] != _hvd.rank():
+        # The old membership's shards no longer exist anywhere:
+        # allgathering over the CURRENT ranks would reassemble a short
+        # buffer and silently label it full. Only the membership that
+        # built the shards can materialize them.
+        raise RuntimeError(
+            "sharded optimizer state was built for rank %d of %d but "
+            "this process is rank %d of %d; the full form can only be "
+            "materialized by the membership that built the shards — "
+            "restore the last COMMITTED full-form state instead "
+            "(docs/ZERO.md)"
+            % (state["rank"], state["world"], _hvd.rank(), _hvd.size()))
+    counts, _ = _ops.shard_partition(state["total"], state["world"])
+    my_count = counts[state["rank"]]
+    leaves, treedef = jax.tree_util.tree_flatten(state["inner"])
+    out = []
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        if arr.ndim >= 1 and arr.shape[0] == my_count:
+            arr = np.asarray(_ops.allgather(
+                arr, "%s.%d" % (name_prefix, i)))
+        out.append(arr)
+    # world/rank -1 = "full form, not sharded for anyone" (not None:
+    # the elastic state sync broadcasts every leaf through numpy).
+    return {"inner": jax.tree_util.tree_unflatten(treedef, out),
+            "total": state["total"], "world": -1, "rank": -1}
+
+
+def sharded_state_shard(full_state):
+    """Inverse of :func:`sharded_state_full` for the CURRENT rank/world:
+    slices every full-length array leaf down to this rank's shard. Pure
+    local slicing — no collective — so a restore path can re-shard a
+    checkpointed full state at any world size. A state still sharded
+    for THIS rank/world passes through unchanged; one sharded for a
+    different (rank, world) cannot be re-sliced locally and is
+    rejected (materialize the full form on the OLD membership first)."""
+    if full_state["world"] != -1:
+        if full_state["world"] == _hvd.size() and \
+                full_state["rank"] == _hvd.rank():
+            return full_state
+        raise ValueError(
+            "sharded_state_shard needs the full form (world=-1) or a "
+            "state already sharded for this rank; got one sharded for "
+            "rank %d of %d on rank %d of %d — call sharded_state_full() "
+            "before the membership changes"
+            % (full_state["rank"], full_state["world"], _hvd.rank(),
+               _hvd.size()))
+    total = full_state["total"]
+    counts, offsets = _ops.shard_partition(total, _hvd.size())
+    r = _hvd.rank()
+    lo, hi = offsets[r], offsets[r] + counts[r]
+    leaves, treedef = jax.tree_util.tree_flatten(full_state["inner"])
+    out = []
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        if arr.ndim >= 1 and arr.shape[0] == total:
+            arr = arr[lo:hi]
+        out.append(jnp.asarray(arr))
+    return {"inner": jax.tree_util.tree_unflatten(treedef, out),
+            "total": total, "world": _hvd.size(), "rank": r}
 
 
 def init_distributed(local_device_ids=None):

@@ -133,6 +133,97 @@ def check_compression_on_integer_tensor(model):
                 severity=WARNING)
 
 
+@register("sharded-update-rank-local-param-read", ERROR,
+          "optimizer state read directly under sharded_update (the "
+          "state is a rank-local 1/N shard)")
+def check_sharded_rank_local_param_read(model):
+    """Under ``DistributedOptimizer(sharded_update=True)`` the
+    optimizer state holds moments for THIS RANK'S 1/N shard only
+    (docs/ZERO.md): the torch wrapper's ``.state`` is empty by design
+    (the real moments live on an inner flat-shard optimizer), and the
+    jax state dict's ``["inner"]`` leaves are shard-length arrays.
+    Reading them as if they were global silently processes 1/N of the
+    elements — on every rank, each a DIFFERENT 1/N. Materialize the
+    world-independent full form first via ``sharded_state_full()`` (a
+    collective — call it on every rank at the same point)."""
+    import ast
+
+    # Pass 1: variables bound to a sharded DistributedOptimizer. Like
+    # the compression rule, anything but an explicitly-falsy constant
+    # counts (a dynamic sharded_update= may be True, and the cost of a
+    # false negative is a silent 1/N read).
+    sharded_opts = set()
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        base, attr = walker._call_base_attr(node.value.func)
+        if attr != "DistributedOptimizer":
+            continue
+        if base is not None and not walker._is_hvd_base(model, base):
+            continue
+        su = next((kw.value for kw in node.value.keywords
+                   if kw.arg == "sharded_update"), None)
+        if su is None or (isinstance(su, ast.Constant)
+                          and not su.value):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                sharded_opts.add(tgt.id)
+    if not sharded_opts:
+        return
+
+    # Pass 2: state variables produced by the sharded optimizer —
+    # `s = opt.init(...)` and the `u, s = opt.update(...)` re-binding.
+    state_vars = set()
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        base, attr = walker._call_base_attr(node.value.func)
+        if base not in sharded_opts:
+            continue
+        if attr == "init":
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    state_vars.add(tgt.id)
+        elif attr == "update":
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Tuple) and len(tgt.elts) == 2 \
+                        and isinstance(tgt.elts[1], ast.Name):
+                    state_vars.add(tgt.elts[1].id)
+
+    # Pass 3: flag the rank-local reads.
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.Attribute) and node.attr == "state" and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in sharded_opts and \
+                isinstance(node.ctx, ast.Load):
+            yield make_finding(
+                model, node, "sharded-update-rank-local-param-read",
+                "`%s.state` is read under sharded_update: the wrapper's "
+                "state dict is EMPTY by design — momentum/Adam moments "
+                "live on an inner optimizer over this rank's 1/N flat "
+                "shard, so any value found here covers a different 1/N "
+                "on every rank. Materialize the full state with "
+                "sharded_state_full() (a collective) before reading "
+                "moments" % node.value.id)
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in state_vars:
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and sl.value == "inner":
+                yield make_finding(
+                    model, node, "sharded-update-rank-local-param-read",
+                    "`%s[\"inner\"]` reads the sharded optimizer state "
+                    "directly: its array leaves are THIS RANK'S 1/N "
+                    "shard of each moment, not the full tensor — every "
+                    "rank sees a different slice. Pass the whole state "
+                    "through sharded_state_full() (collective, "
+                    "world-size independent) and read the full form "
+                    "instead" % node.value.id)
+
+
 @register("missing-initial-broadcast", WARNING,
           "gradient averaging without an initial parameter broadcast")
 def check_missing_initial_broadcast(model):

@@ -66,6 +66,8 @@ Status OperationManager::ExecuteOperation(
       return ExecuteFirstEnabled(allgather_ops_, entries, response);
     case Response::BROADCAST:
       return ExecuteFirstEnabled(broadcast_ops_, entries, response);
+    case Response::REDUCESCATTER:
+      return ExecuteFirstEnabled(reducescatter_ops_, entries, response);
     case Response::ERROR:
       return error_op_->Execute(entries, response);
   }

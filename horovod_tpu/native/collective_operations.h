@@ -55,6 +55,15 @@ class BroadcastOp : public HorovodOp {
   using HorovodOp::HorovodOp;
 };
 
+// Reduce-scatter (docs/ZERO.md): the sum lands SHARDED — rank r's output
+// buffer receives logical chunk r of the PartitionChunks partition over
+// the flattened tensor (the same partition the Python binding's
+// shard_partition computes).
+class ReduceScatterOp : public HorovodOp {
+ public:
+  using HorovodOp::HorovodOp;
+};
+
 class ErrorOp : public HorovodOp {
  public:
   using HorovodOp::HorovodOp;
@@ -73,10 +82,13 @@ class OperationManager {
   OperationManager(std::vector<std::shared_ptr<AllreduceOp>> allreduce_ops,
                    std::vector<std::shared_ptr<AllgatherOp>> allgather_ops,
                    std::vector<std::shared_ptr<BroadcastOp>> broadcast_ops,
+                   std::vector<std::shared_ptr<ReduceScatterOp>>
+                       reducescatter_ops,
                    std::shared_ptr<ErrorOp> error_op)
       : allreduce_ops_(std::move(allreduce_ops)),
         allgather_ops_(std::move(allgather_ops)),
         broadcast_ops_(std::move(broadcast_ops)),
+        reducescatter_ops_(std::move(reducescatter_ops)),
         error_op_(std::move(error_op)) {}
 
   Status ExecuteOperation(std::vector<TensorTableEntry>& entries,
@@ -91,6 +103,7 @@ class OperationManager {
   std::vector<std::shared_ptr<AllreduceOp>> allreduce_ops_;
   std::vector<std::shared_ptr<AllgatherOp>> allgather_ops_;
   std::vector<std::shared_ptr<BroadcastOp>> broadcast_ops_;
+  std::vector<std::shared_ptr<ReduceScatterOp>> reducescatter_ops_;
   std::shared_ptr<ErrorOp> error_op_;
 };
 

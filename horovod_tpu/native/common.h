@@ -62,6 +62,13 @@ namespace hvdtpu {
 // compression= arguments override it; Python resolves the env once per
 // call so the mode rides the Request and is validated cross-rank.
 #define HVD_TPU_COMPRESSION_ENV "HVD_TPU_COMPRESSION"
+// Job-wide sharded-weight-update default (docs/ZERO.md): "1" makes
+// DistributedOptimizer wrappers that were not given an explicit
+// sharded_update= argument reduce-scatter gradients and shard optimizer
+// state 1/N per rank. Per-call arguments override it; negotiation
+// validates the mode cross-rank (mixed sharded/replicated ranks are
+// rejected by name, like mixed compression).
+#define HVD_TPU_SHARDED_UPDATE_ENV "HVD_TPU_SHARDED_UPDATE"
 
 enum class StatusType : int32_t {
   OK = 0,

@@ -97,10 +97,32 @@ Response Controller::ConstructResponse(const std::string& name) {
   // All ranks must agree on op type, dtype, and scaling.
   for (const auto& req : requests) {
     if (req.request_type() != first.request_type()) {
-      error << "Mismatched collective operations: one rank did "
+      error << "Mismatched collective operations: rank "
+            << first.request_rank() << " did "
             << Request::RequestTypeName(first.request_type())
-            << " while another did "
+            << " while rank " << req.request_rank() << " did "
             << Request::RequestTypeName(req.request_type()) << ".";
+      // A sharded-vs-replicated split is the mixed-execution-mode case
+      // (docs/ZERO.md): name both ranks AND both modes, exactly like
+      // mixed compression, so the fix is obvious from the message.
+      auto is_pair = [&](Request::RequestType a, Request::RequestType b) {
+        return (first.request_type() == a && req.request_type() == b) ||
+               (first.request_type() == b && req.request_type() == a);
+      };
+      if (is_pair(Request::ALLREDUCE, Request::REDUCESCATTER)) {
+        int sharded_rank = first.request_type() == Request::REDUCESCATTER
+                               ? first.request_rank()
+                               : req.request_rank();
+        int replicated_rank = first.request_type() == Request::ALLREDUCE
+                                  ? first.request_rank()
+                                  : req.request_rank();
+        error << " Mixed execution modes: rank " << sharded_rank
+              << " runs sharded_update (reduce-scatter) while rank "
+              << replicated_rank
+              << " runs the replicated update (allreduce); pass the same "
+              << "sharded_update= (or HVD_TPU_SHARDED_UPDATE) on every "
+              << "rank.";
+      }
       error_found = true;
       break;
     }
@@ -136,7 +158,8 @@ Response Controller::ConstructResponse(const std::string& name) {
   }
 
   if (!error_found && (first.request_type() == Request::ALLREDUCE ||
-                       first.request_type() == Request::BROADCAST)) {
+                       first.request_type() == Request::BROADCAST ||
+                       first.request_type() == Request::REDUCESCATTER)) {
     for (const auto& req : requests) {
       if (req.tensor_shape() != first.tensor_shape()) {
         TensorShape a(first.tensor_shape()), b(req.tensor_shape());
@@ -213,6 +236,15 @@ Response Controller::ConstructResponse(const std::string& name) {
       break;
     case Request::BROADCAST: {
       response.set_response_type(Response::BROADCAST);
+      TensorShape shape(first.tensor_shape());
+      response.add_tensor_size(shape.num_elements());
+      break;
+    }
+    case Request::REDUCESCATTER: {
+      // Total element count rides the response; the executing op and
+      // the Python binding derive the per-rank shard partition from it
+      // with the same PartitionChunks math (shard i owns chunk i).
+      response.set_response_type(Response::REDUCESCATTER);
       TensorShape shape(first.tensor_shape());
       response.add_tensor_size(shape.num_elements());
       break;

@@ -146,7 +146,9 @@ void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor) {
   }
 }
 
-// Partitions `count` elements into n near-equal chunks.
+// Partitions `count` elements into n near-equal chunks. The same math
+// lives in horovod_tpu/common/ops.py shard_partition — the Python side
+// must size reduce-scatter shard buffers identically.
 static void PartitionChunks(int64_t count, int n, std::vector<int64_t>* counts,
                             std::vector<int64_t>* offsets) {
   counts->assign(n, 0);
@@ -158,6 +160,12 @@ static void PartitionChunks(int64_t count, int n, std::vector<int64_t>* counts,
     (*offsets)[i] = off;
     off += (*counts)[i];
   }
+}
+
+static int64_t MaxChunk(const std::vector<int64_t>& counts) {
+  int64_t m = 0;
+  for (int64_t c : counts) m = std::max(m, c);
+  return m;
 }
 
 // Reduce-scatter leg of a ring allreduce: after n-1 steps ring rank r owns
@@ -177,10 +185,14 @@ static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
   int rank = ctx.RingRank(ring);
   std::size_t elem = DataTypeSize(dtype);
   if (cmp != CompressionMode::NONE) {
+    // Scratch sized by the LARGEST chunk: callers may pass a rotated
+    // chunk order (the standalone reduce-scatter op does), so counts[0]
+    // is not necessarily the maximum.
     float* f = reinterpret_cast<float*>(buf);
-    std::vector<char> send_c(CompressedSize(counts[0], cmp));
-    std::vector<char> recv_c(CompressedSize(counts[0], cmp));
-    std::vector<float> tmp(static_cast<std::size_t>(counts[0]));
+    int64_t max_chunk = MaxChunk(counts);
+    std::vector<char> send_c(CompressedSize(max_chunk, cmp));
+    std::vector<char> recv_c(CompressedSize(max_chunk, cmp));
+    std::vector<float> tmp(static_cast<std::size_t>(max_chunk));
     for (int step = 0; step < n - 1; ++step) {
       int send_chunk = (rank - step + n) % n;
       int recv_chunk = (rank - step - 1 + n) % n;
@@ -198,7 +210,7 @@ static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
     }
     return Status::OK();
   }
-  std::vector<char> tmp(static_cast<std::size_t>(counts[0]) * elem);
+  std::vector<char> tmp(static_cast<std::size_t>(MaxChunk(counts)) * elem);
   for (int step = 0; step < n - 1; ++step) {
     int send_chunk = (rank - step + n) % n;
     int recv_chunk = (rank - step - 1 + n) % n;
@@ -234,8 +246,9 @@ static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
     // the uncompressed path's single tmp), not one per rank.
     float* f = reinterpret_cast<float*>(buf);
     int owned = (rank + 1) % n;
-    std::vector<char> send_c(CompressedSize(counts[0], cmp));
-    std::vector<char> recv_c(CompressedSize(counts[0], cmp));
+    int64_t max_chunk = MaxChunk(counts);
+    std::vector<char> send_c(CompressedSize(max_chunk, cmp));
+    std::vector<char> recv_c(CompressedSize(max_chunk, cmp));
     CompressBuffer(f + offsets[owned], counts[owned], cmp, send_c.data());
     DecompressBuffer(send_c.data(), counts[owned], cmp, f + offsets[owned]);
     for (int step = 0; step < n - 1; ++step) {
@@ -401,6 +414,78 @@ Status CpuHierarchicalAllreduce::ReduceBuffer(void* buffer, int64_t count,
 
   return RingAllgatherPhaseOn(ctx_, Ring::LOCAL, buf, counts, offsets, dtype,
                               cmp);
+}
+
+bool CpuRingReduceScatter::Enabled(
+    const std::vector<TensorTableEntry>& entries,
+    const Response& response) const {
+  return entries[0].device == HOST_DEVICE_ID;
+}
+
+Status CpuRingReduceScatter::Execute(std::vector<TensorTableEntry>& entries,
+                                     const Response& response) {
+  // The reduce-scatter leg of the ring as a standalone op (docs/ZERO.md):
+  // rank r's output receives logical chunk r of the PartitionChunks
+  // partition over the flattened tensor, summed across all ranks. Wire
+  // compression applies per hop exactly as in the fused allreduce leg
+  // (the f32 accumulator never lives in the narrow format). The
+  // controller never fuses REDUCESCATTER responses — sharded callers
+  // fuse at the source instead (one flat gradient buffer whose offsets
+  // ARE the shard boundaries), so entries is normally a single tensor.
+  int n = ctx_.size();
+  int rank = ctx_.rank();
+  auto& timeline = global_state_->timeline;
+  CompressionMode cmp = EffectiveCompression(
+      static_cast<CompressionMode>(response.compression()),
+      entries[0].dtype);
+  Metrics& m = GlobalMetrics();
+  timeline.ActivityStartAll(response.tensor_names(), "REDUCE_SCATTER_RING");
+  for (auto& e : entries) {
+    int64_t count = e.NumElements();
+    std::size_t elem = DataTypeSize(e.dtype);
+    std::vector<int64_t> counts, offsets;
+    PartitionChunks(count, n, &counts, &offsets);
+    m.reduce_scatter_total.fetch_add(1, std::memory_order_relaxed);
+    m.reduce_scatter_bytes_total.fetch_add(
+        static_cast<uint64_t>(count) * elem, std::memory_order_relaxed);
+    if (count == 0) continue;
+    if (n == 1) {
+      if (e.output != e.data) std::memcpy(e.output, e.data, e.SizeBytes());
+      ScaleBuffer(e.output, count, e.dtype,
+                  e.prescale_factor * e.postscale_factor);
+      continue;
+    }
+    // The ring leg leaves ring-rank r owning ring chunk (r+1)%n; rank r
+    // must own LOGICAL chunk r, so ring chunk j maps onto logical chunk
+    // (j+n-1)%n — a pure relabeling (offsets stay the contiguous
+    // PartitionChunks layout, identical on every rank).
+    std::vector<int64_t> ring_counts(n), ring_offsets(n);
+    for (int j = 0; j < n; ++j) {
+      int logical = (j + n - 1) % n;
+      ring_counts[j] = counts[logical];
+      ring_offsets[j] = offsets[logical];
+    }
+    // Work in a scratch copy: the entry's output buffer is shard-sized
+    // (counts[rank] elements), not full-tensor-sized.
+    std::vector<char> work(static_cast<std::size_t>(count) * elem);
+    std::memcpy(work.data(), e.data, work.size());
+    if (e.prescale_factor != 1.0) {
+      ScaleBuffer(work.data(), count, e.dtype, e.prescale_factor);
+    }
+    Status s = RingReduceScatterOn(ctx_, Ring::GLOBAL, work.data(),
+                                   ring_counts, ring_offsets, e.dtype, cmp);
+    if (!s.ok()) {
+      timeline.ActivityEndAll(response.tensor_names());
+      return s;
+    }
+    std::memcpy(e.output, work.data() + offsets[rank] * elem,
+                static_cast<std::size_t>(counts[rank]) * elem);
+    if (e.postscale_factor != 1.0) {
+      ScaleBuffer(e.output, counts[rank], e.dtype, e.postscale_factor);
+    }
+  }
+  timeline.ActivityEndAll(response.tensor_names());
+  return Status::OK();
 }
 
 bool CpuRingAllgather::Enabled(const std::vector<TensorTableEntry>& entries,

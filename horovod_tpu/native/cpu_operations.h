@@ -64,6 +64,24 @@ class CpuHierarchicalAllreduce : public CpuRingAllreduce {
   }
 };
 
+// Standalone reduce-scatter (docs/ZERO.md): the ring's reduce-scatter
+// leg as a first-class negotiated op. Rank r's (shard-sized) output
+// buffer receives logical chunk r of the flattened tensor's
+// PartitionChunks partition, summed across ranks; wire compression
+// applies per hop unchanged.
+class CpuRingReduceScatter : public ReduceScatterOp {
+ public:
+  CpuRingReduceScatter(TcpContext& ctx, HorovodGlobalState* state)
+      : ReduceScatterOp(state), ctx_(ctx) {}
+  bool Enabled(const std::vector<TensorTableEntry>& entries,
+               const Response& response) const override;
+  Status Execute(std::vector<TensorTableEntry>& entries,
+                 const Response& response) override;
+
+ protected:
+  TcpContext& ctx_;
+};
+
 class CpuRingAllgather : public AllgatherOp {
  public:
   CpuRingAllgather(TcpContext& ctx, HorovodGlobalState* state)

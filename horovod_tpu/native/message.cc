@@ -48,6 +48,7 @@ const char* Request::RequestTypeName(RequestType t) {
     case ALLREDUCE: return "ALLREDUCE";
     case ALLGATHER: return "ALLGATHER";
     case BROADCAST: return "BROADCAST";
+    case REDUCESCATTER: return "REDUCESCATTER";
   }
   return "?";
 }
@@ -58,6 +59,7 @@ const char* Response::ResponseTypeName(ResponseType t) {
     case ALLGATHER: return "ALLGATHER";
     case BROADCAST: return "BROADCAST";
     case ERROR: return "ERROR";
+    case REDUCESCATTER: return "REDUCESCATTER";
   }
   return "?";
 }

@@ -38,6 +38,10 @@ class Request {
     ALLREDUCE = 0,
     ALLGATHER = 1,
     BROADCAST = 2,
+    // Sharded weight update (docs/ZERO.md): the reduce-scatter leg of the
+    // ring as a first-class negotiated op — each rank receives its own
+    // 1/N shard of the summed tensor instead of the full result.
+    REDUCESCATTER = 3,
   };
 
   static const char* RequestTypeName(RequestType t);
@@ -157,6 +161,8 @@ class Response {
     ALLGATHER = 1,
     BROADCAST = 2,
     ERROR = 3,
+    // Appended after ERROR so pre-sharded decoders keep their numbering.
+    REDUCESCATTER = 4,
   };
 
   static const char* ResponseTypeName(ResponseType t);

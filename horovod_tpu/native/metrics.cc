@@ -70,6 +70,8 @@ const char* SummaryFieldName(int field) {
     case SUM_NET_RING_BYTES_SENT: return "net_ring_bytes_sent_total";
     case SUM_DRAINS_REQUESTED: return "drains_requested_total";
     case SUM_DRAINING: return "draining";
+    case SUM_REDUCE_SCATTER: return "reduce_scatter_total";
+    case SUM_OPT_STATE_BYTES: return "opt_state_bytes";
   }
   return "unknown";
 }
@@ -106,6 +108,7 @@ void Metrics::Configure(int world_size_in, int rank_in) {
   rank.store(rank_in, std::memory_order_relaxed);
   queue_depth.store(0, std::memory_order_relaxed);
   pending_negotiation.store(0, std::memory_order_relaxed);
+  opt_state_bytes.store(-1, std::memory_order_relaxed);
   std::lock_guard<std::mutex> lk(rank_mutex_);
   is_coordinator_ = rank_in == 0;
   rank_lag_seconds_.assign(world_size_in, 0.0);
@@ -163,6 +166,8 @@ std::vector<double> Metrics::Summary() const {
   v[SUM_DRAINS_REQUESTED] =
       static_cast<double>(drains_requested_total.load());
   v[SUM_DRAINING] = static_cast<double>(draining.load());
+  v[SUM_REDUCE_SCATTER] = static_cast<double>(reduce_scatter_total.load());
+  v[SUM_OPT_STATE_BYTES] = static_cast<double>(opt_state_bytes.load());
   return v;
 }
 
@@ -301,6 +306,10 @@ std::string Metrics::SnapshotJson() const {
            ckpt_restore_failures_total.load(), &first);
   AppendKV(&out, "drains_requested_total", drains_requested_total.load(),
            &first);
+  AppendKV(&out, "reduce_scatter_total", reduce_scatter_total.load(),
+           &first);
+  AppendKV(&out, "reduce_scatter_bytes_total",
+           reduce_scatter_bytes_total.load(), &first);
   out.append("},\"gauges\":{");
   first = true;
   AppendKV(&out, "queue_depth", static_cast<double>(queue_depth.load()),
@@ -317,6 +326,8 @@ std::string Metrics::SnapshotJson() const {
   AppendKV(&out, "last_durable_step",
            static_cast<double>(last_durable_step.load()), &first);
   AppendKV(&out, "draining", static_cast<double>(draining.load()), &first);
+  AppendKV(&out, "opt_state_bytes",
+           static_cast<double>(opt_state_bytes.load()), &first);
   out.append("},\"histograms\":{");
   first = true;
   AppendHistogram(&out, "cycle_seconds", cycle_seconds, &first);

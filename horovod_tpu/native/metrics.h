@@ -99,12 +99,17 @@ enum SummaryField : int {
   SUM_COMPRESSION_BYTES_IN,
   SUM_COMPRESSION_BYTES_OUT,
   SUM_NET_RING_BYTES_SENT,
-  // Graceful drain (docs/FLEET.md). Appended last, same
-  // forward-compatibility rule: drain requests this worker honored and
-  // whether it is currently draining (1) / surviving a peer's drain (0)
-  // / has never seen one (-1).
+  // Graceful drain (docs/FLEET.md). Appended after the compression
+  // fields, same forward-compatibility rule: drain requests this worker
+  // honored and whether it is currently draining (1) / surviving a
+  // peer's drain (0) / has never seen one (-1).
   SUM_DRAINS_REQUESTED,
   SUM_DRAINING,
+  // Sharded weight update (docs/ZERO.md). Appended last: executed
+  // reduce-scatter collectives and this rank's reported optimizer-state
+  // bytes (-1 = never reported); older decoders ignore the tail.
+  SUM_REDUCE_SCATTER,
+  SUM_OPT_STATE_BYTES,
   SUM_FIELD_COUNT
 };
 const char* SummaryFieldName(int field);
@@ -173,6 +178,12 @@ class Metrics {
   // --- graceful drain (elastic/run.py via the C API; docs/FLEET.md) ---
   std::atomic<uint64_t> drains_requested_total{0};  // agreed drain epochs
 
+  // --- sharded weight update (cpu_operations.cc / docs/ZERO.md) ---
+  std::atomic<uint64_t> reduce_scatter_total{0};  // executed reduce-scatters
+  // Full-tensor payload bytes entering reduce-scatter executions (the
+  // shard each rank keeps is 1/N of this).
+  std::atomic<uint64_t> reduce_scatter_bytes_total{0};
+
   // --- gauges (instantaneous; reset per generation) ---
   std::atomic<int64_t> queue_depth{0};
   std::atomic<int64_t> pending_negotiation{0};
@@ -189,6 +200,10 @@ class Metrics {
   // exit), 0 = it survived a peer's drain. Survives Configure() like
   // last_durable_step — a post-drain re-init does not erase history.
   std::atomic<int64_t> draining{-1};
+  // Optimizer-state bytes held by THIS rank, reported by the sharded
+  // optimizer wrappers (docs/ZERO.md; -1 = never reported). Reset per
+  // generation: an elastic resize re-shards the state and re-reports.
+  std::atomic<int64_t> opt_state_bytes{-1};
 
   // --- histograms ---
   MetricHistogram cycle_seconds;        // background work-cycle duration

@@ -393,9 +393,12 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
       std::make_shared<CpuRingAllgather>(state.tcp_context, &state)};
   std::vector<std::shared_ptr<BroadcastOp>> broadcast_ops = {
       std::make_shared<CpuBroadcast>(state.tcp_context, &state)};
+  std::vector<std::shared_ptr<ReduceScatterOp>> reducescatter_ops = {
+      std::make_shared<CpuRingReduceScatter>(state.tcp_context, &state)};
   state.op_manager = std::make_unique<OperationManager>(
       std::move(allreduce_ops), std::move(allgather_ops),
-      std::move(broadcast_ops), std::make_shared<ErrorOp>(&state));
+      std::move(broadcast_ops), std::move(reducescatter_ops),
+      std::make_shared<ErrorOp>(&state));
 
   state.initialization_done.store(true);
   LOG(DEBUG) << "background loop starting";
@@ -772,6 +775,42 @@ int horovod_tpu_effective_compression(int compression, int dtype) {
 int64_t horovod_tpu_compressed_size(int64_t count, int compression) {
   return static_cast<int64_t>(CompressedSize(
       count, static_cast<CompressionMode>(compression)));
+}
+
+// Reduce-scatter enqueue (docs/ZERO.md): `output` must hold this rank's
+// shard — PartitionChunks over the flattened element count (chunk r to
+// rank r; Python mirrors the math in common/ops.py shard_partition).
+// Compression rides the negotiation exactly like allreduce.
+int horovod_tpu_enqueue_reduce_scatter(const char* name, const void* data,
+                                       void* output, int ndim,
+                                       const int64_t* shape, int dtype,
+                                       double prescale, double postscale,
+                                       int compression) {
+  int handle = g_handles.AllocateHandle();
+  Status s = EnqueueTensor(Request::REDUCESCATTER, name, data, output, ndim,
+                           shape, dtype, 0, prescale, postscale, compression,
+                           handle);
+  if (!s.ok()) {
+    g_handles.MarkDone(handle, s);
+  }
+  return handle;
+}
+
+// The HVD_TPU_SHARDED_UPDATE job default, read fresh each call (the
+// negotiation validates the mode cross-rank anyway).
+int horovod_tpu_sharded_update_default() {
+  const char* v = std::getenv(HVD_TPU_SHARDED_UPDATE_ENV);
+  return v != nullptr && std::strtol(v, nullptr, 10) != 0 ? 1 : 0;
+}
+
+// Sharded-optimizer accounting (docs/ZERO.md): the absolute number of
+// optimizer-state bytes this rank holds (gauge; < 0 leaves it
+// unchanged). Reported by the framework wrappers on init and resize so
+// the memory claim is observable (hvd-top, bench A/B).
+void horovod_tpu_opt_state_metrics(int64_t bytes) {
+  if (bytes >= 0) {
+    GlobalMetrics().opt_state_bytes.store(bytes, std::memory_order_relaxed);
+  }
 }
 
 int horovod_tpu_enqueue_allgather(const char* name, const void* data, int ndim,

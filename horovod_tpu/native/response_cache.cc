@@ -36,9 +36,17 @@ ResponseCache::CacheState ResponseCache::cached(const Request& request) const {
               e.prescale_factor == request.prescale_factor() &&
               e.postscale_factor == request.postscale_factor() &&
               e.compression == request.compression();
-  // Response type must match the request type too.
-  same = same && static_cast<int>(e.response.response_type()) ==
-                     static_cast<int>(request.request_type());
+  // Response type must match the request type too. The two enums agree
+  // numerically for allreduce/allgather/broadcast but diverge at
+  // REDUCESCATTER (Response appends it AFTER ERROR for wire
+  // compatibility, Request has no ERROR) — map before comparing, or a
+  // cached reduce-scatter could never hit.
+  int cached_as_request =
+      e.response.response_type() == Response::REDUCESCATTER
+          ? static_cast<int>(Request::REDUCESCATTER)
+          : static_cast<int>(e.response.response_type());
+  same = same &&
+         cached_as_request == static_cast<int>(request.request_type());
   return same ? CacheState::HIT : CacheState::INVALID;
 }
 

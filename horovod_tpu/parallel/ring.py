@@ -493,23 +493,7 @@ def ring_allreduce(x, axis_name, compression="none"):
     c = -(-c // _comp.BLOCK) * _comp.BLOCK
     chunks = jnp.pad(flat, (0, n * c - flat.size)).reshape(n, c)
     perm = [(j, (j + 1) % n) for j in range(n)]
-
-    def enc(v):
-        if mode.mode == _comp.BF16:
-            return (v.astype(jnp.bfloat16),)
-        if mode.mode == _comp.INT8:
-            return _comp.quantize_int8_jax(v)
-        return (v,)
-
-    def dec(payload):
-        if mode.mode == _comp.BF16:
-            return payload[0].astype(jnp.float32)
-        if mode.mode == _comp.INT8:
-            return _comp.dequantize_int8_jax(*payload)
-        return payload[0]
-
-    def ship(payload):
-        return tuple(lax.ppermute(p, axis_name, perm) for p in payload)
+    enc, dec, ship = _ring_codec(mode)
 
     # Reduce-scatter: after n-1 hops this rank's chunk (idx+1)%n holds
     # the full sum. Each hop requantizes the freshly-reduced outgoing
@@ -517,7 +501,8 @@ def ring_allreduce(x, axis_name, compression="none"):
     def rs_body(s, chunks):
         send_i = (idx - s) % n
         recv_i = (idx - s - 1) % n
-        incoming = ship(enc(jnp.take(chunks, send_i, axis=0)))
+        incoming = ship(enc(jnp.take(chunks, send_i, axis=0)), axis_name,
+                        perm)
         upd = jnp.take(chunks, recv_i, axis=0) + dec(incoming)
         return lax.dynamic_update_index_in_dim(chunks, upd, recv_i, 0)
 
@@ -535,7 +520,7 @@ def ring_allreduce(x, axis_name, compression="none"):
         recv_i = (idx - s) % n
         # ppermute first: the transfer of this hop's payload and the
         # decode of the previous hop's chunk have no data dependence.
-        incoming = ship(payload)
+        incoming = ship(payload, axis_name, perm)
         chunks = lax.dynamic_update_index_in_dim(chunks, dec(incoming),
                                                  recv_i, 0)
         return chunks, incoming
@@ -543,6 +528,126 @@ def ring_allreduce(x, axis_name, compression="none"):
     chunks, _ = lax.fori_loop(0, n - 1, ag_body, (chunks, payload))
     out = chunks.reshape(-1)[:flat.size]
     return out.reshape(orig_shape).astype(orig_dtype)
+
+
+def _ring_codec(mode):
+    """(enc, dec, ship) hop codec triple shared by the ring collectives
+    (one definition so the allreduce and the split-out reduce-scatter /
+    allgather legs cannot disagree on the wire format)."""
+    from horovod_tpu import compression as _comp
+
+    def enc(v):
+        if mode.mode == _comp.BF16:
+            return (v.astype(jnp.bfloat16),)
+        if mode.mode == _comp.INT8:
+            return _comp.quantize_int8_jax(v)
+        return (v,)
+
+    def dec(payload):
+        if mode.mode == _comp.BF16:
+            return payload[0].astype(jnp.float32)
+        if mode.mode == _comp.INT8:
+            return _comp.dequantize_int8_jax(*payload)
+        return payload[0]
+
+    def ship(payload, axis_name, perm):
+        return tuple(lax.ppermute(p, axis_name, perm) for p in payload)
+
+    return enc, dec, ship
+
+
+def ring_reduce_scatter(x, axis_name, compression="none"):
+    """Reduce-scatter leg of the ring as a standalone collective
+    (docs/ZERO.md): flattens `x`, splits it into one chunk per rank
+    (padded so chunks are equal and int8-block-aligned), and after n-1
+    ppermute hops returns THIS rank's chunk of the cross-axis SUM — a
+    1-D f32 array of ``ceil(size/n)`` (block-rounded) elements. Chunk r
+    belongs to axis index r, so ``ring_allgather`` of per-rank results
+    reassembles the full vector in order.
+
+    Wire compression ('bf16'/'int8') encodes each hop's payload exactly
+    like :func:`ring_allreduce`'s first phase — the accumulator stays
+    f32. The chunk length is ``ceil(ceil(size/n)/BLOCK)*BLOCK`` (the
+    int8 block padding applies in every mode so a mode change never
+    changes shard shapes). With n == 1 returns the (padded) flat vector
+    unchanged.
+    """
+    from horovod_tpu import compression as _comp
+
+    mode = _comp.resolve(compression)
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    if mode.mode != _comp.NONE and x.dtype != jnp.float32:
+        mode = _comp.Compression.none
+    work_dtype = jnp.float32 if mode.mode != _comp.NONE else x.dtype
+    flat = x.astype(work_dtype).reshape(-1)
+    c = -(-flat.size // n)
+    c = -(-c // _comp.BLOCK) * _comp.BLOCK
+    if n == 1:
+        return jnp.pad(flat, (0, c - flat.size))
+    chunks = jnp.pad(flat, (0, n * c - flat.size)).reshape(n, c)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    enc, dec, ship = _ring_codec(mode)
+
+    # The allreduce's schedule (send (idx-s), recv (idx-s-1)) leaves
+    # rank r owning chunk (r+1)%n; shifting every chunk index by -1
+    # leaves rank r owning chunk r — rank order == chunk order, so the
+    # matching ring_allgather reassembles the vector without a permute.
+    def body(s, chunks):
+        send_i = (idx - s - 1) % n
+        recv_i = (idx - s - 2) % n
+        incoming = ship(enc(jnp.take(chunks, send_i, axis=0)), axis_name,
+                        perm)
+        upd = jnp.take(chunks, recv_i, axis=0) + dec(incoming)
+        return lax.dynamic_update_index_in_dim(chunks, upd, recv_i, 0)
+
+    chunks = lax.fori_loop(0, n - 1, body, chunks)
+    return jnp.take(chunks, idx, axis=0)
+
+
+def ring_allgather(x, axis_name, compression="none"):
+    """Allgather leg of the ring as a standalone collective
+    (docs/ZERO.md): every rank contributes an equal-shape 1-D shard
+    (axis index r's shard is chunk r) and receives the concatenation of
+    all of them — the parameter leg of the sharded weight update, where
+    XLA can overlap each hop's ppermute with downstream compute on
+    already-received chunks.
+
+    With compression, each owner encodes its shard ONCE and decodes its
+    own copy back, and the encoded payload travels the ring VERBATIM —
+    every rank ends with bitwise-identical values (the allreduce's
+    second phase, unchanged). Parameters usually ride 'none': the
+    updated weights are the values every rank must agree on exactly.
+    """
+    from horovod_tpu import compression as _comp
+
+    mode = _comp.resolve(compression)
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    if mode.mode != _comp.NONE and x.dtype != jnp.float32:
+        mode = _comp.Compression.none
+    if n == 1:
+        return x.reshape(-1)
+    c = x.size
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    enc, dec, ship = _ring_codec(mode)
+    chunks = jnp.zeros((n, c), x.dtype if mode.mode == _comp.NONE
+                       else jnp.float32)
+    payload = enc(x.reshape(-1).astype(chunks.dtype))
+    chunks = lax.dynamic_update_index_in_dim(chunks, dec(payload), idx, 0)
+
+    def body(s, carry):
+        chunks, payload = carry
+        recv_i = (idx - s - 1) % n
+        # ppermute first: the transfer and the previous chunk's decode
+        # have no data dependence, so XLA overlaps them.
+        incoming = ship(payload, axis_name, perm)
+        chunks = lax.dynamic_update_index_in_dim(chunks, dec(incoming),
+                                                 recv_i, 0)
+        return chunks, incoming
+
+    chunks, _ = lax.fori_loop(0, n - 1, body, (chunks, payload))
+    return chunks.reshape(-1)
 
 
 def ulysses_attention(q, k, v, axis_name, causal=True, scale=None,

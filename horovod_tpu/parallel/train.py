@@ -59,8 +59,12 @@ def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
       optimizer: an optax GradientTransformation (unwrapped — the
         allreduce wrapping happens here).
       mesh: a 1-D `jax.sharding.Mesh` over `axis_name`.
-      compression: optional `hvd_jax.Compression` codec for gradients
-        (plain path only; incompatible with zero1).
+      compression: optional gradient compression. Wire modes
+        ('bf16'/'int8'/`horovod_tpu.compression` modes) work on BOTH
+        paths — under zero1 the gradient scatter runs the explicit
+        compressed ring (``ring_reduce_scatter``) while the parameter
+        allgather stays exact. Legacy tensor codecs
+        (``hvd_jax.Compression.fp16``) are plain-path only.
       donate: donate params/opt_state buffers (in-place update on TPU).
       zero1: ZeRO-stage-1 optimizer-state sharding. Gradients are
         reduce_scattered over the mesh (each device averages 1/n of
@@ -90,16 +94,18 @@ def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
     opt_state is replicated (plain) or dim-0-sharded (zero1).
     """
     from horovod_tpu import compression as _wire
-    # Explicitly-requested compression + zero1 is a contradiction (the
-    # scatter path is uncompressed); compression=None stays None so the
-    # HVD_TPU_COMPRESSION default can engage on the plain path.
-    explicit_none = compression is hvd_jax.Compression.none or (
-        compression is not None and
-        not hasattr(compression, "compress") and
-        _wire.resolve(compression) == _wire.Compression.none)
-    if zero1 and compression is not None and not explicit_none:
-        raise ValueError("zero1 and gradient compression are mutually "
-                         "exclusive (the scatter path is uncompressed)")
+    # zero1 + WIRE compression composes: the gradient scatter runs the
+    # explicit ring_reduce_scatter with the codec fused per hop (f32
+    # accumulation), and the parameter allgather stays uncompressed so
+    # every rank agrees on the updated weights exactly (docs/ZERO.md).
+    # Legacy tensor codecs (cast-the-tensor) stay rejected under zero1
+    # — they would change the dtype the shard-local optimizer sees —
+    # except the no-op Compression.none codec (replicated-era call
+    # sites); the shared resolve_wire_arg keeps this in lockstep with
+    # the three DistributedOptimizer wrappers.
+    zero1_mode = _wire.resolve_wire_arg(
+        compression, hvd_jax.Compression.none) \
+        if zero1 else _wire.Compression.none
     # Library helper, not a training script: the caller owns the initial
     # parameter sync (place() replicates params over the mesh, and host
     # checkpoint restore broadcasts before entering the step).
@@ -111,9 +117,15 @@ def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
     def _flat_pad(x):
         # Dtype preserved: the shard-local update must apply the same
         # arithmetic the plain path would (f32 master copies are the
-        # caller's choice via param dtype, not imposed here).
+        # caller's choice via param dtype, not imposed here). Under wire
+        # compression shards additionally pad to the int8 block so the
+        # grad scatter (ring_reduce_scatter) and the param slicing agree
+        # on chunk boundaries.
         v = jnp.ravel(x)
-        pad = (-v.size) % n_shards
+        unit = n_shards
+        if zero1_mode != _wire.Compression.none:
+            unit = n_shards * _wire.BLOCK
+        pad = (-v.size) % unit
         return jnp.pad(v, (0, pad)) if pad else v
 
     def _local_loss_and_grads(params, batch):
@@ -143,6 +155,16 @@ def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
             idx = jax.lax.axis_index(axis_name)
 
             def scatter(g):
+                if zero1_mode != _wire.Compression.none:
+                    # Compressed scatter: the explicit ppermute ring with
+                    # quant/dequant fused per hop (f32 accumulation);
+                    # _flat_pad already block-aligned the input so the
+                    # ring's chunk == my_slice's chunk.
+                    from horovod_tpu.parallel.ring import \
+                        ring_reduce_scatter
+                    return ring_reduce_scatter(
+                        _flat_pad(g), axis_name,
+                        compression=zero1_mode) / n_shards
                 v = jax.lax.psum_scatter(_flat_pad(g), axis_name,
                                          scatter_dimension=0, tiled=True)
                 return v / n_shards

@@ -103,6 +103,10 @@ _COLUMNS = [
     ("drn", 5, _int_field("drains_requested_total")),
     # Wire compression factor (codec bytes in / wire bytes out).
     ("cmp", 6, _cmp_ratio),
+    # Sharded weight update (docs/ZERO.md): reduce-scatter collectives
+    # this worker executed (0 = replicated mode; '-' = the worker
+    # predates the field).
+    ("shd", 6, _int_field("reduce_scatter_total")),
     ("lag_s", 9, lambda cur, prev, dt, ctx: "%.2f" % ctx["lag_total"]),
 ]
 

@@ -194,9 +194,124 @@ class DistributedGradientTape(tf.GradientTape):
         return tf.nest.pack_sequence_as(grads, reduced)
 
 
+def _make_sharded_keras(optimizer, average, compression):
+    """ZeRO-style sharded weight update for Keras-3 optimizers
+    (docs/ZERO.md), eager-only: gradients flatten into one fused f32
+    buffer, reduce-scatter delivers this rank's 1/N shard, an INNER
+    optimizer of the same class (rebuilt ``from_config``) updates ONE
+    flat shard variable — so its slots (momentum/Adam moments) cover
+    1/N of the elements — and the updated shard allgathers back into
+    the real variables.
+
+    Variables become OPTIMIZER-OWNED after the first
+    ``apply_gradients()``: the flat shard variable seeded then is the
+    master copy, and every step's allgather ``assign()``s the real
+    variables from it — an external ``v.assign(...)`` between steps is
+    silently reverted by the next allgather. To adopt externally-set
+    values, rebuild the wrapper (docs/ZERO.md). ``None`` gradients ride
+    the dense flat buffer as zeros (stateful optimizers still decay
+    their moments); every call must pass the SAME variable list that
+    built the shard layout — do not filter out None-grad pairs."""
+    import numpy as np
+
+    from horovod_tpu import compression as _wire
+    from horovod_tpu.common.ops import shard_partition
+
+    mode = _wire.resolve_wire_arg(compression, Compression.none)
+    base = optimizer.__class__
+
+    class _Sharded(base):
+        _HVD_WRAPPED = True
+        _HVD_SHARDED = True
+
+        def _hvd_build_shard(self, variables):
+            n, r = size(), rank()
+            total = sum(int(np.prod(v.shape)) for v in variables)
+            counts, offsets = shard_partition(total, n)
+            flat = np.concatenate(
+                [np.asarray(v).ravel().astype(np.float32)
+                 for v in variables])
+            self._hvd_vars = list(variables)
+            self._hvd_total = total
+            self._hvd_shard_var = tf.Variable(
+                flat[offsets[r]:offsets[r] + counts[r]],
+                trainable=False, name="hvd_shard")
+            self._hvd_inner = base.from_config(self.get_config())
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            if not tf.executing_eagerly():
+                raise RuntimeError(
+                    "sharded_update runs the host data plane eagerly; "
+                    "call apply_gradients outside tf.function (or use "
+                    "the jax binding for in-XLA sharded updates)")
+            gvs = list(grads_and_vars)
+            variables = [v for _, v in gvs]
+            if not hasattr(self, "_hvd_shard_var"):
+                self._hvd_build_shard(variables)
+            else:
+                # Mirror a dynamically-assigned learning rate onto the
+                # inner shard optimizer (schedule objects already ride
+                # from_config and advance in lockstep; .assign raises
+                # on a schedule and is skipped).
+                try:
+                    self._hvd_inner.learning_rate.assign(
+                        self.learning_rate)
+                except (AttributeError, TypeError, ValueError):
+                    pass
+            if hasattr(self, "_hvd_vars") and \
+                    [id(v) for v in variables] != \
+                    [id(v) for v in self._hvd_vars]:
+                # The shard layout (offsets, shard variable, inner
+                # slots) was built from the FIRST call's variable list;
+                # a filtered/reordered list would flatten a different
+                # buffer and allgather segments back to the wrong
+                # variables. Keep None grads in the list (they ride as
+                # zeros) instead of filtering them out.
+                raise RuntimeError(
+                    "sharded_update apply_gradients got a different "
+                    "variable list than the first call that built the "
+                    "shard layout (%d vars vs %d, or reordered); pass "
+                    "the same variables in the same order every step "
+                    "(docs/ZERO.md)"
+                    % (len(variables), len(self._hvd_vars)))
+            flat_g = np.concatenate([
+                (np.zeros(int(np.prod(v.shape)), np.float32)
+                 if g is None else
+                 np.asarray(tf.convert_to_tensor(g))
+                 .ravel().astype(np.float32))
+                for g, v in gvs])
+            # Name matches the replicated wrapper's first per-variable
+            # allreduce ("opt_grad.0") so mixed sharded/replicated
+            # ranks collide at negotiation and are rejected naming both
+            # ranks and modes (docs/ZERO.md).
+            shard = _ops.reduce_scatter(flat_g, "opt_grad.0",
+                                        average=average,
+                                        compression=mode)
+            self._hvd_inner.apply_gradients(
+                [(tf.convert_to_tensor(shard), self._hvd_shard_var)])
+            full = np.asarray(_ops.allgather(
+                np.asarray(self._hvd_shard_var), "opt_grad.param_ag"))
+            off = 0
+            for v in variables:
+                cnt = int(np.prod(v.shape))
+                v.assign(tf.cast(tf.reshape(full[off:off + cnt],
+                                            v.shape), v.dtype))
+                off += cnt
+            # Keras-3 variables report dtype as a string; tf.as_dtype
+            # accepts both forms.
+            nbytes = sum(
+                int(np.prod(w.shape)) * tf.as_dtype(w.dtype).size
+                for w in self._hvd_inner.variables)
+            _hvd.get_basics().opt_state_metrics(nbytes)
+            return self.iterations.assign_add(1)
+
+    cls = type("ShardedDistributed%s" % base.__name__, (_Sharded,), {})
+    return cls.from_config(optimizer.get_config())
+
+
 def DistributedOptimizer(optimizer, average=True,
                          compression=Compression.none,
-                         sparse_as_dense=False):
+                         sparse_as_dense=False, sharded_update=None):
     """Wraps an optimizer so gradients are averaged across ranks before
     being applied (reference: tensorflow/__init__.py:231-319).
 
@@ -204,10 +319,23 @@ def DistributedOptimizer(optimizer, average=True,
     allreduces first. TF1 ``tf.compat.v1.train.Optimizer`` instances
     (the estimator-era API, reference tensorflow/__init__.py:186-240)
     get a wrapping v1 optimizer whose ``compute_gradients`` allreduces
-    — so ``minimize()`` inside a session graph trains data-parallel."""
+    — so ``minimize()`` inside a session graph trains data-parallel.
+
+    ``sharded_update=True`` (job-wide: ``HVD_TPU_SHARDED_UPDATE=1``)
+    switches Keras-3 optimizers to the ZeRO-style sharded weight update
+    (docs/ZERO.md): reduce-scatter gradients, shard-local update (slot
+    memory drops N-fold), allgather updated params. Eager-only; not
+    supported for v1 optimizers."""
+    if sharded_update is None:
+        sharded_update = _ops.sharded_update_default()
     if isinstance(optimizer, tf.compat.v1.train.Optimizer):
+        if sharded_update:
+            raise ValueError("sharded_update is not supported for "
+                             "tf.compat.v1 optimizers")
         return _DistributedV1Optimizer(optimizer, average, compression,
                                        sparse_as_dense)
+    if sharded_update:
+        return _make_sharded_keras(optimizer, average, compression)
 
     base = optimizer.__class__
 

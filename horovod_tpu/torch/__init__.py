@@ -560,13 +560,235 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         return super(self.__class__, self).zero_grad(*args, **kwargs)
 
 
+class _ShardedOptimizer(torch.optim.Optimizer):
+    """ZeRO-style sharded weight update (docs/ZERO.md): per parameter
+    group, gradients are flattened into one fused buffer and
+    reduce-scattered (the ring's reduce-scatter leg — same wire bytes
+    as the allreduce it replaces), an INNER optimizer of the wrapped
+    class applies the update to this rank's 1/N flat shard (so
+    momentum/Adam state is held for 1/N of the elements), and updated
+    parameter shards are allgathered back into the real parameters.
+
+    Numerically identical to the replicated wrapper for ELEMENTWISE
+    optimizers (SGD/momentum/Adam/AdamW...); optimizers that couple
+    elements across a parameter (e.g. per-tensor LARS trust ratios) see
+    flat shards instead of whole tensors. The inner state is RANK-LOCAL
+    — ``self.state`` on this wrapper stays empty by design; reading
+    shard moments as if they were global is exactly what hvd-lint's
+    ``sharded-update-rank-local-param-read`` flags.
+
+    Parameters become OPTIMIZER-OWNED after the first ``step()``: the
+    f32 flat shard captured then is the master copy, and every step's
+    allgather overwrites the parameters from it — external parameter
+    mutation between steps (weight clamping, ``load_state_dict`` on the
+    model, re-tying) is silently reverted by the next allgather. To
+    adopt externally-set values, rebuild the wrapper (or restore
+    through ITS ``state_dict()`` contract, docs/ZERO.md).
+
+    A parameter whose gradient is ``None`` this step rides the dense
+    flat buffer as ZEROS (the shard partition is static), so stateful
+    optimizers still decay its moments — unlike plain torch's skip.
+    Freeze parameters BEFORE constructing the wrapper to exclude them
+    (docs/ZERO.md)."""
+
+    def __init__(self, params, named_parameters, compression=None,
+                 backward_passes_per_step=1):
+        super(self.__class__, self).__init__(params)
+        from horovod_tpu import compression as _wire
+        if backward_passes_per_step != 1:
+            raise ValueError("sharded_update does not support "
+                             "backward_passes_per_step > 1")
+        self._hvd_mode = _wire.resolve_wire_arg(compression,
+                                                Compression.none)
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [("allreduce.noname.%s" % i, v)
+                     for param_group in self.param_groups
+                     for i, v in enumerate(param_group["params"])]
+        self._hvd_param_names = {id(v): k for k, v in named}
+        self._hvd_built = False
+
+    def _hvd_build(self):
+        """Builds the flat shard parameters and the inner optimizer
+        lazily (so the wrapper sees the params' CURRENT values, e.g.
+        after broadcast_parameters)."""
+        from horovod_tpu.common.ops import shard_partition
+        n, r = _hvd.size(), _hvd.rank()
+        base_cls = type(self).__mro__[1]
+        self._hvd_meta = []
+        self._hvd_names = []
+        shard_groups = []
+        for group in self.param_groups:
+            ps = [p for p in group["params"] if p.requires_grad]
+            total = sum(p.numel() for p in ps)
+            counts, offsets = shard_partition(max(total, 1), n)
+            if ps:
+                flat = torch.cat(
+                    [p.detach().reshape(-1).float() for p in ps])
+                sp = flat[offsets[r]:offsets[r] + counts[r]].clone()
+            else:
+                sp = torch.zeros(0)
+            self._hvd_meta.append((ps, total, counts, offsets, sp))
+            # Grad tensor name = the replicated wrapper's name for the
+            # group's FIRST parameter: a sharded rank meeting a
+            # replicated peer then collides at negotiation and the
+            # coordinator rejects the op naming both ranks and modes
+            # (docs/ZERO.md) instead of hanging.
+            first = ps[0] if ps else None
+            self._hvd_names.append(
+                "allreduce.%s" % self._hvd_param_names.get(
+                    id(first), "grad.%d" % id(first)))
+            g = {k: v for k, v in group.items() if k != "params"}
+            g["params"] = [sp]
+            shard_groups.append(g)
+        self._hvd_inner = base_cls(shard_groups)
+        self._hvd_built = True
+
+    def _hvd_report_state_bytes(self):
+        total = 0
+        for st in self._hvd_inner.state.values():
+            for v in st.values():
+                if torch.is_tensor(v):
+                    total += v.numel() * v.element_size()
+        _hvd.get_basics().opt_state_metrics(total)
+
+    def state_dict(self):
+        """The wrapper's own state is empty by design; the REAL moments
+        live on the inner flat-shard optimizer. Fold them (plus the
+        shard parameter values and the (rank, world) they were built
+        for) into the dict so a save/load round-trip preserves them
+        instead of silently resetting every moment to zero."""
+        import copy
+        if not self._hvd_built:
+            self._hvd_build()
+        d = super(self.__class__, self).state_dict()
+        # deepcopy: torch's Optimizer.state_dict() references LIVE state
+        # tensors and load_state_dict() only shallow-copies (its float
+        # cast `.to(same dtype)` returns the same tensor), so without a
+        # snapshot here the restored optimizer's moments would alias the
+        # saver's and every subsequent step would mutate both.
+        d["hvd_sharded"] = {
+            "world": _hvd.size(), "rank": _hvd.rank(),
+            "inner": copy.deepcopy(self._hvd_inner.state_dict()),
+            "shards": [sp.detach().clone()
+                       for (_, _, _, _, sp) in self._hvd_meta],
+        }
+        return d
+
+    def load_state_dict(self, state_dict):
+        state_dict = dict(state_dict)
+        sharded = state_dict.pop("hvd_sharded", None)
+        super(self.__class__, self).load_state_dict(state_dict)
+        if sharded is None:
+            raise ValueError(
+                "this state_dict has no sharded-optimizer state (saved "
+                "by a replicated optimizer?); sharded_update cannot "
+                "restore it (docs/ZERO.md)")
+        if sharded["world"] != _hvd.size() or \
+                sharded["rank"] != _hvd.rank():
+            raise RuntimeError(
+                "sharded optimizer state_dict was saved by rank %d of "
+                "%d but this process is rank %d of %d; torch shard "
+                "state is rank-local — restore at the same membership "
+                "(for cross-world restores ride the jax "
+                "sharded_state_full/sharded_state_shard contract, "
+                "docs/ZERO.md)"
+                % (sharded["rank"], sharded["world"], _hvd.rank(),
+                   _hvd.size()))
+        if not self._hvd_built:
+            self._hvd_build()
+        import copy
+        self._hvd_inner.load_state_dict(copy.deepcopy(sharded["inner"]))
+        with torch.no_grad():
+            for (_, _, _, _, sp), saved in zip(self._hvd_meta,
+                                               sharded["shards"]):
+                sp.copy_(saved)
+
+    def step(self, closure=None):
+        import numpy as np
+        loss = None
+        if closure is not None:
+            loss = closure()
+        if not self._hvd_built:
+            self._hvd_build()
+        # LR schedulers (and manual tuning) mutate the WRAPPER's
+        # param_groups; mirror every hyperparameter onto the inner
+        # shard groups (1:1 by construction) or the shard update would
+        # run at the construction-time values forever.
+        for group, inner_group in zip(self.param_groups,
+                                      self._hvd_inner.param_groups):
+            for k, v in group.items():
+                if k != "params":
+                    inner_group[k] = v
+        # Reduce-scatter every group's fused flat gradient into the
+        # shard gradients (async: all groups negotiate/execute
+        # concurrently), update the shards, allgather them back.
+        scale = 1.0 / _hvd.size()
+        handles = []
+        for (ps, total, counts, offsets, sp), name in zip(
+                self._hvd_meta, self._hvd_names):
+            if not ps:
+                handles.append(None)
+                continue
+            flat_g = torch.cat([
+                (p.grad if p.grad is not None
+                 else torch.zeros_like(p)).detach().reshape(-1).float()
+                for p in ps])
+            handles.append(_ops.reduce_scatter_async(
+                flat_g.numpy(), name, postscale_factor=scale,
+                compression=self._hvd_mode))
+        for (_, _, _, _, sp), handle in zip(self._hvd_meta, handles):
+            if handle is None:
+                continue
+            shard = _ops.synchronize(handle)
+            sp.grad = torch.from_numpy(
+                np.ascontiguousarray(shard)).to(sp.dtype)
+        self._hvd_inner.step()
+        handles = []
+        for (ps, _, _, _, sp), name in zip(self._hvd_meta,
+                                           self._hvd_names):
+            handles.append(_ops.allgather_async(
+                sp.detach().numpy(), name + ".param_ag")
+                if ps else None)
+        for (ps, total, counts, offsets, sp), handle in zip(
+                self._hvd_meta, handles):
+            if handle is None:
+                continue
+            full = _ops.synchronize(handle)
+            full_t = torch.from_numpy(np.ascontiguousarray(full))
+            off = 0
+            with torch.no_grad():
+                for p in ps:
+                    p.copy_(full_t[off:off + p.numel()]
+                            .reshape(p.shape).to(p.dtype))
+                    off += p.numel()
+        self._hvd_report_state_bytes()
+        return loss
+
+    def zero_grad(self, *args, **kwargs):
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
-                         backward_passes_per_step=1):
+                         backward_passes_per_step=1,
+                         sharded_update=None):
     """Wraps `optimizer` into a gradient-averaging distributed optimizer
     (reference: torch/__init__.py DistributedOptimizer factory — dynamic
-    subclass so isinstance(opt, type(optimizer)) keeps working)."""
+    subclass so isinstance(opt, type(optimizer)) keeps working).
+
+    ``sharded_update=True`` (job-wide: ``HVD_TPU_SHARDED_UPDATE=1``)
+    switches to the ZeRO-style sharded weight update — reduce-scatter
+    gradients, apply the optimizer to this rank's 1/N shard (optimizer
+    state shrinks N-fold), allgather updated params (docs/ZERO.md).
+    ``compression`` is then a wire mode ('none'/'bf16'/'int8'), and
+    mixed sharded/replicated ranks are rejected at negotiation."""
+    if sharded_update is None:
+        sharded_update = _ops.sharded_update_default()
+    base = (_ShardedOptimizer if sharded_update
+            else _DistributedOptimizer)
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
-               dict(_DistributedOptimizer.__dict__))
+               dict(base.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step)

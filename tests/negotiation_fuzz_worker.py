@@ -60,10 +60,18 @@ def main():
         state = elastic.ElasticState(
             w=np.arange(4096, dtype=np.float64) * (r + 1), step=0)
         state.enable_durable()  # HVD_TPU_CKPT_DIR
+    # HVD_TPU_FUZZ_SHARDED=1 (the sanitizer sharded-update variant,
+    # native/Makefile) folds reduce-scatter into the kind cycle: the
+    # standalone REDUCESCATTER op negotiates/executes concurrently with
+    # the other kinds from out-of-order user threads, with the
+    # compression codec (HVD_TPU_COMPRESSION) riding each hop. Constant
+    # fills quantize exactly, so the value assertions stay bit-strict.
+    kinds = ("allreduce", "allgather", "broadcast")
+    if os.environ.get("HVD_TPU_FUZZ_SHARDED") == "1":
+        kinds = ("allreduce", "allgather", "broadcast", "reduce_scatter")
     jobs = []
     for i in range(num_tensors):
-        kind = ("allreduce", "allgather", "broadcast")[i % 3]
-        jobs.append((i, kind))
+        jobs.append((i, kinds[i % len(kinds)]))
 
     for rnd in range(rounds):
         # Same job set, rank-specific enqueue order (reshuffled per round).
@@ -78,6 +86,10 @@ def main():
                 arr = np.full((idx + 1, 3), float(r + 1), np.float32)
                 handles[idx] = ("allreduce",
                                 ops.allreduce_async(arr, name))
+            elif kind == "reduce_scatter":
+                arr = np.full((idx + 1, 3), float(r + 1), np.float32)
+                handles[idx] = ("reduce_scatter",
+                                ops.reduce_scatter_async(arr, name))
             elif kind == "allgather":
                 # Rank-dependent fill so a permuted segment order is
                 # caught.
@@ -100,6 +112,11 @@ def main():
             if kind == "allreduce":
                 expected = sum(rr + 1 for rr in range(n))
                 assert out.shape == (idx + 1, 3), (idx, out.shape)
+                assert np.allclose(out, expected), (idx, out)
+            elif kind == "reduce_scatter":
+                expected = sum(rr + 1 for rr in range(n))
+                counts, _ = ops.shard_partition((idx + 1) * 3, n)
+                assert out.shape == (counts[r],), (idx, out.shape)
                 assert np.allclose(out, expected), (idx, out)
             elif kind == "allgather":
                 assert out.shape == (sum(rr + 1 for rr in range(n)), 2), \

@@ -1,0 +1,79 @@
+"""Mixed-execution-mode rejection (docs/ZERO.md): rank 0 runs the
+sharded update (reduce-scatter) while every other rank runs the
+replicated update (allreduce) on the SAME tensor name. The coordinator
+must reject the op with an error NAMING both ranks and both modes — on
+every rank, promptly, never a hang.
+
+Run: python -m horovod_tpu.run.run -np 2 -- python tests/sharded_mixed_worker.py
+"""
+
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import ops
+from horovod_tpu.common.ops import HorovodInternalError
+
+
+def _assert_mixed_error(msg):
+    assert "Mixed execution modes" in msg, msg
+    assert "sharded_update" in msg and "reduce-scatter" in msg, msg
+    assert "allreduce" in msg, msg
+    assert "rank 0" in msg and "rank 1" in msg, msg
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n >= 2
+    x = np.ones(100, np.float32)
+
+    # Raw collective level: the coordinator's type check fires.
+    try:
+        if r == 0:
+            ops.reduce_scatter(x, "mixed")
+        else:
+            ops.allreduce(x, "mixed")
+    except HorovodInternalError as e:
+        _assert_mixed_error(str(e))
+        print("rank %d: mixed-mode rejected naming both ranks and modes"
+              % r, flush=True)
+    else:
+        raise SystemExit("mixed sharded/replicated op unexpectedly "
+                         "succeeded")
+
+    # Optimizer level: a sharded DistributedOptimizer meeting a
+    # replicated one collides on the SAME first gradient name
+    # ("grad.0") by design, so the mismatch is caught at negotiation
+    # instead of hanging. Single-leaf params keep the replicated rank's
+    # pending set empty after the error.
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu import jax as hvd_jax
+
+    opt = hvd_jax.DistributedOptimizer(optax.sgd(0.1),
+                                       sharded_update=(r == 0))
+    params = {"w": jnp.ones(10, jnp.float32)}
+    state = opt.init(params)
+    grads = {"w": jnp.full(10, float(r + 1))}
+    try:
+        opt.update(grads, state, params)
+    except HorovodInternalError as e:
+        _assert_mixed_error(str(e))
+        print("rank %d: optimizer-level mixed mode rejected" % r,
+              flush=True)
+    else:
+        raise SystemExit("mixed optimizer update unexpectedly succeeded")
+
+    # The error is per-tensor, not fatal: a uniform op still completes.
+    out = ops.allreduce(x, "uniform")
+    assert np.allclose(out, n), out
+    print("rank %d: mixed worker passed" % r, flush=True)
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,268 @@
+"""Sharded-weight-update e2e worker (docs/ZERO.md): the ZeRO-style
+reduce-scatter -> shard-local optimizer -> allgather path must produce
+the SAME parameters as the replicated allreduce path, while holding
+~1/N of the optimizer state per rank (asserted through the native
+opt_state_bytes gauge).
+
+Sections (env ``SHARDED_TEST_FRAMEWORKS``, default "jax"):
+  jax    host-plane DistributedOptimizer(sharded_update=True) parity vs
+         a locally-computed replicated reference, uneven shard sizes,
+         the opt_state_bytes memory claim, int8 wire compression
+         layered on the scatter leg, reduce_scatter_total accounting
+  torch  _ShardedOptimizer parity vs torch.optim on mean gradients
+  tf     Keras-3 sharded optimizer parity (eager apply_gradients)
+
+Run: python -m horovod_tpu.run.run -np 2 -- python tests/sharded_update_worker.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+FRAMEWORKS = [f for f in os.environ.get(
+    "SHARDED_TEST_FRAMEWORKS", "jax").split(",") if f]
+STEPS = 5
+
+
+def _rank_grads(shapes, r, step):
+    """Deterministic rank- and step-dependent gradients: the collective
+    matters (every rank contributes different values), yet every rank
+    can also compute every OTHER rank's gradient to build the exact
+    replicated reference locally."""
+    out = {}
+    for k, shape in shapes.items():
+        total = int(np.prod(shape))
+        base = np.linspace(-1.0, 1.0, total).astype(np.float32)
+        out[k] = ((base * (step + 1) + 0.25 * r)
+                  .reshape(shape).astype(np.float32))
+    return out
+
+
+def _mean_grads(shapes, n, step):
+    return {k: np.mean([_rank_grads(shapes, rr, step)[k]
+                        for rr in range(n)], axis=0)
+            for k in shapes}
+
+
+def check_jax(r, n):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu import jax as hvd_jax
+
+    # Odd leaf sizes (13*7 + 7 + 3 = 101 elements) so the shard
+    # partition is uneven at every tested world size.
+    shapes = {"w": (13, 7), "b": (7,), "s": (3,)}
+    rng = np.random.RandomState(0)
+    params0 = {k: jnp.asarray(rng.randn(*v).astype(np.float32) * 0.3)
+               for k, v in shapes.items()}
+
+    opt = optax.adam(1e-2)
+    sharded = hvd_jax.DistributedOptimizer(opt, sharded_update=True)
+    assert isinstance(sharded, optax.GradientTransformation)
+
+    p = dict(params0)
+    s = sharded.init(p)
+    assert s["world"] == n and s["rank"] == r and s["total"] == 101
+
+    # Replicated reference computed entirely locally from the mean
+    # gradients (identical on every rank by construction).
+    ref_p = dict(params0)
+    ref_s = opt.init(ref_p)
+
+    for step in range(STEPS):
+        g = {k: jnp.asarray(v)
+             for k, v in _rank_grads(shapes, r, step).items()}
+        updates, s = sharded.update(g, s, p)
+        p = optax.apply_updates(p, updates)
+
+        ref_g = {k: jnp.asarray(v)
+                 for k, v in _mean_grads(shapes, n, step).items()}
+        ref_u, ref_s = opt.update(ref_g, ref_s, ref_p)
+        ref_p = optax.apply_updates(ref_p, ref_u)
+
+    for k in shapes:
+        np.testing.assert_allclose(
+            np.asarray(p[k]), np.asarray(ref_p[k]), rtol=2e-5, atol=2e-5,
+            err_msg="jax sharded != replicated reference for %r" % k)
+
+    # Cross-rank agreement is exact: the allgather leg ships the updated
+    # shards verbatim.
+    for k in shapes:
+        theirs = np.asarray(hvd.allgather(
+            np.asarray(p[k]).ravel()[None, :], "agree.%s" % k))
+        for rr in range(n):
+            assert np.array_equal(theirs[rr], theirs[0]), \
+                "ranks disagree on updated params %r" % k
+
+    # The memory claim (docs/ZERO.md): the inner Adam state holds mu+nu
+    # for THIS RANK'S SHARD only. gauge <= replicated/n + one shard of
+    # padding slack (+ scalar step counters).
+    counts, _ = hvd.shard_partition(101, n)
+    gauge = hvd.metrics()["gauges"]["opt_state_bytes"]
+    replicated_bytes = 2 * 101 * 4
+    assert gauge > 0, gauge
+    assert gauge <= replicated_bytes / n + 2 * 4 * (max(counts) + 16), \
+        (gauge, replicated_bytes, n)
+    expected = 2 * counts[r] * 4
+    assert abs(gauge - expected) <= 64, (gauge, expected)
+
+    # Repeated reduce-scatters on one name ride the response cache's
+    # fast path (REDUCESCATTER is keyed into the cache like any other
+    # op — the Response enum offset must not defeat the hit check).
+    # 5 steps = 5 reduce-scatters + 5 param allgathers on stable names;
+    # the first of each misses, the rest must HIT (>= 6 proves the
+    # reduce-scatters hit too, not just the allgathers).
+    hits = hvd.metrics()["counters"]["cache_hit_total"]
+    assert hits >= 6, "reduce-scatter never hit the response cache " \
+        "(hits=%d)" % hits
+
+    # int8 wire compression layers onto the scatter leg unchanged; the
+    # quantization error per hop is bounded by scale/2 per block.
+    sc = hvd_jax.DistributedOptimizer(opt, sharded_update=True,
+                                      compression="int8")
+    pc = dict(params0)
+    stc = sc.init(pc)
+    before = hvd.metrics()["counters"]["reduce_scatter_total"]
+    g = {k: jnp.asarray(v) for k, v in _rank_grads(shapes, r, 0).items()}
+    updates, stc = sc.update(g, stc, pc)
+    pc = optax.apply_updates(pc, updates)
+    after = hvd.metrics()["counters"]["reduce_scatter_total"]
+    assert after > before, (before, after)
+    ref1_u, _ = opt.update(
+        {k: jnp.asarray(v) for k, v in _mean_grads(shapes, n, 0).items()},
+        opt.init(params0), params0)
+    ref1_p = optax.apply_updates(dict(params0), ref1_u)
+    for k in shapes:
+        np.testing.assert_allclose(
+            np.asarray(pc[k]), np.asarray(ref1_p[k]), atol=5e-3,
+            err_msg="int8-compressed sharded update diverged for %r" % k)
+
+    # sharded_state_full materializes the world-independent form;
+    # sharded_state_shard slices it back bitwise for this rank.
+    full = hvd_jax.sharded_state_full(s)
+    assert full["world"] == -1 and full["rank"] == -1
+    reshard = hvd_jax.sharded_state_shard(full)
+    for a, b in zip(jax.tree_util.tree_leaves(reshard["inner"]),
+                    jax.tree_util.tree_leaves(s["inner"])):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    print("rank %d: jax sharded parity passed" % r, flush=True)
+
+
+def check_torch(r, n):
+    import torch
+
+    from horovod_tpu import torch as hvd_torch
+
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(9, 5), torch.nn.Linear(5, 3))
+    # Same init on every rank (seeded), and a replicated twin for the
+    # local reference.
+    ref_model = torch.nn.Sequential(
+        torch.nn.Linear(9, 5), torch.nn.Linear(5, 3))
+    ref_model.load_state_dict(model.state_dict())
+
+    shapes = {name: tuple(p.shape)
+              for name, p in model.named_parameters()}
+    opt = hvd_torch.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9),
+        named_parameters=model.named_parameters(), sharded_update=True)
+    ref_opt = torch.optim.SGD(ref_model.parameters(), lr=0.1,
+                              momentum=0.9)
+
+    for step in range(STEPS):
+        g = _rank_grads(shapes, r, step)
+        for name, param in model.named_parameters():
+            param.grad = torch.from_numpy(g[name].copy())
+        opt.step()
+
+        mg = _mean_grads(shapes, n, step)
+        for name, param in ref_model.named_parameters():
+            param.grad = torch.from_numpy(mg[name].copy())
+        ref_opt.step()
+
+    for (name, p), (_, rp) in zip(model.named_parameters(),
+                                  ref_model.named_parameters()):
+        np.testing.assert_allclose(
+            p.detach().numpy(), rp.detach().numpy(), rtol=2e-5,
+            atol=2e-5,
+            err_msg="torch sharded != replicated reference for %r" % name)
+
+    # Momentum buffers live ONLY for this rank's flat shard.
+    total = sum(int(np.prod(s)) for s in shapes.values())
+    counts, _ = hvd.shard_partition(total, n)
+    gauge = hvd.metrics()["gauges"]["opt_state_bytes"]
+    assert abs(gauge - counts[r] * 4) <= 64, (gauge, counts[r] * 4)
+
+    print("rank %d: torch sharded parity passed" % r, flush=True)
+
+
+def check_tf(r, n):
+    import tensorflow as tf
+
+    from horovod_tpu import tensorflow as hvd_tf
+
+    tf.random.set_seed(0)
+    v1 = tf.Variable(np.linspace(-1, 1, 35).reshape(7, 5)
+                     .astype(np.float32), name="v1")
+    v2 = tf.Variable(np.linspace(1, -1, 5).astype(np.float32), name="v2")
+    variables = [v1, v2]
+    shapes = {"v1": (7, 5), "v2": (5,)}
+    ref_vals = [v.numpy().copy() for v in variables]
+
+    opt = hvd_tf.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.1, momentum=0.9),
+        sharded_update=True)
+    ref_opt = tf.keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)
+    ref_vars = [tf.Variable(v, name="r%d" % i)
+                for i, v in enumerate(ref_vals)]
+
+    for step in range(STEPS):
+        g = _rank_grads(shapes, r, step)
+        opt.apply_gradients([(tf.constant(g["v1"]), v1),
+                             (tf.constant(g["v2"]), v2)])
+        mg = _mean_grads(shapes, n, step)
+        ref_opt.apply_gradients(
+            [(tf.constant(mg["v1"]), ref_vars[0]),
+             (tf.constant(mg["v2"]), ref_vars[1])])
+
+    for v, rv, name in ((v1, ref_vars[0], "v1"), (v2, ref_vars[1], "v2")):
+        np.testing.assert_allclose(
+            v.numpy(), rv.numpy(), rtol=2e-5, atol=2e-5,
+            err_msg="tf sharded != replicated reference for %r" % name)
+
+    # A filtered/reordered variable list no longer matches the shard
+    # layout built at the first call — must error, not misalign.
+    try:
+        opt.apply_gradients([(tf.constant(_rank_grads(shapes, r, 0)["v2"]),
+                              v2)])
+    except RuntimeError as e:
+        assert "variable list" in str(e), e
+    else:
+        raise AssertionError("reordered variable list was not rejected")
+
+    print("rank %d: tf sharded parity passed" % r, flush=True)
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    if "jax" in FRAMEWORKS:
+        check_jax(r, n)
+    if "torch" in FRAMEWORKS:
+        check_torch(r, n)
+    if "tf" in FRAMEWORKS:
+        check_tf(r, n)
+    print("rank %d: sharded update worker passed" % r, flush=True)
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

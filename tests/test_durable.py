@@ -542,7 +542,7 @@ DONE_LINE = re.compile(r"worker (\S+) done step (\d+) crc ([0-9a-f]{8})")
 
 
 def _launch(ckpt_dir, np_, extra_env=None, extra_args=(), pid_dir=None,
-            total=24):
+            total=24, script="durable_worker.py"):
     from tests.conftest import clean_worker_env
 
     env = clean_worker_env(dict({
@@ -557,7 +557,7 @@ def _launch(ckpt_dir, np_, extra_env=None, extra_args=(), pid_dir=None,
     cmd = [sys.executable, "-m", "horovod_tpu.run.run", "-np", str(np_),
            "--min-np", "1", "--ckpt-dir", ckpt_dir] + list(extra_args) + \
           ["--", sys.executable,
-           os.path.join(REPO_ROOT, "tests", "durable_worker.py")]
+           os.path.join(REPO_ROOT, "tests", script)]
     return cmd, env
 
 
@@ -640,6 +640,97 @@ def test_kill_everything_then_relaunch_resumes_bitwise(tmp_path):
     # saved world size (2) differs from the restoring one (1).
     crcs2.update(crcs1)
     relaunch_and_check(1, crcs2)
+
+
+@pytest.mark.e2e
+def test_sharded_update_kill_restore_half_and_double_world(tmp_path):
+    """Sharded-update x durable (docs/ZERO.md acceptance): SIGKILL a
+    2-rank sharded-update job mid-run, then resume it at HALF (1) and
+    DOUBLE (4) the world size — the sharded Adam state rides the
+    checkpoint in its world-independent full form and re-shards on
+    restore, and the final parameters are BITWISE-identical to an
+    uninterrupted 2-rank run's (the worker's gradient quantization
+    makes the trajectory exactly world-size-independent)."""
+    # Uninterrupted 2-rank reference run.
+    ckpt_u = str(tmp_path / "ckpt_u")
+    cmd, env = _launch(ckpt_u, np_=2, script="sharded_durable_worker.py",
+                       extra_env={"DURABLE_TEST_STEP_SLEEP": "0.1"})
+    ref = subprocess.run(cmd, env=env, timeout=240, capture_output=True,
+                         text=True)
+    assert ref.returncode == 0, (ref.stdout, ref.stderr)
+    ref_crcs = _commit_crcs(ref.stdout)
+    ref_done = DONE_LINE.findall(ref.stdout)
+    assert len(ref_done) == 2 and all(int(s) == 24 for _, s, _ in ref_done)
+    ref_final = ref_done[0][2]
+
+    # Killed run: same trajectory, SIGKILLed once a mid-run manifest
+    # exists.
+    ckpt = str(tmp_path / "ckpt")
+    pid_dir = str(tmp_path / "pids")
+    os.makedirs(pid_dir)
+    cmd, env = _launch(ckpt, np_=2, script="sharded_durable_worker.py",
+                       pid_dir=pid_dir, total=200,
+                       extra_env={"DURABLE_TEST_STEP_SLEEP": "0.1"})
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    deadline = time.monotonic() + 120
+    while True:
+        manifest, _ = latest_valid_manifest(ckpt)
+        if manifest is not None and manifest["step"] >= 6:
+            break
+        assert proc.poll() is None, proc.communicate()
+        assert time.monotonic() < deadline, "no durable manifest in 120s"
+        time.sleep(0.1)
+    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    for name in os.listdir(pid_dir):
+        pid = int(open(os.path.join(pid_dir, name)).read())
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    out1, _ = proc.communicate(timeout=30)
+    crcs1 = _commit_crcs(out1)
+    assert crcs1, out1
+    # The killed run's commits match the uninterrupted run's bitwise.
+    for step, crc in crcs1.items():
+        assert ref_crcs.get(step) == crc, (step, crc, ref_crcs.get(step))
+
+    def resume(np_, total, prior_crcs):
+        cmd, env = _launch(ckpt, np_=np_, total=total,
+                           script="sharded_durable_worker.py",
+                           extra_env={"DURABLE_TEST_STEP_SLEEP": "0.1"})
+        result = subprocess.run(cmd, env=env, timeout=240,
+                                capture_output=True, text=True)
+        assert result.returncode == 0, (result.stdout, result.stderr)
+        starts = [(int(s), crc, int(n))
+                  for _, s, crc, n in START_LINE.findall(result.stdout)]
+        resumed = [x for x in starts if x[0] > 0]
+        assert resumed, ("no resume from the durable checkpoint",
+                         result.stdout)
+        step0, crc0, size0 = resumed[0]
+        assert size0 == np_
+        # Bitwise resume: params + re-shardable full Adam state.
+        assert step0 in prior_crcs, (step0, sorted(prior_crcs))
+        assert crc0 == prior_crcs[step0], \
+            "sharded state corrupted across restart"
+        done = DONE_LINE.findall(result.stdout)
+        assert len(done) == np_ and all(int(s) == total
+                                        for _, s, _ in done)
+        return _commit_crcs(result.stdout), done[0][2]
+
+    # HALF the world size (1): finishes step 16 on the reference
+    # trajectory bitwise.
+    half_crcs, _ = resume(1, 16, crcs1)
+    for step, crc in half_crcs.items():
+        assert ref_crcs.get(step) == crc, (step, crc)
+    # DOUBLE the world size (4): resumes the 1-rank run's step-16
+    # state, trains 8 more steps, and lands on the uninterrupted run's
+    # final CRC exactly.
+    all_crcs = dict(crcs1)
+    all_crcs.update(half_crcs)
+    _, final = resume(4, 24, all_crcs)
+    assert final == ref_final, (final, ref_final)
 
 
 @pytest.mark.e2e

@@ -67,6 +67,13 @@ BAD_CORPUS = {
         ids = tokens.astype(jnp.int32)
         hvd.allreduce(ids, name="ids", compression="int8")
     """,
+    "sharded-update-rank-local-param-read": """
+        import horovod_tpu.jax as hvd_jax
+        opt = hvd_jax.DistributedOptimizer(inner, sharded_update=True)
+        params = hvd_jax.broadcast_parameters(params, root_rank=0)
+        state = opt.init(params)
+        mu = state["inner"][0].mu
+    """,
 }
 
 # --- known-good twins: the corrected version of each snippet ----------------
@@ -120,6 +127,14 @@ GOOD_CORPUS = {
         grads = jax.grad(loss)(params)
         hvd.allreduce(grads, name="g", compression="int8")
     """,
+    "sharded-update-rank-local-param-read": """
+        import horovod_tpu.jax as hvd_jax
+        opt = hvd_jax.DistributedOptimizer(inner, sharded_update=True)
+        params = hvd_jax.broadcast_parameters(params, root_rank=0)
+        state = opt.init(params)
+        full = hvd_jax.sharded_state_full(state)
+        mu = full["inner"][0].mu
+    """,
 }
 
 
@@ -131,6 +146,53 @@ def test_known_bad_flags(rule):
 @pytest.mark.parametrize("rule", sorted(GOOD_CORPUS))
 def test_known_good_clean(rule):
     assert rules_of(GOOD_CORPUS[rule]) == []
+
+
+def test_sharded_state_read_variants():
+    # torch style: `.state` on the sharded wrapper is empty by design.
+    assert "sharded-update-rank-local-param-read" in rules_of("""
+        import horovod_tpu.torch as hvd_torch
+        opt = hvd_torch.DistributedOptimizer(sgd, sharded_update=True)
+        buf = opt.state[p]["momentum_buffer"]
+    """)
+    # The re-bound state from update() keeps the taint.
+    assert "sharded-update-rank-local-param-read" in rules_of("""
+        import horovod_tpu.jax as hvd_jax
+        opt = hvd_jax.DistributedOptimizer(inner, sharded_update=True)
+        s = opt.init(params)
+        u, s = opt.update(grads, s, params)
+        nu = s["inner"][0].nu
+    """)
+    # A dynamic sharded_update= counts (may be True at run time).
+    assert "sharded-update-rank-local-param-read" in rules_of("""
+        import horovod_tpu.jax as hvd_jax
+        opt = hvd_jax.DistributedOptimizer(inner, sharded_update=flag)
+        s = opt.init(params)
+        moments = s["inner"]
+    """)
+    # Replicated optimizers are untouched...
+    assert rules_of("""
+        import horovod_tpu.torch as hvd_torch
+        opt = hvd_torch.DistributedOptimizer(sgd)
+        params = hvd_torch.broadcast_parameters(params, root_rank=0)
+        buf = opt.state[p]["momentum_buffer"]
+    """) == []
+    # ...as is an explicit sharded_update=False.
+    assert rules_of("""
+        import horovod_tpu.jax as hvd_jax
+        opt = hvd_jax.DistributedOptimizer(inner, sharded_update=False)
+        params = hvd_jax.broadcast_parameters(params, root_rank=0)
+        s = opt.init(params)
+        moments = s["inner"]
+    """) == []
+    # Metadata keys on the sharded state stay clean.
+    assert rules_of("""
+        import horovod_tpu.jax as hvd_jax
+        opt = hvd_jax.DistributedOptimizer(inner, sharded_update=True)
+        params = hvd_jax.broadcast_parameters(params, root_rank=0)
+        s = opt.init(params)
+        w = s["world"]
+    """) == []
 
 
 def test_compression_on_embedding_lookup_is_warning():

@@ -1,0 +1,531 @@
+"""ZeRO-style sharded weight update (docs/ZERO.md): shard-partition
+math units, the jax ring reduce-scatter/allgather pair (parity vs
+psum_scatter, wire compression fused per hop, round-trip reassembly),
+the single-process degenerate forms of the host-plane sharded
+optimizer, zero1 x wire compression in make_train_step, and the
+launcher e2es — framework parity at 2 and 4 ranks plus the
+mixed-execution-mode rejection."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+from horovod_tpu.common.ops import shard_partition  # noqa: E402
+
+
+# --- shard partition units --------------------------------------------------
+
+
+def test_shard_partition_golden():
+    assert shard_partition(10, 3) == ([4, 3, 3], [0, 4, 7])
+    assert shard_partition(101, 2) == ([51, 50], [0, 51])
+    assert shard_partition(7, 8) == ([1] * 7 + [0], list(range(7)) + [7])
+    assert shard_partition(0, 4) == ([0] * 4, [0] * 4)
+
+
+@pytest.mark.parametrize("count,n", [(1, 1), (17, 4), (256, 3), (1000, 7)])
+def test_shard_partition_invariants(count, n):
+    counts, offsets = shard_partition(count, n)
+    assert sum(counts) == count
+    assert max(counts) - min(counts) <= 1  # near-equal
+    assert offsets[0] == 0
+    for i in range(1, n):
+        assert offsets[i] == offsets[i - 1] + counts[i - 1]
+    # Earlier ranks absorb the remainder (chunk i owned by rank i; the
+    # native PartitionChunks mirrors this exactly).
+    assert counts == sorted(counts, reverse=True)
+
+
+# --- jax ring reduce-scatter / allgather ------------------------------------
+
+
+def _mesh():
+    cpus = jax.devices("cpu")
+    return Mesh(np.array(cpus), ("hvd",)), len(cpus)
+
+
+@pytest.mark.parametrize("mode,tol", [("none", 1e-6), ("bf16", 1e-2),
+                                      ("int8", 2e-2)])
+def test_ring_reduce_scatter_matches_summed_chunks(mode, tol):
+    """Every device's shard equals its chunk of the cross-device sum,
+    for an odd-sized tensor (pad path) under every wire mode."""
+    from horovod_tpu import compression as comp
+    from horovod_tpu.parallel.ring import ring_reduce_scatter
+
+    mesh, n = _mesh()
+    size = 1003  # odd: exercises the pad-to-block path
+    x = np.stack([(np.linspace(-1, 1, size) * (r + 1)).astype(np.float32)
+                  for r in range(n)])
+    f = jax.jit(jax.shard_map(
+        lambda v: ring_reduce_scatter(v.reshape(-1), "hvd",
+                                      compression=mode),
+        mesh=mesh, in_specs=P("hvd"), out_specs=P("hvd"),
+        check_vma=False))
+    out = np.asarray(f(jnp.asarray(x)))  # concatenated shards
+
+    c = -(-(-(-size // n)) // comp.BLOCK) * comp.BLOCK
+    want = np.zeros(n * c, np.float32)
+    want[:size] = x.sum(axis=0)
+    assert out.shape == (n * c,)
+    # mode none differs from the numpy reference only by f32 sum-order
+    # rounding (the ring accumulates sequentially).
+    scale = np.abs(want).max()
+    assert np.max(np.abs(out - want)) <= tol * scale + 1e-6, mode
+
+
+def test_ring_allgather_reassembles_in_rank_order():
+    """Each device contributes chunk r; every device gets the ordered
+    concatenation, bitwise-identical across devices (mode none and the
+    encode-once compressed path)."""
+    from horovod_tpu.parallel.ring import ring_allgather
+
+    from horovod_tpu import compression as comp
+
+    mesh, n = _mesh()
+    for mode in ("none", "int8"):
+        # Compressed shards must be int8-block-aligned — exactly what
+        # ring_reduce_scatter produces; mode none takes any length.
+        c = comp.BLOCK if mode == "int8" else 37
+        shards = np.stack([np.full(c, r + 1, np.float32) +
+                           np.linspace(0, 1, c).astype(np.float32) * r
+                           for r in range(n)])
+        f = jax.jit(jax.shard_map(
+            lambda v: ring_allgather(v.reshape(-1), "hvd",
+                                     compression=mode),
+            mesh=mesh, in_specs=P("hvd"), out_specs=P("hvd"),
+            check_vma=False))
+        out = np.asarray(f(jnp.asarray(shards))).reshape(n, n * c)
+        # Bitwise-identical on every device: the compressed payload
+        # travels verbatim and the owner decodes its own copy.
+        for r in range(1, n):
+            np.testing.assert_array_equal(out[r], out[0], err_msg=mode)
+        if mode == "none":
+            np.testing.assert_array_equal(out[0], shards.reshape(-1))
+        else:
+            # int8 is lossy but block-bounded.
+            assert np.max(np.abs(out[0] - shards.reshape(-1))) < 2e-2 * \
+                np.abs(shards).max()
+
+
+def test_ring_scatter_then_allgather_is_allreduce():
+    """ring_allgather(ring_reduce_scatter(x)) == padded cross-device
+    sum — the fused sharded-update path reassembles exactly what the
+    allreduce would have produced (mode none: bitwise)."""
+    from horovod_tpu import compression as comp
+    from horovod_tpu.parallel.ring import (ring_allgather,
+                                           ring_reduce_scatter)
+
+    mesh, n = _mesh()
+    size = 777
+    rng = np.random.RandomState(5)
+    x = rng.randn(n, size).astype(np.float32)
+
+    def both(v):
+        shard = ring_reduce_scatter(v.reshape(-1), "hvd")
+        return ring_allgather(shard, "hvd")
+
+    f = jax.jit(jax.shard_map(both, mesh=mesh, in_specs=P("hvd"),
+                              out_specs=P("hvd"), check_vma=False))
+    c = -(-(-(-size // n)) // comp.BLOCK) * comp.BLOCK
+    out = np.asarray(f(jnp.asarray(x))).reshape(n, n * c)
+    want = np.zeros(n * c, np.float32)
+    want[:size] = x.sum(axis=0)
+    for r in range(n):
+        np.testing.assert_allclose(out[r], want, rtol=1e-6, atol=1e-5)
+
+
+def test_zero1_with_wire_compression_matches_plain():
+    """make_train_step(zero1=True, compression='int8'): the compressed
+    scatter leg keeps the loss curve on the exact path's trajectory
+    (PR 6 composition, previously rejected)."""
+    from horovod_tpu.parallel import data_parallel_mesh, make_train_step
+
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(13, 7).astype(np.float32) * 0.3),
+              "b": jnp.asarray(rng.randn(7).astype(np.float32))}
+    x = jnp.asarray(rng.randn(32, 13).astype(np.float32))
+    y = jnp.asarray(rng.randn(32, 7).astype(np.float32))
+
+    def loss_fn(p, batch):
+        return jnp.mean((batch["x"] @ p["w"] + p["b"] - batch["y"]) ** 2)
+
+    mesh = data_parallel_mesh(devices=jax.devices("cpu"))
+    opt = optax.adam(1e-2)
+    plain = make_train_step(loss_fn, opt, mesh, donate=False)
+    p1, s1, b1 = plain.place(params, opt.init(params), {"x": x, "y": y})
+    z = make_train_step(loss_fn, opt, mesh, donate=False, zero1=True,
+                        compression="int8")
+    p2, s2, b2 = z.place(params, None, {"x": x, "y": y})
+    losses1, losses2 = [], []
+    for _ in range(5):
+        p1, s1, l1 = plain(p1, s1, b1)
+        p2, s2, l2 = z(p2, s2, b2)
+        losses1.append(float(l1))
+        losses2.append(float(l2))
+    rel = np.abs(np.asarray(losses2) - np.asarray(losses1)) / \
+        (np.abs(np.asarray(losses1)) + 1e-8)
+    assert rel.max() < 0.05, (losses1, losses2)
+    # Legacy tensor codecs stay rejected under zero1.
+    from horovod_tpu import jax as hvd_jax
+    with pytest.raises(ValueError, match="legacy"):
+        make_train_step(loss_fn, opt, mesh, zero1=True,
+                        compression=hvd_jax.Compression.fp16)
+    # ...but the no-op Compression.none codec is exempt (replicated-era
+    # call sites pass it explicitly; parity with the wrappers).
+    make_train_step(loss_fn, opt, mesh, zero1=True,
+                    compression=hvd_jax.Compression.none)
+
+
+# --- single-process host plane (world size 1 degenerate forms) --------------
+
+
+@pytest.fixture(scope="module")
+def init_hvd():
+    import horovod_tpu as hvd
+    hvd.init()
+    yield hvd
+
+
+def test_reduce_scatter_world1_identity(init_hvd):
+    hvd = init_hvd
+    x = np.linspace(-2, 2, 11).astype(np.float32)
+    out = hvd.reduce_scatter(x, "rs.w1")
+    np.testing.assert_array_equal(np.asarray(out), x)
+    avg = hvd.reduce_scatter(x, "rs.w1avg", average=True)
+    np.testing.assert_array_equal(np.asarray(avg), x)
+
+
+def test_sharded_optimizer_world1_matches_plain(init_hvd):
+    from horovod_tpu import jax as hvd_jax
+
+    rng = np.random.RandomState(3)
+    params = {"w": jnp.asarray(rng.randn(4, 3).astype(np.float32)),
+              "b": jnp.asarray(rng.randn(3).astype(np.float32))}
+    opt = optax.adam(1e-2)
+    sharded = hvd_jax.DistributedOptimizer(opt, sharded_update=True)
+    p, s = dict(params), sharded.init(params)
+    rp, rs = dict(params), opt.init(params)
+    for step in range(3):
+        g = {k: jnp.asarray(np.full(v.shape, 0.1 * (step + 1),
+                                    np.float32))
+             for k, v in params.items()}
+        u, s = sharded.update(g, s, p)
+        p = optax.apply_updates(p, u)
+        ru, rs = opt.update(g, rs, rp)
+        rp = optax.apply_updates(rp, ru)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p[k]), np.asarray(rp[k]),
+                                   rtol=1e-6, atol=1e-6, err_msg=k)
+    # Full/shard round-trip at world 1 is the identity.
+    full = hvd_jax.sharded_state_full(s)
+    assert full["world"] == -1 and full["rank"] == -1
+    back = hvd_jax.sharded_state_shard(full)
+    for a, b in zip(jax.tree_util.tree_leaves(back["inner"]),
+                    jax.tree_util.tree_leaves(s["inner"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_update_requires_params(init_hvd):
+    from horovod_tpu import jax as hvd_jax
+
+    sharded = hvd_jax.DistributedOptimizer(optax.sgd(0.1),
+                                           sharded_update=True)
+    s = sharded.init({"w": jnp.ones(4)})
+    with pytest.raises(ValueError, match="params"):
+        sharded.update({"w": jnp.ones(4)}, s)
+
+
+def test_env_default_engages_sharded_mode(init_hvd, monkeypatch):
+    """HVD_TPU_SHARDED_UPDATE=1 flips wrappers that got no explicit
+    sharded_update= argument (the job-wide knob, docs/ZERO.md)."""
+    import horovod_tpu as hvd
+    from horovod_tpu import jax as hvd_jax
+
+    monkeypatch.setenv("HVD_TPU_SHARDED_UPDATE", "1")
+    assert hvd.get_basics().sharded_update_default() is True
+    opt = hvd_jax.DistributedOptimizer(optax.sgd(0.1))
+    s = opt.init({"w": jnp.ones(4)})
+    assert isinstance(s, dict) and s["world"] == 1  # sharded state layout
+    monkeypatch.setenv("HVD_TPU_SHARDED_UPDATE", "0")
+    assert hvd.get_basics().sharded_update_default() is False
+    opt = hvd_jax.DistributedOptimizer(optax.sgd(0.1))
+    assert not isinstance(opt.init({"w": jnp.ones(4)}), dict)
+    # The wrappers share the native strtol parse: any nonzero value
+    # engages the mode everywhere (no =2-means-different-things skew).
+    monkeypatch.setenv("HVD_TPU_SHARDED_UPDATE", "2")
+    assert hvd.get_basics().sharded_update_default() is True
+    opt = hvd_jax.DistributedOptimizer(optax.sgd(0.1))
+    assert isinstance(opt.init({"w": jnp.ones(4)}), dict)
+
+
+def test_reduce_scatter_out_buffer_validation(init_hvd):
+    """A caller-controlled `out` hands its base pointer to the native
+    core: wrong size, dtype, or a strided view must be a ValueError,
+    never a silent heap overrun."""
+    from horovod_tpu.common import ops as _ops
+
+    t = np.arange(8, dtype=np.float32)  # world=1: shard == whole array
+    with pytest.raises(ValueError, match="elements"):
+        _ops.reduce_scatter_async(t, "rs.out.size",
+                                  out=np.empty(5, np.float32))
+    with pytest.raises(ValueError, match="C-contiguous"):
+        _ops.reduce_scatter_async(t, "rs.out.dtype",
+                                  out=np.empty(8, np.float16))
+    with pytest.raises(ValueError, match="C-contiguous"):
+        _ops.reduce_scatter_async(t, "rs.out.stride",
+                                  out=np.empty(16, np.float32)[::2])
+
+
+def test_sharded_state_full_idempotent_and_shard_guards(init_hvd):
+    from horovod_tpu import jax as hvd_jax
+
+    opt = optax.adam(1e-2)
+    sharded = hvd_jax.DistributedOptimizer(opt, sharded_update=True)
+    s = sharded.init({"w": jnp.ones(8)})
+    full = hvd_jax.sharded_state_full(s)
+    # Idempotent on an already-full state (no collective, no crash).
+    assert hvd_jax.sharded_state_full(full) is full
+    back = hvd_jax.sharded_state_shard(full)
+    # Pass-through when already sharded for THIS rank/world...
+    assert hvd_jax.sharded_state_shard(back) is back
+    # ...but a foreign (rank, world) shard cannot be re-sliced locally.
+    foreign = dict(back)
+    foreign["world"], foreign["rank"] = 7, 3
+    with pytest.raises(ValueError, match="rank 3 of 7"):
+        hvd_jax.sharded_state_shard(foreign)
+    # sharded_state_full refuses a stale membership too: the old
+    # world's shards are gone, so allgathering over the CURRENT ranks
+    # would reassemble a short buffer and silently label it full.
+    with pytest.raises(RuntimeError, match="rank 3 of 7"):
+        hvd_jax.sharded_state_full(foreign)
+
+
+def test_jax_sharded_accepts_legacy_none_codec(init_hvd):
+    """Replicated-era `compression=Compression.none` call sites keep
+    working under a job-wide HVD_TPU_SHARDED_UPDATE rollout (parity
+    with the torch/tf wrappers)."""
+    from horovod_tpu import jax as hvd_jax
+
+    opt = hvd_jax.DistributedOptimizer(
+        optax.sgd(0.1), sharded_update=True,
+        compression=hvd_jax.Compression.none)
+    s = opt.init({"w": jnp.ones(4)})
+    assert isinstance(s, dict) and s["world"] == 1
+
+
+def test_torch_sharded_state_dict_roundtrip(init_hvd):
+    import torch
+
+    from horovod_tpu import torch as hvd_torch
+
+    def build():
+        torch.manual_seed(7)
+        model = torch.nn.Linear(5, 3)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9),
+            named_parameters=model.named_parameters(),
+            sharded_update=True)
+        return model, opt
+
+    def step(model, opt, seed):
+        g = np.random.RandomState(seed)
+        for p in model.parameters():
+            p.grad = torch.from_numpy(
+                g.randn(*p.shape).astype(np.float32))
+        opt.step()
+
+    model1, opt1 = build()
+    step(model1, opt1, 0)
+    saved = opt1.state_dict()
+    assert "hvd_sharded" in saved
+
+    # A fresh wrapper restored from the dict continues the SAME
+    # trajectory (moments survive the round trip).
+    model2, opt2 = build()
+    step(model2, opt2, 0)
+    opt2.load_state_dict(saved)
+    step(model1, opt1, 1)
+    step(model2, opt2, 1)
+    for (_, a), (_, b) in zip(model1.named_parameters(),
+                              model2.named_parameters()):
+        np.testing.assert_array_equal(a.detach().numpy(),
+                                      b.detach().numpy())
+
+    # A replicated optimizer's dict (no sharded payload) is rejected
+    # loudly instead of silently zeroing the moments.
+    with pytest.raises(ValueError, match="sharded"):
+        opt2.load_state_dict(
+            {k: v for k, v in saved.items() if k != "hvd_sharded"})
+    # A foreign (rank, world) shard payload is rejected too.
+    foreign = dict(saved)
+    foreign["hvd_sharded"] = dict(saved["hvd_sharded"], world=4, rank=2)
+    with pytest.raises(RuntimeError, match="rank 2 of 4"):
+        opt2.load_state_dict(foreign)
+
+
+def test_torch_sharded_lr_scheduler_propagates(init_hvd):
+    """LR schedulers mutate the WRAPPER's param_groups; the shard-local
+    inner optimizer must follow (it once ran at the construction-time
+    lr forever), keeping the sharded trajectory on the replicated one."""
+    import torch
+
+    from horovod_tpu import torch as hvd_torch
+
+    def run(sharded):
+        torch.manual_seed(3)
+        model = torch.nn.Linear(4, 2)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9),
+            named_parameters=model.named_parameters(),
+            sharded_update=sharded)
+        sched = torch.optim.lr_scheduler.StepLR(opt, step_size=2,
+                                                gamma=0.1)
+        g = np.random.RandomState(11)
+        for _ in range(5):
+            for p in model.parameters():
+                p.grad = torch.from_numpy(
+                    g.randn(*p.shape).astype(np.float32))
+            opt.step()
+            sched.step()
+        return model, opt
+
+    m_rep, _ = run(False)
+    m_shd, o_shd = run(True)
+    # The inner shard optimizer followed the schedule...
+    assert o_shd.param_groups[0]["lr"] == pytest.approx(
+        o_shd._hvd_inner.param_groups[0]["lr"])
+    assert o_shd.param_groups[0]["lr"] < 0.1
+    # ...so the trajectories agree (world 1: allreduce == identity).
+    for (_, a), (_, b) in zip(m_rep.named_parameters(),
+                              m_shd.named_parameters()):
+        np.testing.assert_allclose(a.detach().numpy(),
+                                   b.detach().numpy(), rtol=1e-6)
+
+
+def test_sharded_rejects_legacy_codecs(init_hvd):
+    import torch
+
+    from horovod_tpu import jax as hvd_jax
+    from horovod_tpu import torch as hvd_torch
+
+    with pytest.raises(ValueError, match="wire compression"):
+        hvd_jax.DistributedOptimizer(optax.sgd(0.1), sharded_update=True,
+                                     compression=hvd_jax.Compression.fp16)
+    model = torch.nn.Linear(3, 2)
+    with pytest.raises(ValueError, match="wire compression"):
+        hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            sharded_update=True, compression=hvd_torch.Compression.fp16)
+    with pytest.raises(ValueError, match="backward_passes_per_step"):
+        hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            sharded_update=True, backward_passes_per_step=2)
+
+
+# --- launcher e2es ----------------------------------------------------------
+
+
+@pytest.mark.e2e
+def test_sharded_parity_all_frameworks_2_ranks(run_launcher):
+    """jax + torch + tf sharded optimizers match their replicated
+    references at 2 ranks, with the opt_state_bytes memory claim and
+    int8-on-the-scatter-leg asserted in-worker."""
+    result = run_launcher(2, "sharded_update_worker.py",
+                          {"SHARDED_TEST_FRAMEWORKS": "jax,torch,tf"},
+                          timeout=420)
+    assert result.returncode == 0, (result.stdout, result.stderr)
+    assert result.stdout.count("sharded update worker passed") == 2
+    assert result.stdout.count("jax sharded parity passed") == 2
+    assert result.stdout.count("torch sharded parity passed") == 2
+    assert result.stdout.count("tf sharded parity passed") == 2
+
+
+@pytest.mark.e2e
+def test_sharded_parity_4_ranks_uneven_shards(run_launcher):
+    """4 ranks over 101 elements: every shard size differs from the
+    padding remainder (26/25/25/25) — the uneven-partition path."""
+    result = run_launcher(4, "sharded_update_worker.py", timeout=420)
+    assert result.returncode == 0, (result.stdout, result.stderr)
+    assert result.stdout.count("sharded update worker passed") == 4
+
+
+@pytest.mark.e2e
+def test_elastic_shrink_then_regrow_with_sharded_update():
+    """Acceptance (docs/ZERO.md): elastic shrink-then-regrow with the
+    sharded update enabled. Worker 1 kills itself at gen-0 step 7; the
+    survivors roll back to the step-5 commit, RE-SHARD the committed
+    full-form Adam state for world size 2, continue, and a respawned
+    worker regrows the job to 3 — training completes with the loss
+    decreasing across both membership changes."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    from tests.conftest import REPO_ROOT, clean_worker_env
+
+    env = clean_worker_env({
+        "HVD_TPU_ELASTIC_COOLDOWN": "2",
+        "HVD_TPU_ELASTIC_DISCOVERY_INTERVAL": "0.3",
+        "HVD_TPU_START_TIMEOUT": "30",
+        "HVD_TPU_SHARDED_UPDATE": "1",  # the job-wide knob rides too
+        "DURABLE_TEST_TOTAL_STEPS": "30",
+        "DURABLE_TEST_COMMIT_EVERY": "5",
+        "DURABLE_TEST_CRASH_STEP": "7",
+        "DURABLE_TEST_CRASH_WIDS": "1",
+        "DURABLE_TEST_STEP_SLEEP": "0.25",
+    })
+    result = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run.run", "-np", "3",
+         "--min-np", "1", "--",
+         sys.executable, os.path.join(REPO_ROOT, "tests",
+                                      "sharded_durable_worker.py")],
+        env=env, timeout=240, capture_output=True, text=True)
+    out = result.stdout
+    assert result.returncode == 0, (out, result.stderr)
+    assert "worker 1 crashing now" in out
+
+    line = re.compile(r"worker (\S+) gen (\d+) step (\d+) size (\d+) "
+                      r"loss ([0-9.]+)")
+    rows = [(w, int(g), int(s), int(n), float(l))
+            for w, g, s, n, l in line.findall(out)]
+    gen0 = [r for r in rows if r[1] == 0]
+    gen1 = [r for r in rows if r[1] == 1]
+    grown = [r for r in rows if r[1] >= 2]
+    assert gen0 and gen1 and grown, rows
+
+    # Shrink: generation 1 runs at size 2 and resumes from the step-5
+    # commit (the committed full-form optimizer state re-sharded 3->2).
+    assert all(r[3] == 2 for r in gen1)
+    assert min(r[2] for r in gen1) == 6
+    # Grow: a later generation reaches size 3 again with a respawned
+    # worker id outside the original cohort (full re-shard 2->3).
+    assert any(r[3] == 3 for r in grown)
+    assert any(not r[0].isdigit() or int(r[0]) > 2 for r in grown), \
+        "replacement worker not absorbed"
+
+    done = re.findall(r"done step (\d+) crc [0-9a-f]{8} loss ([0-9.]+)",
+                      out)
+    assert len(done) == 3, out
+    assert all(int(s) == 30 for s, _ in done)
+    final_loss = float(done[0][1])
+    assert final_loss < min(r[4] for r in gen0)
+
+
+@pytest.mark.e2e
+def test_mixed_mode_ranks_rejected_naming_both(run_launcher):
+    """One sharded rank meeting one replicated rank fails FAST with an
+    error naming both ranks and both modes — at the raw-collective level
+    and at the optimizer level (acceptance, docs/ZERO.md)."""
+    result = run_launcher(2, "sharded_mixed_worker.py", timeout=180)
+    assert result.returncode == 0, (result.stdout, result.stderr)
+    out = result.stdout
+    assert out.count("mixed-mode rejected naming both ranks and modes") == 2
+    assert out.count("optimizer-level mixed mode rejected") == 2
+    assert out.count("mixed worker passed") == 2

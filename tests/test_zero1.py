@@ -93,6 +93,6 @@ def test_zero1_rejects_compression():
 
     params, batch, loss_fn = _problem()
     mesh = data_parallel_mesh(devices=jax.devices("cpu"))
-    with pytest.raises(ValueError, match="zero1"):
+    with pytest.raises(ValueError, match="legacy codec"):
         make_train_step(loss_fn, optax.sgd(0.1), mesh, zero1=True,
                         compression=hvd_jax.Compression.fp16)
