@@ -202,6 +202,18 @@ def job_metrics():
     return _metrics.job_metrics()
 
 
+def autotune():
+    """Live closed-loop tuner state (docs/AUTOTUNE.md) as a dict:
+    ``active``, ``rearm_epoch``/``rearms_total``, sample count, best
+    score, the synchronized knob values under ``params`` (fusion_mb,
+    cycle_time_ms, pipeline_chunk_kb, cache_enabled, the three
+    hierarchical toggles), which knobs env pinned under ``fixed``, the
+    observed workload ``profile``, and the converged drift ``baseline``.
+    Callable any time from any thread."""
+    import json as _json
+    return _json.loads(get_basics().autotune_json())
+
+
 def is_initialized():
     return get_basics().initialized()
 

@@ -188,6 +188,15 @@ class HorovodBasics:
             ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
         ]
+        # Optional in older cores (a stale HVD_TPU_NATIVE_DIR build):
+        # the binding degrades to autotune_params-only introspection
+        # instead of failing every import.
+        try:
+            lib.horovod_tpu_autotune_json.restype = ctypes.c_char_p
+            lib.horovod_tpu_autotune_json.argtypes = []
+            self._has_autotune_json = True
+        except AttributeError:
+            self._has_autotune_json = False
 
     # -- lifecycle ---------------------------------------------------------
     def init(self):
@@ -315,6 +324,26 @@ class HorovodBasics:
         the wire with (non-f32 degrades to 0 = none)."""
         return int(self.lib.horovod_tpu_effective_compression(
             int(mode), int(dtype)))
+
+    def autotune_json(self):
+        """The full live closed-loop tuner state (docs/AUTOTUNE.md) as a
+        JSON string: knobs (incl. pipeline_chunk_kb and
+        hierarchical_reduce_scatter), fixed flags, workload profile,
+        re-arm epoch/counters, and the convergence baseline the drift
+        watch compares against. Callable any time from any thread."""
+        if not self._has_autotune_json:
+            # Keep the documented hvd.autotune() schema stable: knobs
+            # under "params", closed-loop state zeroed (the old core
+            # has no re-arm machinery to report).
+            import json
+            p = self.autotune_params()
+            return json.dumps({
+                "active": p.pop("active"),
+                "rearm_epoch": 0, "rearms_total": 0, "samples": 0,
+                "best_score_bytes_per_us": 0.0, "last_rearm_reason": "",
+                "params": p, "fixed": {}, "profile": {}, "baseline": {},
+            })
+        return self.lib.horovod_tpu_autotune_json().decode("utf-8")
 
     def autotune_params(self):
         """Current synchronized knob values (autotune introspection):

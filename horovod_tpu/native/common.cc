@@ -1,8 +1,28 @@
 #include "common.h"
 
+#include <cstdlib>
 #include <sstream>
 
 namespace hvdtpu {
+
+int64_t EnvInt64(const char* name, int64_t dflt, bool* present) {
+  const char* v = std::getenv(name);
+  if (present != nullptr) *present = v != nullptr;
+  return v == nullptr ? dflt : std::strtoll(v, nullptr, 10);
+}
+
+double EnvDouble(const char* name, double dflt, bool* present) {
+  const char* v = std::getenv(name);
+  if (present != nullptr) *present = v != nullptr;
+  return v == nullptr ? dflt : std::strtod(v, nullptr);
+}
+
+bool EnvBool(const char* name, bool dflt, bool* present) {
+  const char* v = std::getenv(name);
+  if (present != nullptr) *present = v != nullptr;
+  if (v == nullptr) return dflt;
+  return std::strtol(v, nullptr, 10) != 0;
+}
 
 const std::string SHUT_DOWN_ERROR =
     "Horovod-TPU has been shut down. This was caused by an exception on one "

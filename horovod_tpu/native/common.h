@@ -34,6 +34,12 @@ namespace hvdtpu {
 #define HVD_TPU_DIVERGENCE_GRACE "HVD_TPU_DIVERGENCE_GRACE_SECONDS"
 #define HVD_TPU_HIERARCHICAL_ALLREDUCE "HVD_TPU_HIERARCHICAL_ALLREDUCE"
 #define HVD_TPU_HIERARCHICAL_ALLGATHER "HVD_TPU_HIERARCHICAL_ALLGATHER"
+#define HVD_TPU_HIERARCHICAL_REDUCESCATTER "HVD_TPU_HIERARCHICAL_REDUCESCATTER"
+// Pipelined ring transport (docs/AUTOTUNE.md): slice every ring hop's
+// payload into segments of this many bytes with double-buffered
+// send/recv so encode, transport, and ReduceSum overlap within the hop.
+// 0 disables slicing; unset leaves the knob to the autotuner.
+#define HVD_TPU_PIPELINE_CHUNK_BYTES "HVD_TPU_PIPELINE_CHUNK_BYTES"
 // Metrics plane (metrics.h / docs/METRICS.md): HVD_TPU_METRICS=1 turns on
 // the wire piggyback + coordinator job view without HTTP serving;
 // HVD_TPU_METRICS_PORT additionally makes Python serve Prometheus text at
@@ -86,6 +92,14 @@ constexpr int32_t HOST_DEVICE_ID = -1;
 extern const std::string SHUT_DOWN_ERROR;
 extern const std::string DUPLICATE_NAME_ERROR;
 extern const std::string CONNECTION_LOST_ERROR;
+
+// Shared env parsing (single definition so every consumer agrees on
+// strtoll/strtod semantics). `present`, when non-null, reports whether
+// the variable was set at all — the autotuner treats an env-present
+// knob as FIXED (excluded from the search).
+int64_t EnvInt64(const char* name, int64_t dflt, bool* present = nullptr);
+double EnvDouble(const char* name, double dflt, bool* present = nullptr);
+bool EnvBool(const char* name, bool dflt, bool* present = nullptr);
 
 class Status {
  public:

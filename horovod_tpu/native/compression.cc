@@ -163,4 +163,42 @@ void DecompressBuffer(const char* src, int64_t count, CompressionMode mode,
                  /*compress=*/false);
 }
 
+void DecompressAccumulate(const char* src, int64_t count,
+                          CompressionMode mode, float* dst) {
+  auto t0 = std::chrono::steady_clock::now();
+  switch (mode) {
+    case CompressionMode::NONE: {
+      const auto* in = reinterpret_cast<const float*>(src);
+      for (int64_t i = 0; i < count; ++i) dst[i] += in[i];
+      return;  // not a codec op; no metrics
+    }
+    case CompressionMode::BF16: {
+      const auto* in = reinterpret_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < count; ++i) dst[i] += BFloat16ToFloat(in[i]);
+      break;
+    }
+    case CompressionMode::INT8: {
+      int64_t nblocks =
+          (count + kCompressionBlock - 1) / kCompressionBlock;
+      const auto* scales = reinterpret_cast<const float*>(src);
+      const auto* q =
+          reinterpret_cast<const int8_t*>(src + nblocks * sizeof(float));
+      for (int64_t b = 0; b < nblocks; ++b) {
+        int64_t lo = b * kCompressionBlock;
+        int64_t hi = std::min(lo + kCompressionBlock, count);
+        float scale = scales[b];
+        for (int64_t i = lo; i < hi; ++i) {
+          dst[i] += static_cast<float>(q[i]) * scale;
+        }
+      }
+      break;
+    }
+  }
+  CountCodecWork(mode, count, CompressedSize(count, mode),
+                 std::chrono::duration<double>(
+                     std::chrono::steady_clock::now() - t0)
+                     .count(),
+                 /*compress=*/false);
+}
+
 }  // namespace hvdtpu

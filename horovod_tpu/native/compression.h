@@ -64,6 +64,16 @@ void CompressBuffer(const float* src, int64_t count, CompressionMode mode,
 void DecompressBuffer(const char* src, int64_t count, CompressionMode mode,
                       float* dst);
 
+// Fused dequant-accumulate: dst[i] += decode(src)[i] in ONE pass — the
+// pipelined ring's segment consumer (cpu_operations.cc) uses this to
+// skip the intermediate f32 scratch entirely (per hop that removes a
+// full write+read of the chunk from the memory-traffic bill; the
+// element math is identical to DecompressBuffer-then-add, so results
+// stay bitwise equal to the unsliced path). Also accepts NONE (plain
+// f32 accumulate) so callers need not branch.
+void DecompressAccumulate(const char* src, int64_t count,
+                          CompressionMode mode, float* dst);
+
 }  // namespace hvdtpu
 
 #endif  // HVD_TPU_COMPRESSION_H

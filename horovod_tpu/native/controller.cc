@@ -343,6 +343,17 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
     for (const auto& name : ready_names) {
       responses.push_back(ConstructResponse(name));
     }
+    // Workload profile for the autotuner's search space: did this cycle
+    // negotiate wire compression or a reduce-scatter? A first sighting
+    // after convergence triggers a re-arm (parameter_manager.h).
+    {
+      bool comp = false, rs = false;
+      for (const auto& resp : responses) {
+        comp = comp || resp.compression() != 0;
+        rs = rs || resp.response_type() == Response::REDUCESCATTER;
+      }
+      if (comp || rs) parameter_manager_.ObserveWorkload(comp, rs);
+    }
     // Divergence cross-check: fail provably diverged pending tensors NOW
     // with a named call site, instead of letting them hang to the stall
     // timeout (divergence.h documents the two proof rules).
@@ -362,6 +373,12 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
     }
     response_list.set_shutdown(should_shut_down);
     FuseResponses(responses, response_list);
+    // Autotune bootstrap: consume any pending re-arm NOW (after fusion,
+    // before the broadcast) and stamp the (epoch, profile) word on the
+    // list — workers mirror the re-arm at parse time in this same
+    // cycle, so the whole ring re-enters tuning in lockstep.
+    response_list.set_autotune_wire(
+        parameter_manager_.WireEpochForBroadcast());
     std::string blob;
     response_list.SerializeTo(&blob);
     BroadcastBlob(&blob);
@@ -394,6 +411,9 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
     BroadcastBlob(&response_blob);
     if (!response_list.ParseFrom(response_blob.data(), response_blob.size())) {
       LOG(FATAL) << "Failed to parse ResponseList from coordinator";
+    }
+    if (response_list.autotune_wire() != ResponseList::kAutotuneAbsent) {
+      parameter_manager_.NoteWireEpoch(response_list.autotune_wire());
     }
   }
   // Work on ANY rank makes this a full work cycle (the final list is
@@ -480,6 +500,12 @@ ResponseList Controller::ComputeResponseList(
   // inside ShouldForceFullCycle.
   if (is_coordinator() &&
       divergence_.ShouldForceFullCycle(message_table_)) {
+    cache_coordinator.set_uncached_in_queue(true);
+  }
+  // Autotune re-arm delivery: the bootstrap word rides full-cycle
+  // broadcasts only, so a pending re-arm must break the all-cached fast
+  // path until the next FinishCycle ships it.
+  if (is_coordinator() && parameter_manager_.RearmPending()) {
     cache_coordinator.set_uncached_in_queue(true);
   }
   // Metrics freshness: all-cached steady state (and total quiescence)

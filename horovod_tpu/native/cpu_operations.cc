@@ -1,7 +1,11 @@
 #include "cpu_operations.h"
 
 #include <algorithm>
+#include <condition_variable>
 #include <cstring>
+#include <functional>
+#include <mutex>
+#include <thread>
 
 #include "global_state.h"
 #include "half.h"
@@ -168,6 +172,105 @@ static int64_t MaxChunk(const std::vector<int64_t>& counts) {
   return m;
 }
 
+// ---------------------------------------------------------------------------
+// Pipelined segment engine (docs/AUTOTUNE.md "Pipelined ring transport").
+//
+// A ring hop's payload is sliced into HVD_TPU_PIPELINE_CHUNK_BYTES
+// segments and double-buffered: while segment s's decode+ReduceSum runs
+// on the worker thread, the main (background) thread encodes and
+// exchanges segment s+1 — so codec work, socket transport, and the
+// reduction overlap WITHIN a hop. Every rank derives the segment count
+// from the globally-known chunk table and the synchronized chunk knob,
+// so the per-segment frames pair up deterministically (zero-length
+// sides ride an empty frame).
+// ---------------------------------------------------------------------------
+
+// One worker thread with a depth-1 job slot: Submit blocks until the
+// previous job retired (which with two rotating buffers is exactly the
+// guarantee that a buffer is free for reuse), Drain blocks until idle.
+class SegmentWorker {
+ public:
+  SegmentWorker() : thread_([this] { Loop(); }) {}
+  ~SegmentWorker() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    thread_.join();
+  }
+
+  void Submit(std::function<void()> fn) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [&] { return !busy_; });
+    job_ = std::move(fn);
+    busy_ = true;
+    cv_.notify_all();
+  }
+
+  void Drain() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [&] { return !busy_; });
+  }
+
+ private:
+  void Loop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+      cv_.wait(lk, [&] { return busy_ || stop_; });
+      if (stop_) return;
+      std::function<void()> job = std::move(job_);
+      lk.unlock();
+      job();
+      lk.lock();
+      busy_ = false;
+      cv_.notify_all();
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::function<void()> job_;
+  bool busy_ = false;
+  bool stop_ = false;
+  std::thread thread_;
+};
+
+// Elements per pipeline segment for the given knob value. Compressed
+// payloads align to the int8 quantization block so a per-segment encode
+// is bitwise-identical to the whole-chunk encode (block boundaries
+// coincide); bf16 has no blocks but keeps the same alignment for free.
+static int64_t SegmentElems(int64_t pipe_bytes, std::size_t elem,
+                            CompressionMode cmp) {
+  if (pipe_bytes <= 0) return 0;
+  int64_t n = std::max<int64_t>(1, pipe_bytes / static_cast<int64_t>(elem));
+  if (cmp != CompressionMode::NONE) {
+    n = std::max<int64_t>(kCompressionBlock,
+                          (n / kCompressionBlock) * kCompressionBlock);
+  }
+  return n;
+}
+
+// Ring-global segment count for a hop table: every rank must loop the
+// same number of segment exchanges per hop or the frame stream desyncs.
+static int64_t SegmentCount(const std::vector<int64_t>& counts, int64_t seg) {
+  if (seg <= 0) return 1;
+  int64_t max_chunk = MaxChunk(counts);
+  return max_chunk <= seg ? 1 : (max_chunk + seg - 1) / seg;
+}
+
+static int64_t ClampSeg(int64_t chunk_count, int64_t soff, int64_t seg) {
+  return std::max<int64_t>(0, std::min(seg, chunk_count - soff));
+}
+
+// Offset used for pointer arithmetic: clamped to the chunk end so a
+// zero-length tail segment (short chunk, ring-global segment count)
+// forms at most a one-past-the-end pointer — forming one further out
+// is UB even when the length-0 exchange never dereferences it.
+static int64_t SegOff(int64_t chunk_count, int64_t soff) {
+  return std::min(soff, chunk_count);
+}
+
 // Reduce-scatter leg of a ring allreduce: after n-1 steps ring rank r owns
 // chunk (r+1) % n, reduced over the whole ring.
 //
@@ -177,18 +280,91 @@ static int64_t MaxChunk(const std::vector<int64_t>& counts) {
 // payload, and the receiver decodes (dequant) and ReduceSums in f32 —
 // so wire bytes shrink while the sum never accumulates in the narrow
 // format. CRC framing in RingExchangeOn covers the compressed payload.
+//
+// With pipe_bytes > 0 each hop runs the segmented double-buffered
+// pipeline above; pipe_bytes == 0 (or a hop smaller than one segment)
+// takes the original unsliced exchange.
 static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
                                   const std::vector<int64_t>& counts,
                                   const std::vector<int64_t>& offsets,
-                                  DataType dtype, CompressionMode cmp) {
+                                  DataType dtype, CompressionMode cmp,
+                                  int64_t pipe_bytes) {
   int n = ctx.RingSize(ring);
   int rank = ctx.RingRank(ring);
   std::size_t elem = DataTypeSize(dtype);
+  int64_t seg = SegmentElems(pipe_bytes, elem, cmp);
+  int64_t nseg = SegmentCount(counts, seg);
   if (cmp != CompressionMode::NONE) {
-    // Scratch sized by the LARGEST chunk: callers may pass a rotated
-    // chunk order (the standalone reduce-scatter op does), so counts[0]
-    // is not necessarily the maximum.
     float* f = reinterpret_cast<float*>(buf);
+    if (nseg > 1) {
+      // Three concurrent stages: the encoder thread requantizes segment
+      // s+1 and the reducer thread dequantizes+sums segment s-1 WHILE
+      // the main thread's socket exchange moves segment s — per-hop
+      // cost drops from encode+transport+decode+reduce in series to
+      // ~max(encode, transport, decode+reduce).
+      std::vector<char> send_c[2] = {
+          std::vector<char>(CompressedSize(seg, cmp)),
+          std::vector<char>(CompressedSize(seg, cmp))};
+      std::vector<char> recv_c[2] = {
+          std::vector<char>(CompressedSize(seg, cmp)),
+          std::vector<char>(CompressedSize(seg, cmp))};
+      SegmentWorker encoder;  // declared after buffers: join before free
+      SegmentWorker reducer;
+      Metrics& m = GlobalMetrics();
+      for (int step = 0; step < n - 1; ++step) {
+        int send_chunk = (rank - step + n) % n;
+        int recv_chunk = (rank - step - 1 + n) % n;
+        auto encode_seg = [&, send_chunk](int64_t s) {
+          int64_t soff = s * seg;
+          int64_t sn = ClampSeg(counts[send_chunk], soff, seg);
+          const float* src =
+              f + offsets[send_chunk] + SegOff(counts[send_chunk], soff);
+          char* out = send_c[s & 1].data();
+          encoder.Submit([src, sn, cmp, out] {
+            CompressBuffer(src, sn, cmp, out);
+          });
+        };
+        encode_seg(0);
+        for (int64_t s = 0; s < nseg; ++s) {
+          int64_t soff = s * seg;
+          int64_t sn = ClampSeg(counts[send_chunk], soff, seg);
+          int64_t rn = ClampSeg(counts[recv_chunk], soff, seg);
+          // Queue the next segment's encode; either way the depth-1
+          // slot guarantees THIS segment's encode has retired before
+          // its buffer goes on the wire.
+          if (s + 1 < nseg) {
+            encode_seg(s + 1);
+          } else {
+            encoder.Drain();
+          }
+          char* rc = recv_c[s & 1].data();
+          if (!ctx.RingExchangeOn(ring, send_c[s & 1].data(),
+                                  CompressedSize(sn, cmp), rc,
+                                  CompressedSize(rn, cmp))) {
+            encoder.Drain();
+            reducer.Drain();
+            return RingLost(ctx, "ring reduce-scatter exchange failed");
+          }
+          m.pipeline_segments_total.fetch_add(1, std::memory_order_relaxed);
+          if (rn > 0) {
+            // Fused dequant-accumulate: no intermediate f32 scratch —
+            // the decode and the ReduceSum are one pass (bitwise-equal
+            // element math to decompress-then-add).
+            float* dst = f + offsets[recv_chunk] + soff;
+            reducer.Submit([rc, rn, cmp, dst] {
+              DecompressAccumulate(rc, rn, cmp, dst);
+            });
+          }
+        }
+        // Hop barrier: the next hop encodes/forwards what this hop
+        // reduced.
+        reducer.Drain();
+      }
+      return Status::OK();
+    }
+    // Unsliced path. Scratch sized by the LARGEST chunk: callers may
+    // pass a rotated chunk order (the standalone reduce-scatter op
+    // does), so counts[0] is not necessarily the maximum.
     int64_t max_chunk = MaxChunk(counts);
     std::vector<char> send_c(CompressedSize(max_chunk, cmp));
     std::vector<char> recv_c(CompressedSize(max_chunk, cmp));
@@ -210,6 +386,40 @@ static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
     }
     return Status::OK();
   }
+  if (nseg > 1) {
+    std::vector<char> tmp[2] = {
+        std::vector<char>(static_cast<std::size_t>(seg) * elem),
+        std::vector<char>(static_cast<std::size_t>(seg) * elem)};
+    SegmentWorker worker;
+    Metrics& m = GlobalMetrics();
+    for (int step = 0; step < n - 1; ++step) {
+      int send_chunk = (rank - step + n) % n;
+      int recv_chunk = (rank - step - 1 + n) % n;
+      for (int64_t s = 0; s < nseg; ++s) {
+        int64_t soff = s * seg;
+        int64_t sn = ClampSeg(counts[send_chunk], soff, seg);
+        int64_t rn = ClampSeg(counts[recv_chunk], soff, seg);
+        char* rc = tmp[s & 1].data();
+        if (!ctx.RingExchangeOn(
+                ring,
+                buf + (offsets[send_chunk] +
+                       SegOff(counts[send_chunk], soff)) * elem,
+                sn * elem, rc, rn * elem)) {
+          worker.Drain();
+          return RingLost(ctx, "ring reduce-scatter exchange failed");
+        }
+        m.pipeline_segments_total.fetch_add(1, std::memory_order_relaxed);
+        if (rn > 0) {
+          char* dst = buf + (offsets[recv_chunk] + soff) * elem;
+          worker.Submit([dst, rc, rn, dtype] {
+            ReduceSum(dst, rc, rn, dtype);
+          });
+        }
+      }
+      worker.Drain();
+    }
+    return Status::OK();
+  }
   std::vector<char> tmp(static_cast<std::size_t>(MaxChunk(counts)) * elem);
   for (int step = 0; step < n - 1; ++step) {
     int send_chunk = (rank - step + n) % n;
@@ -228,24 +438,78 @@ static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
 // Allgather leg: circulates the fully-reduced chunks (owned per the
 // reduce-scatter leg above) until every ring member has all of them.
 //
-// Compressed variant: each owner encodes its reduced chunk ONCE, decodes
-// its own copy back (so the owner holds exactly what everyone else will
-// decode), and the ring then forwards the encoded payloads VERBATIM —
-// no per-hop requantization, so there is no hop-count-dependent drift
-// and every rank ends with bitwise-identical chunk values.
+// Compressed variant: each owner encodes its reduced chunk ONCE (per
+// segment), decodes its own copy back (so the owner holds exactly what
+// everyone else will decode), and the ring then forwards the encoded
+// payloads VERBATIM — no per-hop requantization, so there is no
+// hop-count-dependent drift and every rank ends with bitwise-identical
+// chunk values. With pipe_bytes > 0 the decode of segment s overlaps
+// the transport of segment s+1 (the uncompressed leg has no compute to
+// overlap and stays unsliced).
 static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
                                    const std::vector<int64_t>& counts,
                                    const std::vector<int64_t>& offsets,
-                                   DataType dtype, CompressionMode cmp) {
+                                   DataType dtype, CompressionMode cmp,
+                                   int64_t pipe_bytes) {
   int n = ctx.RingSize(ring);
   int rank = ctx.RingRank(ring);
   std::size_t elem = DataTypeSize(dtype);
   if (cmp != CompressionMode::NONE) {
-    // Two rotating payload buffers: step s only ever forwards the chunk
-    // received at step s-1, so O(1) encoded chunks suffice (matching
-    // the uncompressed path's single tmp), not one per rank.
     float* f = reinterpret_cast<float*>(buf);
     int owned = (rank + 1) % n;
+    int64_t seg = SegmentElems(pipe_bytes, elem, cmp);
+    int64_t nseg = SegmentCount(counts, seg);
+    if (nseg > 1) {
+      // Encoded chunks live as nseg fixed-stride slots so forwarding a
+      // segment is a pure slice; every rank computes identical slot
+      // layout from (counts, seg).
+      std::size_t slot = CompressedSize(seg, cmp);
+      std::vector<char> cur(static_cast<std::size_t>(nseg) * slot);
+      std::vector<char> nxt(static_cast<std::size_t>(nseg) * slot);
+      SegmentWorker worker;
+      Metrics& m = GlobalMetrics();
+      for (int64_t s = 0; s < nseg; ++s) {
+        int64_t soff = s * seg;
+        int64_t sn = ClampSeg(counts[owned], soff, seg);
+        if (sn <= 0) continue;
+        CompressBuffer(f + offsets[owned] + soff, sn, cmp,
+                       cur.data() + s * slot);
+        DecompressBuffer(cur.data() + s * slot, sn, cmp,
+                         f + offsets[owned] + soff);
+      }
+      for (int step = 0; step < n - 1; ++step) {
+        int send_chunk = (rank + 1 - step + n) % n;
+        int recv_chunk = (rank - step + n) % n;
+        for (int64_t s = 0; s < nseg; ++s) {
+          int64_t soff = s * seg;
+          int64_t sn = ClampSeg(counts[send_chunk], soff, seg);
+          int64_t rn = ClampSeg(counts[recv_chunk], soff, seg);
+          char* rc = nxt.data() + s * slot;
+          if (!ctx.RingExchangeOn(ring, cur.data() + s * slot,
+                                  CompressedSize(sn, cmp), rc,
+                                  CompressedSize(rn, cmp))) {
+            worker.Drain();
+            return RingLost(ctx, "ring allgather exchange failed");
+          }
+          m.pipeline_segments_total.fetch_add(1, std::memory_order_relaxed);
+          if (rn > 0) {
+            float* dst = f + offsets[recv_chunk] + soff;
+            worker.Submit([rc, rn, cmp, dst] {
+              DecompressBuffer(rc, rn, cmp, dst);
+            });
+          }
+        }
+        // Decode jobs read `nxt`; the swap hands it to the next hop's
+        // send side, so they must retire first.
+        worker.Drain();
+        std::swap(cur, nxt);
+      }
+      return Status::OK();
+    }
+    // Unsliced: two rotating payload buffers — step s only ever
+    // forwards the chunk received at step s-1, so O(1) encoded chunks
+    // suffice (matching the uncompressed path's single tmp), not one
+    // per rank.
     int64_t max_chunk = MaxChunk(counts);
     std::vector<char> send_c(CompressedSize(max_chunk, cmp));
     std::vector<char> recv_c(CompressedSize(max_chunk, cmp));
@@ -280,15 +544,18 @@ static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
 }
 
 Status RingAllreduceOn(TcpContext& ctx, Ring ring, void* buffer, int64_t count,
-                       DataType dtype, CompressionMode cmp) {
+                       DataType dtype, CompressionMode cmp,
+                       int64_t pipe_bytes) {
   int n = ctx.RingSize(ring);
   if (n == 1 || count == 0) return Status::OK();
   std::vector<int64_t> counts, offsets;
   PartitionChunks(count, n, &counts, &offsets);
   char* buf = static_cast<char*>(buffer);
-  Status s = RingReduceScatterOn(ctx, ring, buf, counts, offsets, dtype, cmp);
+  Status s = RingReduceScatterOn(ctx, ring, buf, counts, offsets, dtype, cmp,
+                                 pipe_bytes);
   if (!s.ok()) return s;
-  return RingAllgatherPhaseOn(ctx, ring, buf, counts, offsets, dtype, cmp);
+  return RingAllgatherPhaseOn(ctx, ring, buf, counts, offsets, dtype, cmp,
+                              pipe_bytes);
 }
 
 bool CpuRingAllreduce::Enabled(const std::vector<TensorTableEntry>& entries,
@@ -298,7 +565,9 @@ bool CpuRingAllreduce::Enabled(const std::vector<TensorTableEntry>& entries,
 
 Status CpuRingAllreduce::ReduceBuffer(void* buffer, int64_t count,
                                       DataType dtype, CompressionMode cmp) {
-  return RingAllreduceOn(ctx_, Ring::GLOBAL, buffer, count, dtype, cmp);
+  return RingAllreduceOn(ctx_, Ring::GLOBAL, buffer, count, dtype, cmp,
+                         global_state_->parameter_manager
+                             .PipelineChunkBytes());
 }
 
 Status CpuRingAllreduce::Execute(std::vector<TensorTableEntry>& entries,
@@ -398,22 +667,23 @@ Status CpuHierarchicalAllreduce::ReduceBuffer(void* buffer, int64_t count,
   int lr = ctx_.local_rank();
   if (count == 0) return Status::OK();
   std::size_t elem = DataTypeSize(dtype);
+  int64_t pipe = global_state_->parameter_manager.PipelineChunkBytes();
 
   std::vector<int64_t> counts, offsets;
   PartitionChunks(count, ls, &counts, &offsets);
   char* buf = static_cast<char*>(buffer);
 
   Status s = RingReduceScatterOn(ctx_, Ring::LOCAL, buf, counts, offsets,
-                                 dtype, cmp);
+                                 dtype, cmp, pipe);
   if (!s.ok()) return s;
 
   int owned = (lr + 1) % ls;
   s = RingAllreduceOn(ctx_, Ring::CROSS, buf + offsets[owned] * elem,
-                      counts[owned], dtype, cmp);
+                      counts[owned], dtype, cmp, pipe);
   if (!s.ok()) return s;
 
   return RingAllgatherPhaseOn(ctx_, Ring::LOCAL, buf, counts, offsets, dtype,
-                              cmp);
+                              cmp, pipe);
 }
 
 bool CpuRingReduceScatter::Enabled(
@@ -438,6 +708,7 @@ Status CpuRingReduceScatter::Execute(std::vector<TensorTableEntry>& entries,
   CompressionMode cmp = EffectiveCompression(
       static_cast<CompressionMode>(response.compression()),
       entries[0].dtype);
+  int64_t pipe = global_state_->parameter_manager.PipelineChunkBytes();
   Metrics& m = GlobalMetrics();
   timeline.ActivityStartAll(response.tensor_names(), "REDUCE_SCATTER_RING");
   for (auto& e : entries) {
@@ -473,11 +744,250 @@ Status CpuRingReduceScatter::Execute(std::vector<TensorTableEntry>& entries,
       ScaleBuffer(work.data(), count, e.dtype, e.prescale_factor);
     }
     Status s = RingReduceScatterOn(ctx_, Ring::GLOBAL, work.data(),
-                                   ring_counts, ring_offsets, e.dtype, cmp);
+                                   ring_counts, ring_offsets, e.dtype, cmp,
+                                   pipe);
     if (!s.ok()) {
       timeline.ActivityEndAll(response.tensor_names());
       return s;
     }
+    std::memcpy(e.output, work.data() + offsets[rank] * elem,
+                static_cast<std::size_t>(counts[rank]) * elem);
+    if (e.postscale_factor != 1.0) {
+      ScaleBuffer(e.output, counts[rank], e.dtype, e.postscale_factor);
+    }
+  }
+  timeline.ActivityEndAll(response.tensor_names());
+  return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// Hierarchical reduce-scatter (docs/ZERO.md + docs/AUTOTUNE.md): intra-host
+// reduce -> inter-host ring -> shard distribution, so sharded_update jobs
+// get the same two-level treatment allreduce/allgather already have. The
+// inter-host links carry each byte once per HOST instead of once per rank.
+// ---------------------------------------------------------------------------
+
+// One contiguous span of the flattened tensor belonging to a local
+// rank's chunk group.
+struct GroupSeg {
+  int64_t off;  // elements
+  int64_t cnt;  // elements
+};
+
+// Reduces decoded elements [a, b) of a group's packed layout into the
+// scattered destination spans. Runs on the segment worker thread; the
+// destination spans are disjoint from anything the main thread touches
+// during the same hop.
+static void ReduceScattered(char* buf, const std::vector<GroupSeg>& segs,
+                            const char* decoded, int64_t a, int64_t b,
+                            std::size_t elem, DataType dtype) {
+  int64_t pos = 0;
+  for (const auto& s : segs) {
+    int64_t s_end = pos + s.cnt;
+    if (s_end <= a) {
+      pos = s_end;
+      continue;
+    }
+    if (pos >= b) break;
+    int64_t lo = std::max(a, pos), hi = std::min(b, s_end);
+    if (hi > lo) {
+      ReduceSum(buf + (s.off + (lo - pos)) * elem,
+                decoded + (lo - a) * elem, hi - lo, dtype);
+    }
+    pos = s_end;
+  }
+}
+
+// Ring reduce-scatter over chunk GROUPS: ring position m ends up owning
+// ring group (m+1) % n, reduced over the ring — the grouped analogue of
+// RingReduceScatterOn for stage 1 of the hierarchical reduce-scatter,
+// where one local rank's "chunk" is the set of logical chunks of its
+// cross-ring column (scattered spans, so hops stage through a packed
+// buffer). Segmented-pipelined exactly like the flat legs: decode +
+// scatter-reduce of segment s overlaps the pack/encode/transport of
+// segment s+1.
+static Status GroupedRingReduceScatter(
+    TcpContext& ctx, Ring ring, char* buf,
+    const std::vector<std::vector<GroupSeg>>& ring_groups, DataType dtype,
+    CompressionMode cmp, int64_t pipe_bytes) {
+  int n = ctx.RingSize(ring);
+  int rank = ctx.RingRank(ring);
+  std::size_t elem = DataTypeSize(dtype);
+  std::vector<int64_t> group_elems(n, 0);
+  for (int j = 0; j < n; ++j) {
+    for (const auto& s : ring_groups[j]) group_elems[j] += s.cnt;
+  }
+  int64_t seg = SegmentElems(pipe_bytes, elem, cmp);
+  int64_t nseg = SegmentCount(group_elems, seg);
+  int64_t max_group = MaxChunk(group_elems);
+  if (max_group == 0) return Status::OK();
+  if (seg <= 0 || nseg <= 1) {
+    seg = max_group;
+    nseg = 1;
+  }
+
+  std::vector<char> pack(static_cast<std::size_t>(max_group) * elem);
+  bool compressed = cmp != CompressionMode::NONE;
+  std::vector<char> send_c(compressed ? CompressedSize(seg, cmp) : 0);
+  std::vector<char> recv_c[2] = {
+      std::vector<char>(compressed ? CompressedSize(seg, cmp)
+                                   : static_cast<std::size_t>(seg) * elem),
+      std::vector<char>(compressed ? CompressedSize(seg, cmp)
+                                   : static_cast<std::size_t>(seg) * elem)};
+  std::vector<float> dec[2] = {
+      std::vector<float>(compressed ? static_cast<std::size_t>(seg) : 0),
+      std::vector<float>(compressed ? static_cast<std::size_t>(seg) : 0)};
+  SegmentWorker worker;
+  Metrics& m = GlobalMetrics();
+
+  for (int step = 0; step < n - 1; ++step) {
+    int send_g = (rank - step + n) % n;
+    int recv_g = (rank - step - 1 + n) % n;
+    // Pack the outgoing group (it carries every reduction applied so
+    // far — the group received and reduced last hop is the one
+    // forwarded this hop, as in the flat ring).
+    {
+      char* p = pack.data();
+      for (const auto& s : ring_groups[send_g]) {
+        std::memcpy(p, buf + s.off * elem,
+                    static_cast<std::size_t>(s.cnt) * elem);
+        p += s.cnt * elem;
+      }
+    }
+    const std::vector<GroupSeg>& recv_segs = ring_groups[recv_g];
+    for (int64_t s = 0; s < nseg; ++s) {
+      int64_t soff = s * seg;
+      int64_t sn = ClampSeg(group_elems[send_g], soff, seg);
+      int64_t rn = ClampSeg(group_elems[recv_g], soff, seg);
+      bool ok;
+      char* rc = recv_c[s & 1].data();
+      if (compressed) {
+        CompressBuffer(
+            reinterpret_cast<const float*>(pack.data()) + soff, sn, cmp,
+            send_c.data());
+        ok = ctx.RingExchangeOn(ring, send_c.data(), CompressedSize(sn, cmp),
+                                rc, CompressedSize(rn, cmp));
+      } else {
+        ok = ctx.RingExchangeOn(ring, pack.data() + soff * elem, sn * elem,
+                                rc, rn * elem);
+      }
+      if (!ok) {
+        worker.Drain();
+        return RingLost(ctx, "hierarchical reduce-scatter local leg failed");
+      }
+      if (nseg > 1) {
+        m.pipeline_segments_total.fetch_add(1, std::memory_order_relaxed);
+      }
+      if (rn > 0) {
+        if (compressed) {
+          float* dbuf = dec[s & 1].data();
+          worker.Submit([buf, &recv_segs, rc, dbuf, soff, rn, cmp, elem,
+                         dtype] {
+            DecompressBuffer(rc, rn, cmp, dbuf);
+            ReduceScattered(buf, recv_segs,
+                            reinterpret_cast<const char*>(dbuf), soff,
+                            soff + rn, elem, dtype);
+          });
+        } else {
+          worker.Submit([buf, &recv_segs, rc, soff, rn, elem, dtype] {
+            ReduceScattered(buf, recv_segs, rc, soff, soff + rn, elem,
+                            dtype);
+          });
+        }
+      }
+    }
+    // Hop barrier: the pack of the next hop reads what this hop reduced.
+    worker.Drain();
+  }
+  return Status::OK();
+}
+
+bool CpuHierarchicalReduceScatter::Enabled(
+    const std::vector<TensorTableEntry>& entries,
+    const Response& response) const {
+  return entries[0].device == HOST_DEVICE_ID &&
+         ctx_.hierarchical_possible() &&
+         global_state_->parameter_manager.HierarchicalReduceScatter();
+}
+
+Status CpuHierarchicalReduceScatter::Execute(
+    std::vector<TensorTableEntry>& entries, const Response& response) {
+  // Three stages (grid (local_rank, cross_rank) -> global rank via
+  // RankAt; logical chunk r belongs to global rank r):
+  //   1. intra-host grouped reduce-scatter: local rank j ends up owning
+  //      group_j = { chunk of RankAt(j, c) for every host c }, reduced
+  //      over this host's ranks;
+  //   2. inter-host ring reduce-scatter of group_j over the cross ring
+  //      at local_rank j (relabeled so cross rank c lands on the chunk
+  //      of RankAt(j, c) — i.e. every rank ends holding ITS OWN logical
+  //      chunk, fully reduced);
+  //   3. shard distribution: copy the owned chunk into the shard-sized
+  //      output and postscale.
+  int n = ctx_.size();
+  int rank = ctx_.rank();
+  int ls = ctx_.local_size(), lr = ctx_.local_rank();
+  int cs = ctx_.cross_size();
+  auto& timeline = global_state_->timeline;
+  CompressionMode cmp = EffectiveCompression(
+      static_cast<CompressionMode>(response.compression()),
+      entries[0].dtype);
+  int64_t pipe = global_state_->parameter_manager.PipelineChunkBytes();
+  Metrics& m = GlobalMetrics();
+  timeline.ActivityStartAll(response.tensor_names(),
+                            "REDUCE_SCATTER_HIERARCHICAL");
+  for (auto& e : entries) {
+    int64_t count = e.NumElements();
+    std::size_t elem = DataTypeSize(e.dtype);
+    std::vector<int64_t> counts, offsets;
+    PartitionChunks(count, n, &counts, &offsets);
+    m.reduce_scatter_total.fetch_add(1, std::memory_order_relaxed);
+    m.reduce_scatter_bytes_total.fetch_add(
+        static_cast<uint64_t>(count) * elem, std::memory_order_relaxed);
+    m.reduce_scatter_hierarchical_total.fetch_add(1,
+                                                  std::memory_order_relaxed);
+    if (count == 0) continue;
+
+    std::vector<char> work(static_cast<std::size_t>(count) * elem);
+    std::memcpy(work.data(), e.data, work.size());
+    if (e.prescale_factor != 1.0) {
+      ScaleBuffer(work.data(), count, e.dtype, e.prescale_factor);
+    }
+
+    // Stage 1 groups, ring-relabeled exactly like the flat op's chunks:
+    // ring position m ends owning ring group (m+1)%ls, so ring group m
+    // = group (m+ls-1)%ls leaves local rank j with group_j.
+    std::vector<std::vector<GroupSeg>> ring_groups(ls);
+    for (int mpos = 0; mpos < ls; ++mpos) {
+      int j = (mpos + ls - 1) % ls;
+      for (int c = 0; c < cs; ++c) {
+        int g = ctx_.RankAt(j, c);
+        ring_groups[mpos].push_back({offsets[g], counts[g]});
+      }
+    }
+    Status s = GroupedRingReduceScatter(ctx_, Ring::LOCAL, work.data(),
+                                        ring_groups, e.dtype, cmp, pipe);
+    if (!s.ok()) {
+      timeline.ActivityEndAll(response.tensor_names());
+      return s;
+    }
+
+    // Stage 2: cross-ring reduce-scatter of my group's per-host chunks
+    // (each contiguous; ring chunk m relabeled so cross rank c ends on
+    // the chunk of RankAt(lr, c)).
+    std::vector<int64_t> ring_counts(cs), ring_offsets(cs);
+    for (int mpos = 0; mpos < cs; ++mpos) {
+      int g = ctx_.RankAt(lr, (mpos + cs - 1) % cs);
+      ring_counts[mpos] = counts[g];
+      ring_offsets[mpos] = offsets[g];
+    }
+    s = RingReduceScatterOn(ctx_, Ring::CROSS, work.data(), ring_counts,
+                            ring_offsets, e.dtype, cmp, pipe);
+    if (!s.ok()) {
+      timeline.ActivityEndAll(response.tensor_names());
+      return s;
+    }
+
+    // Stage 3: shard distribution.
     std::memcpy(e.output, work.data() + offsets[rank] * elem,
                 static_cast<std::size_t>(counts[rank]) * elem);
     if (e.postscale_factor != 1.0) {

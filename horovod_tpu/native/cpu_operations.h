@@ -82,6 +82,20 @@ class CpuRingReduceScatter : public ReduceScatterOp {
   TcpContext& ctx_;
 };
 
+// Two-level reduce-scatter (intra-host grouped reduce -> inter-host ring
+// -> shard distribution), gated on the topology being hierarchical AND
+// the autotuned HierarchicalReduceScatter knob — sharded_update's data
+// leg gets the same inter-host byte economy the hierarchical allreduce/
+// allgather have (each byte crosses the host boundary once per HOST).
+class CpuHierarchicalReduceScatter : public CpuRingReduceScatter {
+ public:
+  using CpuRingReduceScatter::CpuRingReduceScatter;
+  bool Enabled(const std::vector<TensorTableEntry>& entries,
+               const Response& response) const override;
+  Status Execute(std::vector<TensorTableEntry>& entries,
+                 const Response& response) override;
+};
+
 class CpuRingAllgather : public AllgatherOp {
  public:
   CpuRingAllgather(TcpContext& ctx, HorovodGlobalState* state)
@@ -123,10 +137,14 @@ void ReduceSum(void* dst, const void* src, int64_t count, DataType dtype);
 void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor);
 // In-place ring allreduce of `count` elements on the chosen ring, with
 // per-hop wire compression (cmp != NONE requires dtype == f32 — the
-// negotiation's EffectiveCompression guarantees it).
+// negotiation's EffectiveCompression guarantees it). pipe_bytes > 0
+// slices each hop into double-buffered pipeline segments of that many
+// (uncompressed-equivalent) bytes so codec + transport + reduction
+// overlap within the hop; 0 keeps the original unsliced exchange.
 Status RingAllreduceOn(TcpContext& ctx, Ring ring, void* buffer, int64_t count,
                        DataType dtype,
-                       CompressionMode cmp = CompressionMode::NONE);
+                       CompressionMode cmp = CompressionMode::NONE,
+                       int64_t pipe_bytes = 0);
 
 }  // namespace hvdtpu
 

@@ -286,6 +286,7 @@ void ResponseList::SerializeTo(std::string* out) const {
   PutU8(out, shutdown_ ? 1 : 0);
   PutU32(out, static_cast<uint32_t>(responses_.size()));
   for (const auto& resp : responses_) resp.SerializeTo(out);
+  PutI64(out, static_cast<int64_t>(autotune_wire_));
 }
 
 bool ResponseList::ParseFrom(const char* data, std::size_t len) {
@@ -303,6 +304,12 @@ bool ResponseList::ParseFrom(const char* data, std::size_t len) {
     off += used;
     responses_.push_back(std::move(resp));
   }
+  // Autotune bootstrap tail: absent on a short (older-writer) blob —
+  // "no information", not a parse error.
+  Reader tail(data + off, len - off);
+  int64_t wire;
+  autotune_wire_ = tail.GetI64(&wire) ? static_cast<uint64_t>(wire)
+                                      : kAutotuneAbsent;
   return true;
 }
 

@@ -209,6 +209,13 @@ class Response {
 
 class ResponseList {
  public:
+  // Autotune bootstrap word: (rearm_epoch << 8) | profile bits, attached
+  // by the coordinator to every full-cycle broadcast so workers re-enter
+  // tuning at the same cycle the coordinator re-arms
+  // (parameter_manager.h). kAutotuneAbsent marks a list that never
+  // crossed the wire (fast-path local lists) or an older serializer.
+  static constexpr uint64_t kAutotuneAbsent = ~0ull;
+
   const std::vector<Response>& responses() const { return responses_; }
   std::vector<Response>& mutable_responses() { return responses_; }
   void add_response(const Response& r) { responses_.push_back(r); }
@@ -216,12 +223,16 @@ class ResponseList {
   bool shutdown() const { return shutdown_; }
   void set_shutdown(bool v) { shutdown_ = v; }
 
+  uint64_t autotune_wire() const { return autotune_wire_; }
+  void set_autotune_wire(uint64_t v) { autotune_wire_ = v; }
+
   void SerializeTo(std::string* out) const;
   bool ParseFrom(const char* data, std::size_t len);
 
  private:
   std::vector<Response> responses_;
   bool shutdown_ = false;
+  uint64_t autotune_wire_ = kAutotuneAbsent;
 };
 
 // --- low-level wire helpers (shared with net.cc) ---

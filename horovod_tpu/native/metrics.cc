@@ -72,6 +72,8 @@ const char* SummaryFieldName(int field) {
     case SUM_DRAINING: return "draining";
     case SUM_REDUCE_SCATTER: return "reduce_scatter_total";
     case SUM_OPT_STATE_BYTES: return "opt_state_bytes";
+    case SUM_AUTOTUNE_ACTIVE: return "autotune_active";
+    case SUM_AUTOTUNE_REARMS: return "autotune_rearms_total";
   }
   return "unknown";
 }
@@ -168,6 +170,9 @@ std::vector<double> Metrics::Summary() const {
   v[SUM_DRAINING] = static_cast<double>(draining.load());
   v[SUM_REDUCE_SCATTER] = static_cast<double>(reduce_scatter_total.load());
   v[SUM_OPT_STATE_BYTES] = static_cast<double>(opt_state_bytes.load());
+  v[SUM_AUTOTUNE_ACTIVE] = static_cast<double>(autotune_active.load());
+  v[SUM_AUTOTUNE_REARMS] =
+      static_cast<double>(autotune_rearms_total.load());
   return v;
 }
 
@@ -310,6 +315,12 @@ std::string Metrics::SnapshotJson() const {
            &first);
   AppendKV(&out, "reduce_scatter_bytes_total",
            reduce_scatter_bytes_total.load(), &first);
+  AppendKV(&out, "reduce_scatter_hierarchical_total",
+           reduce_scatter_hierarchical_total.load(), &first);
+  AppendKV(&out, "pipeline_segments_total",
+           pipeline_segments_total.load(), &first);
+  AppendKV(&out, "autotune_rearms_total",
+           autotune_rearms_total.load(), &first);
   out.append("},\"gauges\":{");
   first = true;
   AppendKV(&out, "queue_depth", static_cast<double>(queue_depth.load()),
@@ -328,6 +339,10 @@ std::string Metrics::SnapshotJson() const {
   AppendKV(&out, "draining", static_cast<double>(draining.load()), &first);
   AppendKV(&out, "opt_state_bytes",
            static_cast<double>(opt_state_bytes.load()), &first);
+  AppendKV(&out, "autotune_active",
+           static_cast<double>(autotune_active.load()), &first);
+  AppendKV(&out, "pipeline_chunk_bytes",
+           static_cast<double>(pipeline_chunk_bytes.load()), &first);
   out.append("},\"histograms\":{");
   first = true;
   AppendHistogram(&out, "cycle_seconds", cycle_seconds, &first);

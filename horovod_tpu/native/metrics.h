@@ -105,11 +105,18 @@ enum SummaryField : int {
   // peer's drain (0) / has never seen one (-1).
   SUM_DRAINS_REQUESTED,
   SUM_DRAINING,
-  // Sharded weight update (docs/ZERO.md). Appended last: executed
-  // reduce-scatter collectives and this rank's reported optimizer-state
-  // bytes (-1 = never reported); older decoders ignore the tail.
+  // Sharded weight update (docs/ZERO.md). Appended after the drain
+  // fields: executed reduce-scatter collectives and this rank's reported
+  // optimizer-state bytes (-1 = never reported); older decoders ignore
+  // the tail.
   SUM_REDUCE_SCATTER,
   SUM_OPT_STATE_BYTES,
+  // Always-on closed-loop autotune (docs/AUTOTUNE.md). Appended last:
+  // whether this rank's tuner is actively sampling (1) or converged (0)
+  // and how many times it re-armed; the hvd-top `tun` column renders
+  // them ('-' for a pre-autotune worker's summary).
+  SUM_AUTOTUNE_ACTIVE,
+  SUM_AUTOTUNE_REARMS,
   SUM_FIELD_COUNT
 };
 const char* SummaryFieldName(int field);
@@ -183,6 +190,17 @@ class Metrics {
   // Full-tensor payload bytes entering reduce-scatter executions (the
   // shard each rank keeps is 1/N of this).
   std::atomic<uint64_t> reduce_scatter_bytes_total{0};
+  // Reduce-scatters that took the two-level (intra-host reduce ->
+  // inter-host ring -> shard distribution) composite path.
+  std::atomic<uint64_t> reduce_scatter_hierarchical_total{0};
+
+  // --- pipelined ring transport (cpu_operations.cc / docs/AUTOTUNE.md) ---
+  // Segment exchanges issued by the double-buffered pipelined hops (a
+  // hop that ran unsliced contributes nothing here).
+  std::atomic<uint64_t> pipeline_segments_total{0};
+
+  // --- always-on closed-loop autotune (parameter_manager / operations.cc) ---
+  std::atomic<uint64_t> autotune_rearms_total{0};
 
   // --- gauges (instantaneous; reset per generation) ---
   std::atomic<int64_t> queue_depth{0};
@@ -204,6 +222,11 @@ class Metrics {
   // optimizer wrappers (docs/ZERO.md; -1 = never reported). Reset per
   // generation: an elastic resize re-shards the state and re-reports.
   std::atomic<int64_t> opt_state_bytes{-1};
+  // Live tuner posture: 1 while actively sampling, 0 once converged
+  // (docs/AUTOTUNE.md). Updated from the background loop each cycle.
+  std::atomic<int64_t> autotune_active{0};
+  // Pipelined-ring segment size currently in force (0 = slicing off).
+  std::atomic<int64_t> pipeline_chunk_bytes{0};
 
   // --- histograms ---
   MetricHistogram cycle_seconds;        // background work-cycle duration

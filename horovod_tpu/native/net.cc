@@ -392,7 +392,12 @@ bool Listener::Start(int port) {
     Close();
     return false;
   }
-  if (::listen(fd_, 128) != 0) {
+  // Backlog sized for the coordinator's connect storm: at init (and
+  // after a mass control-reconnect) every worker dials rank 0's
+  // listener at once, and a 128-entry queue drops SYNs past ~128 ranks
+  // on a slow-to-accept (oversubscribed) host. The kernel clamps to
+  // somaxconn.
+  if (::listen(fd_, 1024) != 0) {
     Close();
     return false;
   }

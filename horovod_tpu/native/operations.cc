@@ -127,28 +127,8 @@ class HandleManager {
 
 HandleManager g_handles;
 
-// ---------------- env helpers ----------------
-
-int64_t EnvInt64(const char* name, int64_t dflt, bool* present = nullptr) {
-  const char* v = std::getenv(name);
-  if (present != nullptr) *present = v != nullptr;
-  return v == nullptr ? dflt : std::strtoll(v, nullptr, 10);
-}
-
-double EnvDouble(const char* name, double dflt, bool* present = nullptr) {
-  const char* v = std::getenv(name);
-  if (present != nullptr) *present = v != nullptr;
-  return v == nullptr ? dflt : std::strtod(v, nullptr);
-}
-
-bool EnvBool(const char* name, bool dflt, bool* present = nullptr) {
-  const char* v = std::getenv(name);
-  if (present != nullptr) *present = v != nullptr;
-  if (v == nullptr) return dflt;
-  return std::strtol(v, nullptr, 10) != 0;
-}
-
 // ---------------- background loop ----------------
+// (env parsing lives in common.h EnvInt64/EnvDouble/EnvBool)
 
 // Returns (tensors, payload bytes) executed so RunLoopOnce can feed the
 // per-cycle histograms.
@@ -215,17 +195,6 @@ std::pair<int64_t, int64_t> PerformOperation(HorovodGlobalState& state,
   return {static_cast<int64_t>(entries.size()), bytes};
 }
 
-int64_t ResponseListByteTotal(HorovodGlobalState& state,
-                              const ResponseList& list) {
-  int64_t total = 0;
-  for (const auto& response : list.responses()) {
-    int64_t dtype_size =
-        static_cast<int64_t>(DataTypeSize(response.tensor_type()));
-    for (int64_t n : response.tensor_sizes()) total += n * dtype_size;
-  }
-  return total;
-}
-
 bool RunLoopOnce(HorovodGlobalState& state,
                  std::chrono::steady_clock::time_point& last_cycle_start) {
   // Pace the cycle.
@@ -270,14 +239,31 @@ bool RunLoopOnce(HorovodGlobalState& state,
       state.controller->TensorFusionThresholdBytes(),
       std::memory_order_relaxed);
 
+  // Closed-loop tuner (docs/AUTOTUNE.md): the coordinator's Update runs
+  // EVERY cycle — it advances sampling while tuning and watches for
+  // workload drift while converged (a drift re-arm is bootstrapped to
+  // the workers through the next full-cycle ResponseList). The per-cycle
+  // parameter broadcast still runs only while every rank knows tuning is
+  // live (`was_tuning` is synchronized state), keeping knob application
+  // in lockstep across ranks.
+  if (state.controller->is_coordinator()) {
+    state.parameter_manager.Update(cycle_tensors, cycle_bytes);
+  }
   if (was_tuning) {
-    if (state.controller->is_coordinator()) {
-      std::vector<std::string> names;
-      state.parameter_manager.Update(names,
-                                     ResponseListByteTotal(state,
-                                                           response_list));
-    }
     state.controller->SynchronizeParameters();
+  }
+  metrics.autotune_active.store(
+      state.parameter_manager.IsAutoTuning() ? 1 : 0,
+      std::memory_order_relaxed);
+  metrics.pipeline_chunk_bytes.store(
+      state.parameter_manager.PipelineChunkBytes(),
+      std::memory_order_relaxed);
+  uint64_t rearms = state.parameter_manager.rearms_total();
+  uint64_t seen = metrics.autotune_rearms_total.load(
+      std::memory_order_relaxed);
+  if (rearms > seen) {
+    metrics.autotune_rearms_total.fetch_add(rearms - seen,
+                                            std::memory_order_relaxed);
   }
 
   return !response_list.shutdown();
@@ -325,12 +311,21 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
     state.parameter_manager.SetHierarchicalAllreduce(hier_ar, fixed);
     bool hier_ag = EnvBool(HVD_TPU_HIERARCHICAL_ALLGATHER, false, &fixed);
     state.parameter_manager.SetHierarchicalAllgather(hier_ag, fixed);
+    bool hier_rs =
+        EnvBool(HVD_TPU_HIERARCHICAL_REDUCESCATTER, false, &fixed);
+    state.parameter_manager.SetHierarchicalReduceScatter(hier_rs, fixed);
   } else {
     // Flat topology: pin the knobs off and fixed so the autotuner doesn't
     // waste its categorical budget scoring identical configurations.
     state.parameter_manager.SetHierarchicalAllreduce(false, true);
     state.parameter_manager.SetHierarchicalAllgather(false, true);
+    state.parameter_manager.SetHierarchicalReduceScatter(false, true);
   }
+  // Pipelined ring segment size: env pins it (0 = unsliced); unset
+  // leaves the knob to the autotuner, starting at 1 MiB.
+  int64_t pipeline_chunk =
+      EnvInt64(HVD_TPU_PIPELINE_CHUNK_BYTES, 1 << 20, &fixed);
+  state.parameter_manager.SetPipelineChunkBytes(pipeline_chunk, fixed);
 
   state.controller->stall_inspector().SetStallWarningTimeSeconds(
       static_cast<int>(EnvInt64(HVD_TPU_STALL_CHECK_TIME, 60)));
@@ -376,7 +371,18 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
   const char* autotune_log = std::getenv(HVD_TPU_AUTOTUNE_LOG);
   state.parameter_manager.Initialize(state.controller->rank(),
                                      autotune_log ? autotune_log : "");
-  if (EnvBool(HVD_TPU_AUTOTUNE, false)) {
+  // Search-space profile seed, identical on every rank (both values come
+  // from job-wide env): the coordinator's live observation of negotiated
+  // responses refines it later (controller.cc) and re-arms on change.
+  state.parameter_manager.ObserveWorkload(
+      ParseCompressionMode(std::getenv(HVD_TPU_COMPRESSION_ENV)) !=
+          CompressionMode::NONE,
+      EnvBool(HVD_TPU_SHARDED_UPDATE_ENV, false));
+  // Always-on closed loop (docs/AUTOTUNE.md): tuning defaults ON and
+  // re-arms on every generation (this code path runs per elastic
+  // re-init) plus on observed workload shifts. HVD_TPU_AUTOTUNE=0 — or
+  // single-rank jobs, where every knob scores identically — opts out.
+  if (EnvBool(HVD_TPU_AUTOTUNE, state.controller->size() > 1)) {
     state.parameter_manager.SetAutoTuning(true);
   }
 
@@ -394,6 +400,8 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
   std::vector<std::shared_ptr<BroadcastOp>> broadcast_ops = {
       std::make_shared<CpuBroadcast>(state.tcp_context, &state)};
   std::vector<std::shared_ptr<ReduceScatterOp>> reducescatter_ops = {
+      std::make_shared<CpuHierarchicalReduceScatter>(state.tcp_context,
+                                                     &state),
       std::make_shared<CpuRingReduceScatter>(state.tcp_context, &state)};
   state.op_manager = std::make_unique<OperationManager>(
       std::move(allreduce_ops), std::move(allgather_ops),
@@ -722,6 +730,16 @@ void horovod_tpu_bo_best(void* bo, double* out2, double* best_y) {
 }
 void horovod_tpu_bo_destroy(void* bo) {
   delete static_cast<BayesianOptimizer*>(bo);
+}
+
+// Live closed-loop tuner state (docs/AUTOTUNE.md) as JSON — knobs,
+// fixed flags, workload profile, re-arm counters, convergence baseline.
+// Callable from any thread at any time (the manager is mutex-guarded);
+// thread_local storage so concurrent scrapers never share a buffer.
+const char* horovod_tpu_autotune_json() {
+  static thread_local std::string out;
+  out = g_state.parameter_manager.Json();
+  return out.c_str();
 }
 
 // Autotune introspection (tests + diagnostics): current synchronized

@@ -95,6 +95,17 @@ bool TcpContext::Initialize() {
   // the spec from frame 0 of the new generation.
   GlobalFaultInjector().Configure(std::getenv("HVD_TPU_FAULT_SPEC"), rank_);
 
+  // Emulated data-ring bandwidth (docs/AUTOTUNE.md "Bench"): pace ring
+  // TX to N MB/s so single-host runs reproduce a real inter-host link's
+  // serialization delay. 0/unset = full loopback speed.
+  {
+    double mbps = 0.0;
+    const char* v = std::getenv("HVD_TPU_RING_BANDWIDTH_MBPS");
+    if (v != nullptr) mbps = std::atof(v);
+    ring_tx_bytes_per_us_ = mbps > 0.0 ? mbps : 0.0;  // 1 MB/s == 1 B/us
+    ring_tx_ready_us_ = 0.0;
+  }
+
   my_ctrl_opseq_ = 0;
   ctrl_opseq_.assign(static_cast<std::size_t>(size_ > 0 ? size_ : 1), 0);
 
@@ -1041,31 +1052,74 @@ bool TcpContext::RingExchangeOn(Ring ring, const void* send_buf,
   const char* sp = static_cast<const char*>(send_buf);
   char* rp = static_cast<char*>(recv_buf);
   std::size_t sent = 0, received = 0;
+  // Emulated-link TX pacing: when the token bucket is empty the send
+  // side simply withholds POLLOUT until its ready time (receives keep
+  // draining), then accounts the bytes it wrote. Quantized writes keep
+  // the pacing granular so a receiver sees a stream, not a burst.
+  const double rate = ring_tx_bytes_per_us_;
+  auto now_us = [] {
+    return std::chrono::duration<double, std::micro>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  };
   while (sent < send_len || received < recv_len) {
     struct pollfd pfds[2];
     int n = 0;
     int send_idx = -1, recv_idx = -1;
+    int timeout_ms = ControlPollMs();
+    bool throttle_wait = false;
     if (sent < send_len) {
-      pfds[n] = {next->fd(), POLLOUT, 0};
-      send_idx = n++;
+      double wait_us =
+          rate > 0.0 ? ring_tx_ready_us_ - now_us() : 0.0;
+      if (wait_us > 0.0) {
+        // Bucket empty: wake when it refills (or when bytes arrive).
+        // poll(2) only has millisecond granularity; sub-ms refills use
+        // a precise sleep below instead of a padded poll timeout —
+        // padding compounds across pipeline segments.
+        int wait_ms = static_cast<int>(wait_us / 1000.0);
+        if (wait_ms < 1) wait_ms = 1;
+        if (wait_ms < timeout_ms) timeout_ms = wait_ms;
+        throttle_wait = true;
+      } else {
+        pfds[n] = {next->fd(), POLLOUT, 0};
+        send_idx = n++;
+      }
+      if (throttle_wait && received >= recv_len) {
+        // Only the throttled send remains: precise sleep, then retry.
+        std::this_thread::sleep_for(
+            std::chrono::duration<double, std::micro>(wait_us));
+        continue;
+      }
     }
     if (received < recv_len) {
       pfds[n] = {prev->fd(), POLLIN, 0};
       recv_idx = n++;
     }
-    if (::poll(pfds, n, ControlPollMs()) <= 0) {
+    if (n == 0) {
+      continue;  // unreachable; defensive
+    }
+    int rv = ::poll(pfds, n, timeout_ms);
+    if (rv < 0 || (rv == 0 && !throttle_wait)) {
       LOG(ERROR) << "ring exchange poll timeout/error";
       SetLastError(chan, NetError::TIMEOUT);
       return false;
     }
     if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR))) {
-      ssize_t w = ::send(next->fd(), sp + sent, send_len - sent,
+      std::size_t quantum = send_len - sent;
+      if (rate > 0.0 && quantum > 262144) quantum = 262144;
+      ssize_t w = ::send(next->fd(), sp + sent, quantum,
                          MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
         SetLastError(chan, NetError::CLOSED);
         return false;
       }
-      if (w > 0) sent += static_cast<std::size_t>(w);
+      if (w > 0) {
+        sent += static_cast<std::size_t>(w);
+        if (rate > 0.0) {
+          double now = now_us();
+          ring_tx_ready_us_ = std::max(ring_tx_ready_us_, now) + w / rate;
+        }
+      }
     }
     if (recv_idx >= 0 && (pfds[recv_idx].revents & (POLLIN | POLLERR))) {
       ssize_t r = ::recv(prev->fd(), rp + received, recv_len - received,

@@ -151,6 +151,16 @@ class TcpContext {
 
   void SetLastError(Channel chan, NetError err);
 
+  // --- emulated data-ring bandwidth (HVD_TPU_RING_BANDWIDTH_MBPS) ---
+  // A TX token bucket paces ring-exchange sends to the configured rate
+  // so a laptop/CI host can reproduce the wait states of a real
+  // inter-host link (capacity planning + the pipelined-ring bench,
+  // docs/AUTOTUNE.md). 0 = off. Only the send side is paced, and only
+  // by withholding POLLOUT — receives keep draining, so the emulation
+  // never deadlocks the duplex pump. Background thread only.
+  double ring_tx_bytes_per_us_ = 0.0;
+  double ring_tx_ready_us_ = 0.0;
+
   int rank_ = 0;
   int size_ = 1;
   int local_rank_ = 0;

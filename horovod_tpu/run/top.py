@@ -54,6 +54,19 @@ def _int_field(field):
     return fmt
 
 
+def _tun_state(cur, prev, dt, ctx):
+    """Closed-loop autotune posture (docs/AUTOTUNE.md): 'tun' while the
+    tuner is actively sampling, 'cvg' once converged, suffixed with the
+    re-arm count when it has re-armed (e.g. 'tun/2' = third tuning pass
+    live). '-' when the worker's summary predates the autotune fields
+    (mixed-version elastic job)."""
+    if "autotune_active" not in cur:
+        return "-"
+    state = "tun" if int(cur.get("autotune_active", 0)) else "cvg"
+    rearms = int(cur.get("autotune_rearms_total", 0))
+    return "%s/%d" % (state, rearms) if rearms else state
+
+
 def _cmp_ratio(cur, prev, dt, ctx):
     """Live wire-compression factor (docs/COMPRESSION.md): f32 bytes
     into the codec / bytes put on the wire. '-' when the worker
@@ -107,6 +120,9 @@ _COLUMNS = [
     # this worker executed (0 = replicated mode; '-' = the worker
     # predates the field).
     ("shd", 6, _int_field("reduce_scatter_total")),
+    # Closed-loop autotune posture: tun(actively sampling) / cvg
+    # (converged), '/N' = re-armed N times (docs/AUTOTUNE.md).
+    ("tun", 6, _tun_state),
     ("lag_s", 9, lambda cur, prev, dt, ctx: "%.2f" % ctx["lag_total"]),
 ]
 

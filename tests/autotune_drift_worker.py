@@ -1,0 +1,93 @@
+"""Workload-drift re-arm worker: train until the tuner converges on a
+small-tensor workload, then SHIFT the workload (8x payload) and keep
+training — the converged tuner's drift watch must notice the per-cycle
+bytes distribution moving past HVD_TPU_AUTOTUNE_DRIFT and re-arm,
+bootstrapping every rank back into tuning through the ResponseList wire.
+
+Rank 0 decides each phase transition and broadcasts the verdict so all
+ranks change workload (and exit) at the same collective count."""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+    small = [np.full(4096, float(i % 3), np.float32) for i in range(4)]
+    big = [np.full(32768, float(i % 3), np.float32) for i in range(8)]
+
+    def step(grads, tag, i):
+        hs = [hvd.allreduce_async(g, "drift.%s.%d" % (tag, j))
+              for j, g in enumerate(grads)]
+        for h in hs:
+            hvd.synchronize(h)
+
+    # Phase 1: converge on the small workload.
+    deadline = time.time() + 240
+    steps = 0
+    while True:
+        step(small, "s", steps)
+        steps += 1
+        verdict = 1.0
+        if r == 0:
+            if not hvd.autotune()["active"]:
+                verdict = 0.0
+            elif time.time() > deadline:
+                verdict = -1.0
+        verdict = float(hvd.broadcast(np.array([verdict]), 0,
+                                      "drift.p1.%d" % steps)[0])
+        if verdict == 0.0:
+            break
+        if verdict < 0.0:
+            print("DRIFT_TIMEOUT phase1 after %d steps" % steps, flush=True)
+            return 1
+    pre = hvd.autotune()
+    print("DRIFT_CONVERGED %s" % json.dumps(
+        {"steps": steps, "epoch": pre["rearm_epoch"],
+         "rearms": pre["rearms_total"]}), flush=True)
+
+    # Settle: the FIRST post-convergence window only CAPTURES the drift
+    # baseline under the adopted knobs (parameter_manager.cc) — keep the
+    # small workload flowing long enough for that window to fill, so the
+    # shift below lands in a window that is actually CHECKED.
+    window = int(os.environ.get("HVD_TPU_AUTOTUNE_DRIFT_WINDOW", "40"))
+    for i in range(3 * window):
+        step(small, "settle", i)
+
+    # Phase 2: shift the workload; the drift watch must re-arm.
+    steps2 = 0
+    while True:
+        step(big, "b", steps2)
+        steps2 += 1
+        verdict = 1.0
+        if r == 0:
+            at = hvd.autotune()
+            if at["rearms_total"] > pre["rearms_total"]:
+                verdict = 0.0
+            elif time.time() > deadline:
+                verdict = -1.0
+        verdict = float(hvd.broadcast(np.array([verdict]), 0,
+                                      "drift.p2.%d" % steps2)[0])
+        if verdict == 0.0:
+            break
+        if verdict < 0.0:
+            print("DRIFT_TIMEOUT phase2 after %d steps" % steps2, flush=True)
+            return 1
+    post = hvd.autotune()
+    print("DRIFT_REARMED %s" % json.dumps(
+        {"steps": steps2, "epoch": post["rearm_epoch"],
+         "rearms": post["rearms_total"], "active": post["active"],
+         "reason": post["last_rearm_reason"]}), flush=True)
+    print("rank %d drift done" % r, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

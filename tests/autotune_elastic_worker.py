@@ -1,0 +1,72 @@
+"""Autotune + elastic e2e worker: the closed-loop tuner must converge in
+generation 0, RE-ARM when the membership shrinks (worker 1 self-kills),
+converge again under the new world size, and survive the regrow — with
+the re-tuned knob values broadcast to every rank.
+
+Run under the elastic launcher (`-np 3 --min-np 1`) with fast sampling
+env (HVD_TPU_AUTOTUNE_CYCLES_PER_SAMPLE etc) so each generation's tuning
+pass completes in a handful of steps. Each step prints one `TUNE` line
+carrying this rank's synchronized tuner view plus the step wall time;
+the test asserts convergence/re-arm/param-change/throughput-recovery
+from rank 0's stream.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+TOTAL_STEPS = int(os.environ.get("AT_ELASTIC_TOTAL_STEPS", "60"))
+CRASH_STEP = int(os.environ.get("AT_ELASTIC_CRASH_STEP", "30"))
+COMMIT_EVERY = 5
+WID = os.environ.get("HVD_TPU_WORKER_ID", "?")
+
+K = 8          # gradients per step
+ELEMS = 16384  # 64 KB each
+
+
+@elastic.run
+def train(state):
+    grads = [np.full(ELEMS, float(i % 5), np.float32) for i in range(K)]
+    while state.step < TOTAL_STEPS:
+        gen = int(os.environ.get("HVD_TPU_GENERATION", "0") or 0)
+        t0 = time.perf_counter()
+        hs = [hvd.allreduce_async(g, "at.g%02d" % i)
+              for i, g in enumerate(grads)]
+        for h in hs:
+            hvd.synchronize(h)
+        dt = time.perf_counter() - t0
+        state.step += 1
+        at = hvd.autotune()
+        print("TUNE worker %s gen %d step %d size %d active %d epoch %d "
+              "rearms %d fusion %.6f cycle %.6f chunk %.3f ms %.3f"
+              % (WID, gen, state.step, hvd.size(), int(at["active"]),
+                 at["rearm_epoch"], at["rearms_total"],
+                 at["params"]["fusion_mb"], at["params"]["cycle_time_ms"],
+                 at["params"]["pipeline_chunk_kb"], dt * 1e3), flush=True)
+        if WID == "1" and gen == 0 and state.step == CRASH_STEP:
+            print("worker 1 crashing now", flush=True)
+            os._exit(23)
+        if state.step % COMMIT_EVERY == 0:
+            state.commit()
+    return state.step
+
+
+def main():
+    state = elastic.ElasticState(w=np.zeros(4, np.float64), step=0)
+    done = train(state)
+    if done is None:
+        print("worker %s superseded (job already complete)" % WID,
+              flush=True)
+        return 0
+    print("worker %s tune train done step %d" % (WID, state.step),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

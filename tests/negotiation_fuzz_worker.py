@@ -34,6 +34,12 @@ def main():
                 snap = hvd.metrics()
                 assert "counters" in snap
                 hvd.job_metrics()
+                # Autotune state API under concurrent knob mutation: the
+                # sanitizer autotune variant (native/Makefile) runs the
+                # tuner with per-cycle sampling, so this read races a
+                # live ReadyTune unless the manager's mutex discipline
+                # holds.
+                assert "params" in hvd.autotune()
         scraper = threading.Thread(target=scrape_loop, daemon=True)
         scraper.start()
 

@@ -1,0 +1,88 @@
+"""Pipelined-ring parity worker: runs allreduce / reduce-scatter /
+allgather over payloads whose final pipeline segment is UNEVEN, under
+every wire-compression mode, and prints a CRC digest of every result.
+
+The test launches this twice — once with HVD_TPU_PIPELINE_CHUNK_BYTES=0
+(unsliced hops) and once with a small chunk (many segments per hop) —
+and asserts the digests match bitwise: slicing a hop into
+double-buffered segments must be a pure transport optimization. int8
+segments align to the quantization block (native SegmentElems), so even
+the lossy codec's values are bitwise-stable across slicings.
+
+Ops run strictly one-at-a-time (enqueue -> synchronize) so tensor fusion
+cannot group them differently between the two runs — a fused buffer has
+different ring partition boundaries, which legitimately changes f32
+summation order.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import ops
+
+
+# Element counts chosen so chunks are uneven across ranks AND the final
+# pipeline segment is partial: primes, a sub-block tail, sub-segment
+# payloads, and a multi-segment payload.
+SIZES = [1, 255, 785, 3 * 256 + 17, 99991, (1 << 18) + 3]
+MODES = ["none", "bf16", "int8"]
+
+
+def fill(size, rank, mode):
+    if mode == "int8":
+        # Constant fills quantize exactly (scale = c/127, q = 127), so
+        # the cross-run digest ALSO equals the exact expected sum.
+        return np.full(size, float(rank + 1), np.float32)
+    i = np.arange(size, dtype=np.float32)
+    # Small integers: exact in f32 and in bf16 rounding (< 256).
+    return np.asarray((i % 13) + rank + 1, np.float32)
+
+
+def crc(arr):
+    return hvd.get_basics().crc32c(np.ascontiguousarray(arr).tobytes())
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    digests = {}
+    for mode in MODES:
+        for size in SIZES:
+            name = "parity.%s.%d" % (mode, size)
+            x = fill(size, r, mode)
+            out = ops.allreduce(x, name + ".ar", compression=mode)
+            # Exact even under the lossy codecs: int8 constant fills
+            # quantize exactly, bf16 small integers round-trip exactly.
+            expected = sum(fill(size, rr, mode) for rr in range(n))
+            assert np.array_equal(out, expected), (mode, size)
+            digests[name + ".ar"] = crc(out)
+
+            shard = ops.reduce_scatter(x, name + ".rs", compression=mode)
+            counts, offsets = ops.shard_partition(size, n)
+            want = expected[offsets[r]:offsets[r] + counts[r]]
+            assert np.array_equal(shard, want), (mode, size)
+            digests[name + ".rs"] = crc(shard)
+
+        # Allgather rides the uncompressed block circulation; cover it
+        # once per mode loop for the digest set anyway.
+        g = ops.allgather(fill(1024 + r, r, "none"), "parity.ag.%s" % mode)
+        digests["parity.ag.%s" % mode] = crc(g)
+
+    print("PARITY_DIGESTS %s" % json.dumps(digests, sort_keys=True),
+          flush=True)
+    snap = hvd.metrics()
+    print("PARITY_METRICS %s" % json.dumps({
+        "pipeline_segments_total":
+            snap["counters"]["pipeline_segments_total"],
+        "reduce_scatter_total":
+            snap["counters"]["reduce_scatter_total"],
+    }), flush=True)
+    print("rank %d parity done" % r, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

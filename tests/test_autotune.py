@@ -15,6 +15,19 @@ from horovod_tpu.common import get_basics
 
 FUSION_LO, FUSION_HI = 0.0, 64.0
 CYCLE_LO, CYCLE_HI = 1.0, 100.0
+# Pipelined-ring chunk bounds of the UNCOMPRESSED profile — the e2e's
+# workload (parameter_manager.cc; compressed jobs search the tighter
+# [16, 1024] instead).
+CHUNK_LO_KB, CHUNK_HI_KB = 64.0, 4096.0
+
+# Fast-convergence env for the closed-loop e2es: 2 cycles per sample,
+# 6 samples, 1 warmup — the tuner converges in ~14 work cycles.
+FAST_TUNE_ENV = {
+    "HVD_TPU_AUTOTUNE": "1",
+    "HVD_TPU_AUTOTUNE_CYCLES_PER_SAMPLE": "2",
+    "HVD_TPU_AUTOTUNE_MAX_SAMPLES": "6",
+    "HVD_TPU_AUTOTUNE_WARMUP": "1",
+}
 
 
 def _bo(lo0, hi0, lo1, hi1, seed):
@@ -94,8 +107,8 @@ def test_autotune_e2e(run_launcher, tmp_path):
     and every sampled/final knob must lie inside the search bounds."""
     log = tmp_path / "autotune.csv"
     proc = run_launcher(2, "autotune_worker.py",
-                        extra_env={"HVD_TPU_AUTOTUNE": "1",
-                                   "HVD_TPU_AUTOTUNE_LOG": str(log)},
+                        extra_env=dict(FAST_TUNE_ENV,
+                                       HVD_TPU_AUTOTUNE_LOG=str(log)),
                         timeout=600)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "MISMATCH" not in proc.stdout, proc.stdout
@@ -108,20 +121,26 @@ def test_autotune_e2e(run_launcher, tmp_path):
         assert FUSION_LO <= p["fusion_mb"] <= FUSION_HI, p
         assert CYCLE_LO <= p["cycle_time_ms"] <= CYCLE_HI, p
 
-    # CSV: header + >= 2 post-warmup samples, all rows in bounds.
+    # CSV: header + >= 2 post-warmup samples, all rows in bounds. Format
+    # (docs/AUTOTUNE.md): the three continuous knobs, the four
+    # categorical knobs, the score, and the row's event
+    # (sample/converged/rearm reason).
     lines = log.read_text().strip().splitlines()
-    assert lines[0].startswith("fusion_mb,cycle_time_ms,cache_enabled"), \
-        lines[0]
+    assert lines[0].startswith(
+        "fusion_mb,cycle_time_ms,pipeline_chunk_kb,cache_enabled"), lines[0]
     rows = [line.split(",") for line in lines[1:]]
     assert len(rows) >= 2, lines
+    assert any(row[8] == "converged" for row in rows), lines
     for row in rows:
-        assert len(row) == 6, row
-        fusion, cycle = float(row[0]), float(row[1])
+        assert len(row) == 9, row
+        fusion, cycle, chunk = float(row[0]), float(row[1]), float(row[2])
         assert FUSION_LO <= fusion <= FUSION_HI, row
         assert CYCLE_LO <= cycle <= CYCLE_HI, row
-        assert row[2] in ("0", "1") and row[3] in ("0", "1") \
-            and row[4] in ("0", "1"), row
-        assert np.isfinite(float(row[5])), row
+        assert CHUNK_LO_KB <= chunk <= CHUNK_HI_KB, row
+        for cat in row[3:7]:
+            assert cat in ("0", "1"), row
+        assert np.isfinite(float(row[7])), row
+        assert row[8], row
 
 
 @pytest.mark.e2e
@@ -151,3 +170,138 @@ def test_autotune_ab_worker_symmetric_exit(run_launcher):
         result.stdout[marker + len("AB_RESULT "):])[0]
     assert res["tune_steps"] == 25, res
     assert res["steps_per_s"] > 0, res
+
+
+@pytest.mark.e2e
+def test_autotune_drift_rearm(run_launcher):
+    """Closed loop (docs/AUTOTUNE.md): after convergence on a small
+    workload, an 8x payload shift must trip the drift watch — the tuner
+    re-arms (rearms_total bumps, a new epoch rides the ResponseList
+    bootstrap) on EVERY rank, with rank 0 naming workload-shift as the
+    reason."""
+    result = run_launcher(
+        2, "autotune_drift_worker.py",
+        extra_env=dict(FAST_TUNE_ENV,
+                       HVD_TPU_AUTOTUNE_DRIFT_WINDOW="8",
+                       HVD_TPU_AUTOTUNE_DRIFT="2.0"),
+        timeout=600)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "DRIFT_TIMEOUT" not in result.stdout, result.stdout
+    rearmed = [json.loads(m) for m in
+               re.findall(r"DRIFT_REARMED (\{.*?\})", result.stdout)]
+    assert len(rearmed) == 2, result.stdout  # both ranks re-entered tuning
+    assert all(r["rearms"] >= 1 for r in rearmed), rearmed
+    assert all(r["epoch"] >= 1 for r in rearmed), rearmed
+    assert any(r["reason"] == "workload-shift" for r in rearmed), rearmed
+
+
+@pytest.mark.e2e
+def test_autotune_rearm_across_elastic_resize():
+    """Acceptance e2e: the tuner converges in generation 0, RE-ARMS when
+    worker 1 dies (shrink 3->2), converges again under the new world
+    size with different knobs, survives the regrow to 3, and step time
+    recovers to the converged-regime envelope instead of sticking at
+    sampling-transient pacing."""
+    import statistics
+    import subprocess
+    import sys
+    import time as _time
+
+    from tests.conftest import clean_worker_env
+
+    env = clean_worker_env(dict(
+        FAST_TUNE_ENV,
+        HVD_TPU_ELASTIC_COOLDOWN="2",
+        HVD_TPU_ELASTIC_DISCOVERY_INTERVAL="0.3",
+        HVD_TPU_START_TIMEOUT="30",
+    ))
+    result = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run.run", "-np", "3",
+         "--min-np", "1", "--",
+         sys.executable, os.path.join(os.path.dirname(__file__),
+                                      "autotune_elastic_worker.py")],
+        env=env, timeout=420, capture_output=True, text=True)
+    out = result.stdout
+    assert result.returncode == 0, (out, result.stderr)
+    assert "worker 1 crashing now" in out
+
+    line = re.compile(
+        r"TUNE worker (\S+) gen (\d+) step (\d+) size (\d+) active (\d) "
+        r"epoch (\d+) rearms (\d+) fusion ([0-9.]+) cycle ([0-9.]+) "
+        r"chunk ([0-9.]+) ms ([0-9.]+)")
+    rows = [dict(worker=m[0], gen=int(m[1]), step=int(m[2]),
+                 size=int(m[3]), active=int(m[4]), epoch=int(m[5]),
+                 rearms=int(m[6]), fusion=float(m[7]), cycle=float(m[8]),
+                 chunk=float(m[9]), ms=float(m[10]))
+            for m in line.findall(out)]
+    gen0 = [r for r in rows if r["gen"] == 0]
+    shrunk = [r for r in rows if r["gen"] >= 1 and r["size"] == 2]
+    assert gen0 and shrunk, out
+
+    # Generation 0 converged before the crash...
+    gen0_converged = [r for r in gen0 if r["active"] == 0]
+    assert gen0_converged, "tuner never converged in gen 0:\n" + out
+    # ...and the resize RE-ARMED it: the shrunk generation starts with
+    # the tuner actively sampling again.
+    assert any(r["active"] == 1 for r in shrunk), \
+        "tuner did not re-arm after the shrink:\n" + out
+    # Post-resize the tuner converges AGAIN (the shrunk generation may
+    # regrow before its pass finishes — the regrown generation re-arms
+    # once more and finishes there) on knobs that differ from the
+    # pre-shrink ones: each pass explores generation-salted sample
+    # points, so an identical point would mean the re-tune never ran.
+    shrunk_converged = [r for r in rows
+                        if r["gen"] >= 1 and r["active"] == 0]
+    assert shrunk_converged, "tuner never re-converged post-resize:\n" + out
+    pre, post = gen0_converged[-1], shrunk_converged[-1]
+    assert (abs(pre["fusion"] - post["fusion"]) > 1e-9 or
+            abs(pre["cycle"] - post["cycle"]) > 1e-9 or
+            abs(pre["chunk"] - post["chunk"]) > 1e-9), (pre, post)
+
+    # The job regrew to 3 and finished on every worker.
+    assert any(r["size"] == 3 and r["gen"] >= 1 for r in rows), out
+    assert len(re.findall(r"tune train done", out)) == 3, out
+
+    # Throughput recovers: converged step time after the resize stays in
+    # the same envelope as generation 0's converged regime (generous 4x
+    # bound — the point is it does NOT stick at sampling-transient
+    # pacing, e.g. a 100ms-cycle probe).
+    pre_ms = statistics.median(r["ms"] for r in gen0_converged[-5:])
+    post_ms = statistics.median(r["ms"] for r in shrunk_converged[-5:])
+    assert post_ms <= 4 * pre_ms + 50, (pre_ms, post_ms)
+
+
+# --- hvd-top `tun` column tolerance -----------------------------------------
+
+
+def _job(per_rank):
+    return {"size": len(per_rank), "generation": 1,
+            "per_rank": per_rank,
+            "age_seconds": {r: 0.0 for r in per_rank},
+            "rank_lag_seconds": [0.0] * len(per_rank)}
+
+
+def test_hvd_top_tun_column_and_mixed_version_tolerance():
+    """The `tun` column renders tuning posture + re-arm count, and a
+    mixed-version job (rank 1's summary predates the autotune fields)
+    shows '-' in the same column span without shifting anything."""
+    from horovod_tpu.run import top
+
+    new_worker = {"cycles_total": 100.0, "cycle_seconds_sum": 1.0,
+                  "cache_hit_total": 5, "cache_miss_total": 5,
+                  "autotune_active": 1.0, "autotune_rearms_total": 2.0}
+    old_worker = {"cycles_total": 90.0, "cycle_seconds_sum": 1.0,
+                  "cache_hit_total": 5, "cache_miss_total": 5}
+    frame = top.render(_job({"0": new_worker, "1": old_worker}), None, 0.0,
+                       "test:0")
+    lines = frame.splitlines()
+    rows = [ln for ln in lines if ln.strip().startswith(("0", "1"))]
+    assert len(rows) == 2, frame
+    header = next(ln for ln in lines if " tun" in ln)
+    tun_col = header.index(" tun")
+    assert "tun/2" in rows[0], frame
+    assert rows[1][tun_col:tun_col + 5].strip() == "-", frame
+    assert all(len(r) == len(rows[0]) for r in rows), frame
+    # Converged posture with no re-arms renders plain 'cvg'.
+    cvg = dict(new_worker, autotune_active=0.0, autotune_rearms_total=0.0)
+    assert "cvg" in top.render(_job({"0": cvg}), None, 0.0, "t"), "cvg"
