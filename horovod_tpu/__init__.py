@@ -26,6 +26,13 @@ from .common import (  # noqa: F401
     shard_partition,
     synchronize,
 )
+from .groups import (  # noqa: F401
+    WORLD,
+    ProcessGroup,
+    group_rank,
+    group_size,
+    new_group,
+)
 
 __version__ = "0.4.0"
 
@@ -132,7 +139,13 @@ def _maybe_rendezvous():
                                             generation=generation))
 
 
-def init(ranks=None):
+# 2-D mesh state (docs/GROUPS.md): set by init(model_parallel=k) — this
+# rank's (batch, model) groups plus the mesh shape. Re-formed on every
+# (re-)init: the native group table clears per generation.
+_mesh = None
+
+
+def init(ranks=None, model_parallel=None):
     """Initializes the core runtime (rendezvous + background thread).
 
     Args:
@@ -141,10 +154,20 @@ def init(ranks=None):
         ``horovod/common/basics.py:29-60``). Processes whose world rank is
         not listed initialize as independent size-1 communicators and sit
         out the subset's collectives.
+      model_parallel: optional model-parallel width k (docs/GROUPS.md).
+        The N ranks form a (N/k, k) (batch, model) mesh: rank r sits at
+        batch row r//k and model column r%k; ``batch_group()`` is the
+        rank's model-COLUMN (gradient reduction runs over it — N/k
+        members) and ``model_group()`` its contiguous k-rank model row
+        (tensor-parallel collectives ride it). Persists through elastic
+        re-inits via ``HVD_TPU_MODEL_PARALLEL`` (the env form sets it
+        job-wide without a code change).
 
     Reference analogue: ``hvd.init()`` -> ``horovod/common/basics.py:29-60``.
     """
-    global _initialized_here, _world_env
+    import os as _os
+
+    global _initialized_here, _world_env, _mesh
     if not is_initialized():
         _maybe_rendezvous()
     if ranks is not None and len(ranks) > 0:
@@ -166,6 +189,20 @@ def init(ranks=None):
     release_held_ports()
     for cb in _init_callbacks:
         cb()
+    # Mesh formation AFTER the callbacks (groups are per-generation; the
+    # native table was cleared by the (re-)init). The env is only
+    # persisted AFTER validation against the live world size, so an
+    # invalid model_parallel= raises without poisoning later init()
+    # retries.
+    _mesh = None
+    mp = int(model_parallel) if model_parallel is not None else \
+        int(_os.environ.get("HVD_TPU_MODEL_PARALLEL", "1") or "1")
+    if mp > 1:
+        _mesh = _form_mesh(mp, explicit=model_parallel is not None)
+    if model_parallel is not None:
+        # Persist so elastic re-inits (plain init() calls) re-form the
+        # mesh for the new membership.
+        _os.environ["HVD_TPU_MODEL_PARALLEL"] = str(mp)
     # Metrics endpoint (docs/METRICS.md): serve Prometheus at
     # HVD_TPU_METRICS_PORT + rank. After the callbacks (rank may have
     # changed across an elastic re-init; the server follows its slot).
@@ -174,6 +211,70 @@ def init(ranks=None):
     if not _initialized_here:
         _atexit.register(shutdown)
         _initialized_here = True
+
+
+def _form_mesh(k, explicit=True):
+    """Registers the (batch, model) mesh groups on THIS rank (every rank
+    runs the identical sequence, so ids agree; docs/GROUPS.md).
+
+    Megatron-style layout: model groups are k CONSECUTIVE ranks (the
+    fastest-moving axis — on a TPU slice, launcher-ordered neighbors
+    share ICI links), batch groups are the strided columns {j, j+k, ...}.
+    Registration order: all k batch groups (column 0..k-1), then all N/k
+    model groups (row 0..N/k-1).
+    """
+    n = size()
+    if n % k != 0:
+        if explicit:
+            raise ValueError(
+                "model_parallel=%d does not divide world size %d"
+                % (k, n))
+        # Env-driven re-form (an elastic re-init): the model is SHARDED
+        # k ways, so a membership whose size k does not divide cannot
+        # host it — name the resume constraint instead of a bare
+        # divisibility error mid-recovery.
+        raise RuntimeError(
+            "elastic membership of size %d cannot resume the "
+            "model_parallel=%d mesh (size must be a multiple of k — "
+            "the model is sharded k ways); resize to a multiple of %d, "
+            "or unset HVD_TPU_MODEL_PARALLEL for a fresh pure-DP job "
+            "(docs/GROUPS.md)" % (n, k, k))
+    batch_groups = [new_group(range(j, n, k)) for j in range(k)]
+    model_groups = [new_group(range(i * k, (i + 1) * k))
+                    for i in range(n // k)]
+    r = rank()
+    return {
+        "k": k,
+        "batch": batch_groups[r % k],
+        "model": model_groups[r // k],
+        "batch_groups": batch_groups,
+        "model_groups": model_groups,
+    }
+
+
+def model_parallel_size():
+    """The mesh's model-parallel width k (1 = pure data-parallel)."""
+    return _mesh["k"] if _mesh is not None else 1
+
+
+def batch_group():
+    """This rank's batch-axis (data-parallel) group: the N/k ranks
+    holding the same model shard. Gradient allreduces run over it —
+    ``DistributedOptimizer`` defaults to it when the mesh is active.
+    None without ``init(model_parallel=k)``."""
+    return _mesh["batch"] if _mesh is not None else None
+
+
+def model_group():
+    """This rank's model-axis (tensor-parallel) group: the k ranks
+    forming one model replica. ``parallel.tensor_parallel``'s host-plane
+    f/g collectives ride it. None without ``init(model_parallel=k)``."""
+    return _mesh["model"] if _mesh is not None else None
+
+
+def mesh_groups():
+    """(batch_group, model_group) for this rank, or (None, None)."""
+    return (batch_group(), model_group())
 
 
 def shutdown():

@@ -15,14 +15,17 @@ import horovod_tpu as hvd
 _distributed_class_cache = {}
 
 
-def distributed_optimizer_class(base, compression=None, average=True):
+def distributed_optimizer_class(base, compression=None, average=True,
+                                group=None):
     """The dynamic `Distributed<Base>` optimizer CLASS — split from
     instance creation so load_model can hand these to keras
     deserialization as custom_objects (reference:
     _keras/__init__.py:107-123 load_model's custom-object wrapping).
-    Cached per (base, compression, average) so repeated load_model
-    calls reuse identical classes."""
-    key = (base, compression, average)
+    Cached per (base, compression, average, group) so repeated
+    load_model calls reuse identical classes. `group` scopes the
+    gradient averaging to a process group (docs/GROUPS.md); it defaults
+    to this rank's batch group under hvd.init(model_parallel=k)."""
+    key = (base, compression, average, group)
     cached = _distributed_class_cache.get(key)
     if cached is not None:
         return cached
@@ -33,14 +36,31 @@ def distributed_optimizer_class(base, compression=None, average=True):
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             import tensorflow as tf
             from horovod_tpu import tensorflow as hvd_tf
+            grp = group if group is not None else hvd.batch_group()
             grads_and_vars = list(grads_and_vars)
             reduced = []
             for i, (g, v) in enumerate(grads_and_vars):
                 if g is not None:
                     comp = compression or hvd_tf.Compression.none
+                    # Group-scoped allreduce needs dense tensors; the
+                    # Keras surface has no sparse_as_dense knob, so
+                    # densify sparse grads under a group — LOUDLY: a
+                    # big embedding's IndexedSlices becomes a
+                    # full-table dense allreduce per step.
+                    sparse_dense = grp is not None
+                    if sparse_dense and isinstance(g, tf.IndexedSlices):
+                        import warnings
+                        warnings.warn(
+                            "group-scoped Keras optimizer densifies "
+                            "IndexedSlices gradient %d (full-table "
+                            "allreduce per step — docs/GROUPS.md); "
+                            "consider a dense embedding or the jax "
+                            "binding's sparse plane" % i,
+                            stacklevel=2)
                     g = hvd_tf.allreduce(
                         g, average=average, name="keras_grad.%d" % i,
-                        compression=comp)
+                        compression=comp,
+                        sparse_as_dense=sparse_dense, group=grp)
                     g = tf.convert_to_tensor(g) if isinstance(
                         g, tf.IndexedSlices) else g
                 reduced.append((g, v))
@@ -53,12 +73,13 @@ def distributed_optimizer_class(base, compression=None, average=True):
 
 
 def create_distributed_optimizer(keras, optimizer, name=None,
-                                 compression=None, average=True):
+                                 compression=None, average=True,
+                                 group=None):
     """Dynamically subclasses `optimizer` so apply_gradients first
     allreduces gradients (reference: _keras/__init__.py:20-80)."""
     cls = distributed_optimizer_class(optimizer.__class__,
                                       compression=compression,
-                                      average=average)
+                                      average=average, group=group)
     return cls.from_config(optimizer.get_config())
 
 

@@ -105,6 +105,18 @@ def render_prometheus(snapshot, labels=None):
         sample(name + "_bucket", cumulative, 'le="+Inf"')
         sample(name + "_sum", h["sum"])
         sample(name + "_count", h["count"])
+    # Coordinator-side group-labeled negotiation counters
+    # (docs/GROUPS.md): one series per process group id.
+    per_group = snapshot.get("per_group") or {}
+    if per_group:
+        lines.append("# TYPE %sgroup_negotiated_total counter" % _PREFIX)
+        for gid in sorted(per_group, key=int):
+            inner = 'group="%s"' % gid
+            if label_str:
+                inner = label_str + "," + inner
+            lines.append("%sgroup_negotiated_total{%s} %s" % (
+                _PREFIX, inner,
+                _fmt(per_group[gid].get("negotiated_total", 0))))
     # Coordinator-only per-rank announce lag (straggler table). The rank
     # label here names the ATTRIBUTED rank, not the serving worker, so
     # the base labels are deliberately not applied.

@@ -113,6 +113,41 @@ class HorovodBasics:
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_double,
             ctypes.c_double, ctypes.c_int,
         ]
+        # Process groups (docs/GROUPS.md): registry + group-scoped
+        # enqueue variants (the plain entry points stay group-0 so older
+        # bindings keep their signatures).
+        lib.horovod_tpu_new_group.restype = ctypes.c_int
+        lib.horovod_tpu_new_group.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+        lib.horovod_tpu_group_size.restype = ctypes.c_int
+        lib.horovod_tpu_group_size.argtypes = [ctypes.c_int]
+        lib.horovod_tpu_group_rank.restype = ctypes.c_int
+        lib.horovod_tpu_group_rank.argtypes = [ctypes.c_int]
+        lib.horovod_tpu_group_count.restype = ctypes.c_int
+        lib.horovod_tpu_group_count.argtypes = []
+        lib.horovod_tpu_enqueue_allreduce_grp.restype = ctypes.c_int
+        lib.horovod_tpu_enqueue_allreduce_grp.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_double,
+            ctypes.c_double, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.horovod_tpu_enqueue_reduce_scatter_grp.restype = ctypes.c_int
+        lib.horovod_tpu_enqueue_reduce_scatter_grp.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_double,
+            ctypes.c_double, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.horovod_tpu_enqueue_allgather_grp.restype = ctypes.c_int
+        lib.horovod_tpu_enqueue_allgather_grp.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+        ]
+        lib.horovod_tpu_enqueue_broadcast_grp.restype = ctypes.c_int
+        lib.horovod_tpu_enqueue_broadcast_grp.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int,
+        ]
         lib.horovod_tpu_sharded_update_default.restype = ctypes.c_int
         lib.horovod_tpu_sharded_update_default.argtypes = []
         lib.horovod_tpu_opt_state_metrics.restype = None

@@ -12,10 +12,13 @@ import os
 import numpy as np
 
 from horovod_tpu import compression as _compression
+from horovod_tpu import groups as _groups
 from .basics import get_basics, numpy_to_hvd_dtype, _DTYPE_TO_NUMPY
 
-# handle -> (input array, output array or None) — keeps buffers alive while
-# the background thread works on them.
+# handle -> (input array, output array or None, participant count) —
+# keeps buffers alive while the background thread works on them; the
+# participant count (group size; world size for group 0) shapes
+# allgather results at synchronize time.
 _handle_map = {}
 
 # Status codes must match native/common.h StatusType.
@@ -32,7 +35,7 @@ def _shape_array(arr):
 
 
 def allreduce_async(tensor, name, prescale_factor=1.0, postscale_factor=1.0,
-                    out=None, compression=None):
+                    out=None, compression=None, group=None):
     """Starts an allreduce (sum) on a numpy array; returns a handle.
 
     `out`, when given, is a C-contiguous same-dtype/size array the core
@@ -45,20 +48,25 @@ def allreduce_async(tensor, name, prescale_factor=1.0, postscale_factor=1.0,
     `horovod_tpu.compression.Compression` mode; None defers to
     HVD_TPU_COMPRESSION). The array stays this dtype end to end — only
     ring-hop payloads are encoded — and the mode rides the negotiation,
-    so every rank must pass the same value (docs/COMPRESSION.md)."""
+    so every rank must pass the same value (docs/COMPRESSION.md).
+
+    `group` scopes the collective to a `horovod_tpu.ProcessGroup`
+    (docs/GROUPS.md): the sum spans only the group's members and rides
+    the group's ring; only members may call it."""
     basics = get_basics()
     mode = _compression.resolve(compression)
+    gid = _groups.resolve_group(group)
     arr = np.ascontiguousarray(tensor)
     # ascontiguousarray promotes 0-d to (1,); the result must round-trip
     # the caller's shape (a reshape view shares the output buffer).
     if out is None:
         out = np.empty_like(arr).reshape(np.shape(tensor))
-    handle = basics.lib.horovod_tpu_enqueue_allreduce(
+    handle = basics.lib.horovod_tpu_enqueue_allreduce_grp(
         name.encode("utf-8"), arr.ctypes.data_as(ctypes.c_void_p),
         out.ctypes.data_as(ctypes.c_void_p), arr.ndim, _shape_array(arr),
         numpy_to_hvd_dtype(arr.dtype), float(prescale_factor),
-        float(postscale_factor), int(mode.mode))
-    _handle_map[handle] = (arr, out)
+        float(postscale_factor), int(mode.mode), gid)
+    _handle_map[handle] = (arr, out, None)
     return handle
 
 
@@ -84,7 +92,8 @@ def sharded_update_default():
 
 
 def reduce_scatter_async(tensor, name, prescale_factor=1.0,
-                         postscale_factor=1.0, compression=None, out=None):
+                         postscale_factor=1.0, compression=None, out=None,
+                         group=None):
     """Starts a reduce-scatter (sum) on a numpy array; returns a handle.
 
     The tensor is treated as FLAT: its elements are partitioned into
@@ -93,12 +102,16 @@ def reduce_scatter_async(tensor, name, prescale_factor=1.0,
     array of ``counts[rank]`` elements (the sharded-update gradient leg,
     docs/ZERO.md). `out`, when given, must be a C-contiguous same-dtype
     array of exactly that many elements. `compression` rides the
-    negotiation per hop exactly as in :func:`allreduce_async`."""
+    negotiation per hop exactly as in :func:`allreduce_async`.
+
+    With `group=` the partition spans the GROUP: chunk i goes to the
+    group's i-th member and the sum covers members only."""
     basics = get_basics()
     mode = _compression.resolve(compression)
+    gid = _groups.resolve_group(group)
     arr = np.ascontiguousarray(tensor)
-    counts, _ = shard_partition(arr.size, basics.size())
-    my_count = counts[basics.rank()]
+    counts, _ = shard_partition(arr.size, _groups.group_size(group))
+    my_count = counts[_groups.group_rank(group)]
     if out is None:
         out = np.empty(my_count, dtype=arr.dtype)
     elif out.size != my_count:
@@ -113,51 +126,55 @@ def reduce_scatter_async(tensor, name, prescale_factor=1.0,
                          % (arr.dtype, out.dtype,
                             "" if out.flags["C_CONTIGUOUS"]
                             else ", non-contiguous"))
-    handle = basics.lib.horovod_tpu_enqueue_reduce_scatter(
+    handle = basics.lib.horovod_tpu_enqueue_reduce_scatter_grp(
         name.encode("utf-8"), arr.ctypes.data_as(ctypes.c_void_p),
         out.ctypes.data_as(ctypes.c_void_p), arr.ndim, _shape_array(arr),
         numpy_to_hvd_dtype(arr.dtype), float(prescale_factor),
-        float(postscale_factor), int(mode.mode))
-    _handle_map[handle] = (arr, out)
+        float(postscale_factor), int(mode.mode), gid)
+    _handle_map[handle] = (arr, out, None)
     return handle
 
 
 def reduce_scatter(tensor, name, average=False, prescale_factor=1.0,
-                   postscale_factor=1.0, compression=None):
+                   postscale_factor=1.0, compression=None, group=None):
     """Synchronous reduce-scatter; returns this rank's 1-D shard of the
     sum (or the average with ``average=True``)."""
     if average:
-        postscale_factor = postscale_factor / get_basics().size()
+        postscale_factor = postscale_factor / _groups.group_size(group)
     return synchronize(reduce_scatter_async(
         tensor, name, prescale_factor, postscale_factor,
-        compression=compression))
+        compression=compression, group=group))
 
 
-def allgather_async(tensor, name):
-    """Starts an allgather along dim 0; returns a handle."""
+def allgather_async(tensor, name, group=None):
+    """Starts an allgather along dim 0; returns a handle. With `group=`
+    the concatenation spans the group's members in group order."""
     basics = get_basics()
+    gid = _groups.resolve_group(group)
     arr = np.ascontiguousarray(tensor)
     if arr.ndim == 0:
         arr = arr.reshape(1)
-    handle = basics.lib.horovod_tpu_enqueue_allgather(
+    handle = basics.lib.horovod_tpu_enqueue_allgather_grp(
         name.encode("utf-8"), arr.ctypes.data_as(ctypes.c_void_p), arr.ndim,
-        _shape_array(arr), numpy_to_hvd_dtype(arr.dtype))
-    _handle_map[handle] = (arr, None)
+        _shape_array(arr), numpy_to_hvd_dtype(arr.dtype), gid)
+    _handle_map[handle] = (arr, None, _groups.group_size(group))
     return handle
 
 
-def broadcast_async(tensor, root_rank, name, out=None):
+def broadcast_async(tensor, root_rank, name, out=None, group=None):
     """Starts a broadcast from root_rank; returns a handle. `out` as in
-    :func:`allreduce_async` (may alias the input)."""
+    :func:`allreduce_async` (may alias the input). `root_rank` is the
+    WORLD rank and must be a member of `group` when one is given."""
     basics = get_basics()
+    gid = _groups.resolve_group(group)
     arr = np.ascontiguousarray(tensor)
     if out is None:
         out = np.empty_like(arr).reshape(np.shape(tensor))
-    handle = basics.lib.horovod_tpu_enqueue_broadcast(
+    handle = basics.lib.horovod_tpu_enqueue_broadcast_grp(
         name.encode("utf-8"), arr.ctypes.data_as(ctypes.c_void_p),
         out.ctypes.data_as(ctypes.c_void_p), arr.ndim, _shape_array(arr),
-        numpy_to_hvd_dtype(arr.dtype), int(root_rank))
-    _handle_map[handle] = (arr, out)
+        numpy_to_hvd_dtype(arr.dtype), int(root_rank), gid)
+    _handle_map[handle] = (arr, out, None)
     return handle
 
 
@@ -186,14 +203,15 @@ def synchronize(handle):
             msg = basics.lib.horovod_tpu_error_string(handle)
             raise HorovodInternalError(
                 msg.decode("utf-8") if msg else "collective failed")
-        arr, out = _handle_map[handle]
+        arr, out, gsize = _handle_map[handle]
         if out is not None:
             return out
-        # Allgather: view the core-owned result in place.
+        # Allgather: view the core-owned result in place. The first-dim
+        # table spans the PARTICIPANTS (group members, or the world).
         nbytes = basics.lib.horovod_tpu_allgather_bytes(handle)
         if nbytes < 0:
             raise HorovodInternalError("allgather produced no result")
-        size = get_basics().size()
+        size = gsize if gsize is not None else get_basics().size()
         first_dim = 0
         for r in range(size):
             d = basics.lib.horovod_tpu_allgather_rank_dim(handle, r)
@@ -237,18 +255,21 @@ def _view_core_buffer(basics, handle, ptr, nbytes, dtype, shape):
 
 
 def allreduce(tensor, name, average=False, prescale_factor=1.0,
-              postscale_factor=1.0, compression=None):
-    """Synchronous allreduce; returns the reduced array."""
+              postscale_factor=1.0, compression=None, group=None):
+    """Synchronous allreduce; returns the reduced array. ``average``
+    divides by the participant count (the group's size under
+    ``group=``)."""
     if average:
-        postscale_factor = postscale_factor / get_basics().size()
+        postscale_factor = postscale_factor / _groups.group_size(group)
     return synchronize(allreduce_async(tensor, name, prescale_factor,
                                        postscale_factor,
-                                       compression=compression))
+                                       compression=compression,
+                                       group=group))
 
 
-def allgather(tensor, name):
-    return synchronize(allgather_async(tensor, name))
+def allgather(tensor, name, group=None):
+    return synchronize(allgather_async(tensor, name, group=group))
 
 
-def broadcast(tensor, root_rank, name):
-    return synchronize(broadcast_async(tensor, root_rank, name))
+def broadcast(tensor, root_rank, name, group=None):
+    return synchronize(broadcast_async(tensor, root_rank, name, group=group))

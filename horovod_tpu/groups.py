@@ -1,0 +1,155 @@
+"""Process groups (docs/GROUPS.md): subgroup communicators in the
+negotiation core.
+
+``new_group(ranks)`` registers a group in the native :class:`GroupTable`
+and returns a :class:`ProcessGroup` handle that every collective (and
+``DistributedOptimizer``) accepts as ``group=``. A group collective
+negotiates against the GROUP's member set (readiness bitmaps sized to
+the group), caches per group (cache key includes the group id), and
+executes over a dedicated ring connecting only the members — ring hops
+drop from world-1 to group-1 and disjoint groups' rings run
+concurrently.
+
+Discipline (identical to torch.distributed's): EVERY rank — members and
+non-members alike — must call ``new_group`` with the identical rank
+list in the identical order. Ids come from a per-process counter, so
+the same call sequence yields the same ids everywhere; non-members need
+the registration too (the response-cache bit protocol treats "not my
+group" as vacuously ready, which requires knowing the membership).
+Mismatched membership is rejected at negotiation naming the rank.
+
+Groups are per-generation: an elastic re-init clears the native table,
+and ``hvd.init(model_parallel=k)`` re-forms the mesh groups after every
+(re-)init.
+"""
+
+
+class ProcessGroup:
+    """Handle to a registered process group.
+
+    ``id`` is the native group id (0 = the implicit world group);
+    ``ranks`` the ascending member world ranks (None for world).
+    """
+
+    def __init__(self, group_id, ranks=None):
+        self.id = int(group_id)
+        self.ranks = tuple(ranks) if ranks is not None else None
+
+    def size(self):
+        """Member count (world size for the world group)."""
+        from .common.basics import get_basics
+        if self.id == 0:
+            return get_basics().size()
+        if self.ranks is not None:
+            return len(self.ranks)
+        return int(get_basics().lib.horovod_tpu_group_size(self.id))
+
+    def rank(self):
+        """This process's position in the group's ring order, or -1 when
+        it is not a member (non-members sit the group's collectives
+        out)."""
+        from .common.basics import get_basics
+        return int(get_basics().lib.horovod_tpu_group_rank(self.id))
+
+    def __contains__(self, world_rank):
+        if self.id == 0:
+            return True
+        return self.ranks is not None and int(world_rank) in self.ranks
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessGroup) and other.id == self.id
+
+    def __hash__(self):
+        return hash(("ProcessGroup", self.id))
+
+    def __repr__(self):
+        if self.id == 0:
+            return "ProcessGroup(WORLD)"
+        return "ProcessGroup(id=%d, ranks=%r)" % (self.id, list(self.ranks))
+
+
+#: The implicit world group — ``group=WORLD`` (or ``group=None``) is the
+#: pre-groups behavior everywhere.
+WORLD = ProcessGroup(0)
+
+
+def new_group(ranks):
+    """Creates a process group over ``ranks`` (world ranks, ascending).
+
+    COLLECTIVE BY CONVENTION: call it on EVERY rank with the identical
+    list, in the identical order relative to other ``new_group`` calls.
+    Returns a :class:`ProcessGroup`; non-member ranks receive the same
+    handle (with ``.rank() == -1``) and must simply not submit the
+    group's collectives.
+    """
+    import ctypes
+
+    from .common.basics import get_basics
+
+    members = sorted(int(r) for r in ranks)
+    if len(set(members)) != len(members):
+        raise ValueError("duplicate ranks in %r" % (ranks,))
+    basics = get_basics()
+    if not basics.initialized():
+        raise RuntimeError("hvd.init() must run before new_group()")
+    arr = (ctypes.c_int32 * len(members))(*members)
+    gid = int(basics.lib.horovod_tpu_new_group(arr, len(members)))
+    if gid <= 0:
+        world = basics.size()
+        raise ValueError(
+            "invalid process group %r (native error %d): ranks must be "
+            "unique world ranks in [0, %d)" % (ranks, gid, world))
+    return ProcessGroup(gid, members)
+
+
+def resolve_group(group):
+    """The native group id for a ``group=`` argument: None/WORLD -> 0, a
+    ProcessGroup -> its id, a plain int passes through."""
+    if group is None:
+        return 0
+    if isinstance(group, ProcessGroup):
+        return group.id
+    return int(group)
+
+
+def group_size(group):
+    """Member count behind a ``group=`` argument (world size for None)."""
+    from .common.basics import get_basics
+    gid = resolve_group(group)
+    if gid == 0:
+        return get_basics().size()
+    if isinstance(group, ProcessGroup) and group.ranks is not None:
+        return len(group.ranks)
+    n = int(get_basics().lib.horovod_tpu_group_size(gid))
+    if n <= 0:
+        raise ValueError("unknown process group %d" % gid)
+    return n
+
+
+def assert_sharded_update_world_scope(group=None):
+    """Shared guard for every sharded_update wrapper (docs/ZERO.md +
+    docs/GROUPS.md): the ZeRO-style sharded weight update shards state
+    over the WORLD, so it cannot compose with a group-scoped gradient
+    reduction — an explicit non-world ``group=`` or an ACTIVE mesh
+    (``hvd.init(model_parallel=k)``) is rejected. Called at wrapper
+    construction AND per update: a mesh formed after the optimizer was
+    built must fail the next step, not silently reduce-scatter across
+    model shards. One definition so the four wrappers can't skew."""
+    import horovod_tpu as hvd
+
+    if (group is not None and resolve_group(group) != 0) or \
+            (group is None and hvd.batch_group() is not None):
+        raise ValueError(
+            "sharded_update composes with the world group only; a "
+            "group-scoped (mesh) job must use the replicated update "
+            "per batch group (docs/GROUPS.md)")
+
+
+def group_rank(group):
+    """This process's group position behind a ``group=`` argument (its
+    world rank for None); -1 when not a member."""
+    from .common.basics import get_basics
+    gid = resolve_group(group)
+    if gid == 0:
+        return get_basics().rank()
+    return int(get_basics().lib.horovod_tpu_group_rank(gid))

@@ -162,8 +162,14 @@ class Compression:
 
 def allreduce(tensor, average=True, name=None, axis_name=AXIS_NAME,
               compression=None, prescale_factor=1.0,
-              postscale_factor=1.0):
+              postscale_factor=1.0, group=None):
     """Allreduce across ranks (and, in-jit, across the mapped axis).
+
+    ``group``: a ``hvd.ProcessGroup`` scoping the HOST-plane collective
+    to a subgroup (docs/GROUPS.md) — e.g. ``hvd.batch_group()`` under
+    ``init(model_parallel=k)``. The in-jit mapped-axis plane expresses
+    subgroups through MESH AXES instead (psum over the batch or model
+    axis of a 2-D mesh); ``group`` is ignored there.
 
     ``compression``: a wire mode ('none'/'bf16'/'int8', a
     ``horovod_tpu.compression`` mode, or None = HVD_TPU_COMPRESSION) —
@@ -215,7 +221,7 @@ def allreduce(tensor, average=True, name=None, axis_name=AXIS_NAME,
                     np.asarray(arr), op_name, average=average,
                     prescale_factor=prescale_factor,
                     postscale_factor=postscale_factor,
-                    compression=mode)).astype(arr.dtype)
+                    compression=mode, group=group)).astype(arr.dtype)
 
             compressed, ctx = (compression.compress(tensor) if legacy
                                else (tensor, None))
@@ -232,14 +238,14 @@ def allreduce(tensor, average=True, name=None, axis_name=AXIS_NAME,
     out = _ops.allreduce(arr, name or _auto_name("allreduce"),
                          average=average, prescale_factor=prescale_factor,
                          postscale_factor=postscale_factor,
-                         compression=mode)
+                         compression=mode, group=group)
     result = jnp.asarray(out)
     return compression.decompress(result, ctx) if legacy else result
 
 
 def reduce_scatter(tensor, average=True, name=None, axis_name=AXIS_NAME,
                    compression=None, prescale_factor=1.0,
-                   postscale_factor=1.0):
+                   postscale_factor=1.0, group=None):
     """Reduce-scatter across ranks (docs/ZERO.md): the tensor is
     flattened, summed (or averaged) across ranks, and this rank keeps
     only its 1/N shard of the result — the gradient leg of the sharded
@@ -266,17 +272,20 @@ def reduce_scatter(tensor, average=True, name=None, axis_name=AXIS_NAME,
             return shard.astype(tensor.dtype)
         if _multi_process():
             from jax.experimental import io_callback
+
+            from horovod_tpu import groups as _grp
             op_name = name or _auto_name("reduce_scatter")
             counts, _ = _ops.shard_partition(
-                int(np.prod(tensor.shape, dtype=np.int64)), _hvd.size())
-            my_count = counts[_hvd.rank()]
+                int(np.prod(tensor.shape, dtype=np.int64)),
+                _grp.group_size(group))
+            my_count = counts[_grp.group_rank(group)]
 
             def _cb(arr):
                 return np.asarray(_ops.reduce_scatter(
                     np.asarray(arr), op_name, average=average,
                     prescale_factor=prescale_factor,
                     postscale_factor=postscale_factor,
-                    compression=mode)).astype(arr.dtype)
+                    compression=mode, group=group)).astype(arr.dtype)
 
             out_shape = jax.ShapeDtypeStruct((my_count,), tensor.dtype)
             return io_callback(_cb, out_shape, tensor, ordered=True)
@@ -289,11 +298,11 @@ def reduce_scatter(tensor, average=True, name=None, axis_name=AXIS_NAME,
                               average=average,
                               prescale_factor=prescale_factor,
                               postscale_factor=postscale_factor,
-                              compression=mode)
+                              compression=mode, group=group)
     return jnp.asarray(out)
 
 
-def allgather(tensor, name=None, axis_name=AXIS_NAME):
+def allgather(tensor, name=None, axis_name=AXIS_NAME, group=None):
     """Concatenates tensors from all ranks along dim 0.
 
     In plain jit without a mapped axis, all ranks must pass equal shapes
@@ -304,38 +313,43 @@ def allgather(tensor, name=None, axis_name=AXIS_NAME):
             return jax.lax.all_gather(tensor, axis_name, tiled=True)
         if _multi_process():
             from jax.experimental import io_callback
+
+            from horovod_tpu import groups as _grp
             op_name = name or _auto_name("allgather")
             if tensor.ndim == 0:  # match the host path's 0-d -> (1,)
                 tensor = tensor.reshape(1)
 
             def _cb(arr):
                 return np.asarray(
-                    _ops.allgather(np.asarray(arr), op_name))
+                    _ops.allgather(np.asarray(arr), op_name, group=group))
 
-            shape = (tensor.shape[0] * _hvd.size(),) + tuple(tensor.shape[1:])
+            shape = (tensor.shape[0] * _grp.group_size(group),) + \
+                tuple(tensor.shape[1:])
             out_shape = jax.ShapeDtypeStruct(shape, tensor.dtype)
             return io_callback(_cb, out_shape, tensor, ordered=True)
         _require_init_traced()
         return tensor
     arr = np.asarray(tensor)
-    out = _ops.allgather(arr, name or _auto_name("allgather"))
+    out = _ops.allgather(arr, name or _auto_name("allgather"), group=group)
     return jnp.asarray(out)
 
 
-def broadcast(tensor, root_rank=0, name=None, axis_name=AXIS_NAME):
+def broadcast(tensor, root_rank=0, name=None, axis_name=AXIS_NAME,
+              group=None):
     """Broadcasts the root rank's tensor — or pytree of tensors,
-    leaf-wise with order-stable names — to every rank."""
+    leaf-wise with order-stable names — to every rank (the group's
+    members under ``group=``; ``root_rank`` stays a WORLD rank)."""
     leaves, treedef = jax.tree_util.tree_flatten(tensor)
     if len(leaves) != 1 or leaves[0] is not tensor:
         base = name or _auto_name("broadcast")
         out = [_broadcast_one(leaf, root_rank, "%s.%d" % (base, i),
-                              axis_name)
+                              axis_name, group)
                for i, leaf in enumerate(leaves)]
         return jax.tree_util.tree_unflatten(treedef, out)
-    return _broadcast_one(tensor, root_rank, name, axis_name)
+    return _broadcast_one(tensor, root_rank, name, axis_name, group)
 
 
-def _broadcast_one(tensor, root_rank, name, axis_name):
+def _broadcast_one(tensor, root_rank, name, axis_name, group=None):
     if _is_traced(tensor):
         if _axis_in_scope(axis_name):
             # In-jit: mask every rank but the root to zero and psum — XLA
@@ -351,39 +365,45 @@ def _broadcast_one(tensor, root_rank, name, axis_name):
 
             def _cb(arr):
                 return np.asarray(_ops.broadcast(
-                    np.asarray(arr), root_rank, op_name)).astype(arr.dtype)
+                    np.asarray(arr), root_rank, op_name,
+                    group=group)).astype(arr.dtype)
 
             return _host_callback(_cb, tensor)
         _require_init_traced()
         return tensor
     arr = np.asarray(tensor)
-    out = _ops.broadcast(arr, root_rank, name or _auto_name("broadcast"))
+    out = _ops.broadcast(arr, root_rank, name or _auto_name("broadcast"),
+                         group=group)
     return jnp.asarray(out)
 
 
 def allreduce_gradients(grads, average=True, name_prefix="grad",
-                        compression=None, axis_name=AXIS_NAME):
+                        compression=None, axis_name=AXIS_NAME, group=None):
     """Allreduces a pytree of gradients (order-stable naming so all ranks
     negotiate the same tensors). ``compression`` as in :func:`allreduce`
     (wire modes negotiate per leaf; the core fuses same-mode leaves into
-    one ring pass)."""
+    one ring pass). ``group`` scopes the reduction — under a 2-D mesh
+    this is the BATCH group: gradients average over the ranks sharing
+    this model shard only (docs/GROUPS.md)."""
     legacy = compression is not None and hasattr(compression, "compress")
     mode = _wire.Compression.none if legacy else _wire.resolve(compression)
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if leaves and _is_traced(leaves[0]):
         reduced = [allreduce(g, average=average, axis_name=axis_name,
-                             compression=compression) for g in leaves]
+                             compression=compression, group=group)
+                   for g in leaves]
         return jax.tree_util.tree_unflatten(treedef, reduced)
     # Host path: enqueue everything first so the core can fuse within a
     # cycle, then synchronize in order.
+    from horovod_tpu import groups as _grp
     handles = []
     for i, g in enumerate(leaves):
         comp, ctx = compression.compress(g) if legacy else (g, None)
         arr = np.asarray(comp)
-        postscale = 1.0 / _hvd.size() if average else 1.0
+        postscale = 1.0 / _grp.group_size(group) if average else 1.0
         handles.append((_ops.allreduce_async(arr, "%s.%d" % (name_prefix, i),
                                              postscale_factor=postscale,
-                                             compression=mode),
+                                             compression=mode, group=group),
                         ctx))
     reduced = []
     for h, ctx in handles:
@@ -417,7 +437,8 @@ def broadcast_optimizer_state(opt_state, root_rank=0,
 
 def DistributedOptimizer(optimizer, compression=None,
                          average=True, name_prefix="grad",
-                         axis_name=AXIS_NAME, sharded_update=None):
+                         axis_name=AXIS_NAME, sharded_update=None,
+                         group=None):
     """Wraps an optax GradientTransformation so every update first averages
     gradients across ranks (reference: _DistributedOptimizer,
     tensorflow/__init__.py:231-258).
@@ -442,12 +463,19 @@ def DistributedOptimizer(optimizer, compression=None,
     instead. The optimizer state it returns is RANK-LOCAL — read it
     through :func:`sharded_state_full` (hvd-lint rule
     ``sharded-update-rank-local-param-read`` flags direct reads).
+
+    ``group`` scopes the gradient reduction to a process group; under
+    ``hvd.init(model_parallel=k)`` it DEFAULTS to this rank's batch
+    group, so a mesh job's gradients average over the ranks sharing its
+    model shard without any call-site change (docs/GROUPS.md).
     """
     import optax
 
     if sharded_update is None:
         sharded_update = _ops.sharded_update_default()
     if sharded_update:
+        from horovod_tpu.groups import assert_sharded_update_world_scope
+        assert_sharded_update_world_scope(group)
         return _sharded_distributed_optimizer(optimizer, compression,
                                               average, name_prefix)
 
@@ -455,10 +483,14 @@ def DistributedOptimizer(optimizer, compression=None,
         return optimizer.init(params)
 
     def update_fn(updates, state, params=None):
+        # group=None resolves to the CURRENT batch group per update:
+        # construction-time capture would go stale across elastic
+        # re-inits (the mesh re-forms with fresh ids).
+        grp = group if group is not None else _hvd.batch_group()
         updates = allreduce_gradients(updates, average=average,
                                       name_prefix=name_prefix,
                                       compression=compression,
-                                      axis_name=axis_name)
+                                      axis_name=axis_name, group=grp)
         return optimizer.update(updates, state, params)
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -513,6 +545,11 @@ def _sharded_distributed_optimizer(optimizer, compression, average,
                 "world": _hvd.size(), "rank": _hvd.rank()}
 
     def update_fn(updates, state, params=None):
+        # Re-checked per update: a mesh formed AFTER the optimizer was
+        # built must fail here, not silently reduce-scatter the fused
+        # buffer across model shards.
+        from horovod_tpu.groups import assert_sharded_update_world_scope
+        assert_sharded_update_world_scope()
         if params is None:
             raise ValueError(
                 "sharded_update needs params: call update(grads, state, "

@@ -21,11 +21,13 @@ from . import callbacks  # noqa: F401
 
 
 def DistributedOptimizer(optimizer, name=None,
-                         compression=None, average=True):
+                         compression=None, average=True, group=None):
     """Wraps a Keras optimizer for synchronous data-parallel training
-    (reference: keras/__init__.py:34)."""
+    (reference: keras/__init__.py:34). ``group`` scopes the gradient
+    averaging to a process group (docs/GROUPS.md)."""
     return _impl.create_distributed_optimizer(keras, optimizer, name,
-                                              compression, average)
+                                              compression, average,
+                                              group=group)
 
 
 def broadcast_model_weights(model, root_rank=0):

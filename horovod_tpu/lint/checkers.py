@@ -17,11 +17,25 @@ from .walker import (COLLECTIVES, INITIAL_BROADCASTS, PREFIX_NAMED,
 @register("rank-conditional-collective", ERROR,
           "collective reachable only under rank-dependent control flow")
 def check_rank_conditional(model):
+    import ast as _ast
     for site in model.call_sites:
         if site.func in TRAIN_MARKERS and site.func != "allreduce_gradients":
             continue  # wrapping an optimizer is not itself a collective
         if site.func.startswith("checkpoint."):
             continue  # owned by checkpoint-in-rank-guard below
+        # Group-scoped calls (docs/GROUPS.md): a collective passed
+        # `group=` is SUPPOSED to run on a rank subset — "only members
+        # call it" is the contract, and membership guards are
+        # rank-dependent by nature (`if g.rank() >= 0:`). Whether the
+        # guard matches the membership is undecidable statically; the
+        # runtime's group-scoped divergence detection names the group
+        # and both call sites when it does not, so the lexical rule
+        # stands down instead of flagging every legitimate mesh program.
+        group_arg = site.kwargs.get("group")
+        if group_arg is not None and not (
+                isinstance(group_arg, _ast.Constant) and
+                group_arg.value is None):
+            continue
         for cond in site.conditions:
             if cond.rank_dependent:
                 kind = "elastic commit point" if site.is_commit \

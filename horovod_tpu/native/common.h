@@ -177,6 +177,10 @@ struct TensorTableEntry {
   // Effective wire-compression mode (compression.h CompressionMode as
   // u8; already dtype-filtered at enqueue).
   uint8_t compression = 0;
+  // Process group the collective is scoped to (group_table.h; 0 =
+  // world). Responses only claim entries of their own group, so the
+  // same tensor name active in two groups at once never cross-executes.
+  uint32_t group_id = 0;
   // Allgather result storage (core-owned) — set after execution.
   std::shared_ptr<std::vector<char>> gathered;
   std::shared_ptr<std::vector<int64_t>> gathered_sizes;

@@ -48,13 +48,16 @@ void Controller::SynchronizeParameters() {
 }
 
 bool Controller::IncrementTensorCount(const Request& msg, int rank) {
-  const std::string& name = msg.tensor_name();
-  auto it = message_table_.find(name);
+  // Pending-table key is group-qualified: the same tensor name active
+  // in two groups at once is two independent negotiations.
+  const std::string key =
+      GroupQualifiedName(msg.group_id(), msg.tensor_name());
+  auto it = message_table_.find(key);
   auto now = std::chrono::steady_clock::now();
   if (it == message_table_.end()) {
-    timeline_.NegotiateStart(name, msg.request_type());
-    it = message_table_.emplace(name, std::vector<Request>()).first;
-    negotiate_started_[name] = now;
+    timeline_.NegotiateStart(key, msg.request_type());
+    it = message_table_.emplace(key, std::vector<Request>()).first;
+    negotiate_started_[key] = now;
     if (metrics_plane_enabled_) GlobalMetrics().AddRankLag(rank, 0.0);
   } else if (metrics_plane_enabled_) {
     // Announce lag: how long this rank kept the tensor waiting after its
@@ -62,26 +65,56 @@ bool Controller::IncrementTensorCount(const Request& msg, int rank) {
     // the job view surfaces (the slow rank's total dominates). Gated on
     // the plane: AddRankLag takes the registry's rank mutex (shared with
     // snapshot builds), which metrics-off jobs must never touch.
-    auto started = negotiate_started_.find(name);
+    auto started = negotiate_started_.find(key);
     if (started != negotiate_started_.end()) {
       GlobalMetrics().AddRankLag(
           rank, std::chrono::duration<double>(now - started->second).count());
     }
   }
-  timeline_.NegotiateRankReady(name, rank);
-  stall_inspector_.RecordUncachedTensorStart(name, rank, size_);
+  timeline_.NegotiateRankReady(key, rank);
+  // Readiness threshold: ALL ranks for the world group, the MEMBER set
+  // for a process group (the bitmap sized to the group). Provably-bad
+  // group reports (unknown id / non-member announcer / membership-digest
+  // mismatch) go ready IMMEDIATELY so ConstructResponse rejects them by
+  // name instead of leaving the count stuck below threshold forever.
+  int expected = size_;
+  std::vector<int> members;
+  bool poisoned = false;
+  if (msg.group_id() != 0) {
+    if (group_table_ == nullptr) {
+      poisoned = true;  // no registry at all: can never resolve
+    } else {
+      members = group_table_->Members(msg.group_id());
+      if (members.empty()) {
+        // Not registered in THIS process yet: new_group is per-process
+        // and unsynchronized, so another rank's announcement can arrive
+        // before the coordinator's own call lands. Leave the tensor
+        // pending — the late-registration sweep in FinishCycle marks it
+        // ready once the id resolves (a genuinely unknown id then ends
+        // in the divergence/stall path, by name).
+        expected = -1;
+      } else {
+        expected = static_cast<int>(members.size());
+        poisoned =
+            !std::binary_search(members.begin(), members.end(), rank) ||
+            msg.group_digest() != group_table_->Digest(msg.group_id());
+      }
+    }
+  }
+  stall_inspector_.RecordUncachedTensorStart(
+      key, rank, size_, members.empty() ? nullptr : &members);
   it->second.push_back(msg);
-  return static_cast<int>(it->second.size()) == size_;
+  return poisoned || static_cast<int>(it->second.size()) == expected;
 }
 
-Response Controller::ConstructResponse(const std::string& name) {
-  auto it = message_table_.find(name);
+Response Controller::ConstructResponse(const std::string& key) {
+  auto it = message_table_.find(key);
   assert(it != message_table_.end());
   std::vector<Request> requests = std::move(it->second);
   message_table_.erase(it);
-  stall_inspector_.RemoveUncachedTensor(name);
-  timeline_.NegotiateEnd(name);
-  auto started = negotiate_started_.find(name);
+  stall_inspector_.RemoveUncachedTensor(key);
+  timeline_.NegotiateEnd(key);
+  auto started = negotiate_started_.find(key);
   if (started != negotiate_started_.end()) {
     GlobalMetrics().negotiation_seconds.Observe(
         std::chrono::duration<double>(std::chrono::steady_clock::now() -
@@ -91,11 +124,53 @@ Response Controller::ConstructResponse(const std::string& name) {
   }
 
   const Request& first = requests[0];
+  const uint32_t gid = first.group_id();
+  std::vector<int> members;
+  if (gid != 0 && group_table_ != nullptr) {
+    members = group_table_->Members(gid);
+  }
   std::ostringstream error;
   bool error_found = false;
 
+  // Group validation first: a membership problem explains every other
+  // mismatch, so it must own the error message.
+  if (gid != 0) {
+    if (members.empty()) {
+      error << "Unknown process group " << gid << " for tensor '"
+            << first.tensor_name()
+            << "'; every rank must create groups with hvd.new_group(...) "
+            << "in the same order before using them.";
+      error_found = true;
+    } else {
+      uint64_t digest = group_table_->Digest(gid);
+      for (const auto& req : requests) {
+        if (req.group_digest() != digest) {
+          error << "Mixed membership for process group " << gid
+                << ": rank " << req.request_rank()
+                << " created it with a different rank list than this "
+                << "coordinator's " << group_table_->DescribeMembers(gid)
+                << "; every rank must pass the identical ranks to "
+                << "hvd.new_group.";
+          error_found = true;
+          break;
+        }
+        if (!std::binary_search(members.begin(), members.end(),
+                                req.request_rank())) {
+          error << "rank " << req.request_rank() << " announced tensor '"
+                << first.tensor_name() << "' in process group " << gid
+                << " whose members are "
+                << group_table_->DescribeMembers(gid)
+                << "; only members may submit group collectives.";
+          error_found = true;
+          break;
+        }
+      }
+    }
+  }
+
   // All ranks must agree on op type, dtype, and scaling.
   for (const auto& req : requests) {
+    if (error_found) break;
     if (req.request_type() != first.request_type()) {
       error << "Mismatched collective operations: rank "
             << first.request_rank() << " did "
@@ -182,11 +257,21 @@ Response Controller::ConstructResponse(const std::string& name) {
         break;
       }
     }
+    if (!error_found && gid != 0 &&
+        !std::binary_search(members.begin(), members.end(),
+                            first.root_rank())) {
+      error << "Broadcast root rank " << first.root_rank()
+            << " is not a member of process group " << gid << " "
+            << group_table_->DescribeMembers(gid) << ".";
+      error_found = true;
+    }
   }
 
   std::vector<int64_t> tensor_sizes;
   if (!error_found && first.request_type() == Request::ALLGATHER) {
-    // All dims but the first must match; gather per-rank first dims.
+    // All dims but the first must match; gather per-rank first dims —
+    // indexed by GROUP position for group collectives (the executing
+    // ring lays blocks out in group order).
     tensor_sizes.resize(requests.size(), 0);
     for (const auto& req : requests) {
       if (req.tensor_shape().size() != first.tensor_shape().size() ||
@@ -203,22 +288,37 @@ Response Controller::ConstructResponse(const std::string& name) {
         }
       }
       if (error_found) break;
-      if (req.request_rank() < 0 ||
-          req.request_rank() >= static_cast<int>(tensor_sizes.size())) {
+      int slot = req.request_rank();
+      if (gid != 0) {
+        slot = group_table_ != nullptr
+                   ? group_table_->IndexOf(gid, req.request_rank())
+                   : -1;
+      }
+      if (slot < 0 || slot >= static_cast<int>(tensor_sizes.size())) {
         error << "Invalid request rank " << req.request_rank() << ".";
         error_found = true;
         break;
       }
-      tensor_sizes[req.request_rank()] = req.tensor_shape()[0];
+      tensor_sizes[slot] = req.tensor_shape()[0];
     }
   }
 
   Response response;
-  response.add_tensor_name(name);
+  response.add_tensor_name(first.tensor_name());
+  response.set_group_id(gid);
   if (error_found) {
     response.set_response_type(Response::ERROR);
+    if (gid != 0 && group_table_ != nullptr) {
+      // Every group-scoped rejection names the group — the fix is
+      // almost always a membership or scoping mistake.
+      error << " [process group " << gid << ", ranks "
+            << group_table_->DescribeMembers(gid) << "]";
+    }
     response.set_error_message(error.str());
     return response;
+  }
+  if (gid != 0) {
+    GlobalMetrics().AddGroupNegotiated(gid, 1);
   }
   response.set_tensor_type(first.tensor_type());
   response.set_devices(first.device());
@@ -274,6 +374,9 @@ void Controller::FuseResponses(std::deque<Response>& responses,
         if (next.response_type() == Response::ALLREDUCE &&
             next.tensor_type() == response.tensor_type() &&
             next.compression() == response.compression() &&
+            // Tensors only fuse WITHIN a group: a fused buffer rides one
+            // ring, and different groups ride different rings.
+            next.group_id() == response.group_id() &&
             next.devices() == response.devices()) {
           int64_t next_bytes = 0;
           for (int64_t n : next.tensor_sizes()) next_bytes += n * dtype_size;
@@ -308,7 +411,8 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
     std::vector<std::string> ready_names;
     for (auto& msg : non_cached_messages) {
       if (IncrementTensorCount(msg, rank_)) {
-        ready_names.push_back(msg.tensor_name());
+        ready_names.push_back(
+            GroupQualifiedName(msg.group_id(), msg.tensor_name()));
       }
     }
     // The coordinator's own call stream enters the detector directly (its
@@ -336,37 +440,64 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
       }
       for (const auto& msg : list.requests()) {
         if (IncrementTensorCount(msg, r)) {
-          ready_names.push_back(msg.tensor_name());
+          ready_names.push_back(
+              GroupQualifiedName(msg.group_id(), msg.tensor_name()));
         }
       }
     }
-    for (const auto& name : ready_names) {
-      responses.push_back(ConstructResponse(name));
+    // Late-registration sweep: group tensors whose id was unknown when
+    // their announcements arrived (see IncrementTensorCount) go ready
+    // as soon as this process's registry resolves the id and every
+    // member has announced. ShouldForceFullCycle keeps full cycles
+    // coming while anything is pending, so the sweep always gets to
+    // run even after the announcements went quiet.
+    for (const auto& kv : message_table_) {
+      const Request& first = kv.second.front();
+      if (first.group_id() == 0 || group_table_ == nullptr) continue;
+      int gsize = group_table_->Size(first.group_id());
+      if (gsize > 0 && static_cast<int>(kv.second.size()) >= gsize) {
+        ready_names.push_back(kv.first);
+      }
+    }
+    for (const auto& key : ready_names) {
+      // A key can go ready twice in one cycle (two provably-bad group
+      // reports poisoning it, or the announcement path plus the sweep);
+      // the first ConstructResponse consumed it.
+      if (message_table_.count(key) == 0) continue;
+      responses.push_back(ConstructResponse(key));
     }
     // Workload profile for the autotuner's search space: did this cycle
-    // negotiate wire compression or a reduce-scatter? A first sighting
-    // after convergence triggers a re-arm (parameter_manager.h).
+    // negotiate wire compression, a reduce-scatter, or a subgroup
+    // collective? A first sighting after convergence triggers a re-arm
+    // (parameter_manager.h) so tuning re-scores under the new regime.
     {
-      bool comp = false, rs = false;
+      bool comp = false, rs = false, grp = false;
       for (const auto& resp : responses) {
         comp = comp || resp.compression() != 0;
         rs = rs || resp.response_type() == Response::REDUCESCATTER;
+        grp = grp || resp.group_id() != 0;
       }
-      if (comp || rs) parameter_manager_.ObserveWorkload(comp, rs);
+      if (comp || rs || grp) {
+        parameter_manager_.ObserveWorkload(comp, rs, grp);
+      }
     }
     // Divergence cross-check: fail provably diverged pending tensors NOW
     // with a named call site, instead of letting them hang to the stall
     // timeout (divergence.h documents the two proof rules).
-    for (const auto& diag : divergence_.Check(message_table_)) {
+    for (const auto& diag : divergence_.Check(message_table_,
+                                              group_table_)) {
       LOG(ERROR) << diag.message;
       GlobalMetrics().divergence_errors_total.fetch_add(
           1, std::memory_order_relaxed);
-      message_table_.erase(diag.tensor_name);
-      stall_inspector_.RemoveUncachedTensor(diag.tensor_name);
-      timeline_.NegotiateEnd(diag.tensor_name);
-      negotiate_started_.erase(diag.tensor_name);
+      message_table_.erase(diag.key);
+      stall_inspector_.RemoveUncachedTensor(diag.key);
+      timeline_.NegotiateEnd(diag.key);
+      negotiate_started_.erase(diag.key);
+      // The ERROR response carries the BARE tensor name plus the group
+      // id — entry lookup on every rank is (name, group)-scoped.
       Response error;
       error.add_tensor_name(diag.tensor_name);
+      error.set_group_id(diag.group_id);
       error.set_response_type(Response::ERROR);
       error.set_error_message(diag.message);
       responses.push_back(std::move(error));
@@ -457,7 +588,8 @@ ResponseList Controller::ComputeResponseList(
         uint32_t bit = response_cache_.peek_cache_bit(message);
         cache_coordinator.record_hit(bit);
         metrics.cache_hit_total.fetch_add(1, std::memory_order_relaxed);
-        stall_inspector_.RecordCachedTensorStart(message.tensor_name());
+        stall_inspector_.RecordCachedTensorStart(GroupQualifiedName(
+            message.group_id(), message.tensor_name()));
         hit_messages.emplace(bit, std::move(message));
         continue;
       }
@@ -471,6 +603,16 @@ ResponseList Controller::ComputeResponseList(
     }
     cache_coordinator.set_uncached_in_queue(true);
     non_cached_messages.push_back(std::move(message));
+  }
+  // Process groups (docs/GROUPS.md): every cached tensor belonging to a
+  // group this rank is NOT a member of is vacuously ready here — record
+  // its bit as a hit so the cross-rank AND reduces to an AND over the
+  // group's actual members. Without this, a group tensor could never
+  // take the fast path (non-members would always zero its bit).
+  if (cache_on) {
+    std::vector<uint32_t> foreign_bits;
+    response_cache_.NonMemberBits(&foreign_bits);
+    for (uint32_t bit : foreign_bits) cache_coordinator.record_hit(bit);
   }
   // Periodic stall inspection — must run every cycle type (stalls surface
   // precisely when no negotiation is happening): warn about tensors waiting
@@ -538,7 +680,8 @@ ResponseList Controller::ComputeResponseList(
     for (auto& kv : hit_messages) {
       if (cache_coordinator.cache_hits().count(kv.first)) continue;
       if (cache_coordinator.invalid_bits().count(kv.first)) {
-        stall_inspector_.RemoveCachedTensor(kv.second.tensor_name());
+        stall_inspector_.RemoveCachedTensor(GroupQualifiedName(
+            kv.second.group_id(), kv.second.tensor_name()));
         non_cached_messages.push_back(std::move(kv.second));
       } else {
         tensor_queue_.PushMessageToQueue(kv.second);
@@ -550,8 +693,9 @@ ResponseList Controller::ComputeResponseList(
     // evictions consistent.
     for (uint32_t bit : cache_coordinator.cache_hits()) {
       cached_responses.push_back(response_cache_.get_response(bit));
-      stall_inspector_.RemoveCachedTensor(
-          cached_responses.back().tensor_names()[0]);
+      stall_inspector_.RemoveCachedTensor(GroupQualifiedName(
+          cached_responses.back().group_id(),
+          cached_responses.back().tensor_names()[0]));
     }
 
     // Drop invalidated entries identically on every rank, then re-pack bits.

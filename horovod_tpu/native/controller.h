@@ -31,6 +31,7 @@
 #include <vector>
 
 #include "divergence.h"
+#include "group_table.h"
 #include "message.h"
 #include "response_cache.h"
 #include "stall_inspector.h"
@@ -90,6 +91,14 @@ class Controller {
   // cross-checks them against the pending table and fails provably
   // diverged tensors with ERROR responses naming the offending call site.
   void SetCallTracker(CallTracker* tracker) { call_tracker_ = tracker; }
+
+  // --- process groups (group_table.h / docs/GROUPS.md) ---
+  // The registry the coordinator validates group requests against:
+  // readiness counts are sized to the GROUP (a tensor is ready when all
+  // MEMBERS announced, regardless of the other ranks), membership
+  // digests are cross-checked, and non-member announcements are
+  // rejected by name.
+  void SetGroupTable(const GroupTable* table) { group_table_ = table; }
   // Call after Initialize() (needs size_). progress_calls==0 and
   // grace_seconds<=0 disable the respective rules.
   void ConfigureDivergence(int64_t progress_calls, double grace_seconds) {
@@ -130,12 +139,18 @@ class Controller {
 
  protected:
   // Coordinator: record that `rank` reported readiness of msg's tensor.
-  // Returns true when all ranks have reported it.
+  // Returns true when all of the tensor's GROUP members have reported it
+  // (all world ranks for group 0) — or immediately when the report is
+  // provably bad (unknown group / non-member / membership-digest
+  // mismatch), so ConstructResponse can reject it by name instead of
+  // letting the count hang forever.
   bool IncrementTensorCount(const Request& msg, int rank);
 
   // Coordinator: build the validated Response for a fully-ready tensor,
-  // checking cross-rank consistency of shape/dtype/op/root rank.
-  Response ConstructResponse(const std::string& name);
+  // checking cross-rank consistency of shape/dtype/op/root rank and
+  // group membership. `key` is the pending-table key
+  // (GroupQualifiedName); the response carries the bare tensor name.
+  Response ConstructResponse(const std::string& key);
 
   // Coordinator: fuse eligible same-type/dtype responses under the threshold.
   void FuseResponses(std::deque<Response>& responses, ResponseList& out);
@@ -155,8 +170,12 @@ class Controller {
   bool is_homogeneous_ = true;
   std::vector<int> local_sizes_;
 
-  // Coordinator-side table: tensor name -> one Request per reported rank.
+  // Coordinator-side table: GroupQualifiedName(group, tensor name) ->
+  // one Request per reported rank. The composite key keeps the same
+  // tensor name active in two groups at once (the 2-D mesh's per-column
+  // gradient reduce) as two independent negotiations.
   std::unordered_map<std::string, std::vector<Request>> message_table_;
+  const GroupTable* group_table_ = nullptr;
 
   ResponseCache& response_cache_;
   TensorQueue& tensor_queue_;

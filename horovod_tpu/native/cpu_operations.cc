@@ -288,9 +288,9 @@ static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
                                   const std::vector<int64_t>& counts,
                                   const std::vector<int64_t>& offsets,
                                   DataType dtype, CompressionMode cmp,
-                                  int64_t pipe_bytes) {
-  int n = ctx.RingSize(ring);
-  int rank = ctx.RingRank(ring);
+                                  int64_t pipe_bytes, uint32_t group = 0) {
+  int n = group ? ctx.GroupSize(group) : ctx.RingSize(ring);
+  int rank = group ? ctx.GroupRank(group) : ctx.RingRank(ring);
   std::size_t elem = DataTypeSize(dtype);
   int64_t seg = SegmentElems(pipe_bytes, elem, cmp);
   int64_t nseg = SegmentCount(counts, seg);
@@ -338,9 +338,9 @@ static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
             encoder.Drain();
           }
           char* rc = recv_c[s & 1].data();
-          if (!ctx.RingExchangeOn(ring, send_c[s & 1].data(),
-                                  CompressedSize(sn, cmp), rc,
-                                  CompressedSize(rn, cmp))) {
+          if (!ctx.ExchangeOn(ring, group, send_c[s & 1].data(),
+                              CompressedSize(sn, cmp), rc,
+                              CompressedSize(rn, cmp))) {
             encoder.Drain();
             reducer.Drain();
             return RingLost(ctx, "ring reduce-scatter exchange failed");
@@ -376,8 +376,8 @@ static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
       std::size_t recv_len = CompressedSize(counts[recv_chunk], cmp);
       CompressBuffer(f + offsets[send_chunk], counts[send_chunk], cmp,
                      send_c.data());
-      if (!ctx.RingExchangeOn(ring, send_c.data(), send_len, recv_c.data(),
-                              recv_len)) {
+      if (!ctx.ExchangeOn(ring, group, send_c.data(), send_len,
+                          recv_c.data(), recv_len)) {
         return RingLost(ctx, "ring reduce-scatter exchange failed");
       }
       DecompressBuffer(recv_c.data(), counts[recv_chunk], cmp, tmp.data());
@@ -400,8 +400,8 @@ static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
         int64_t sn = ClampSeg(counts[send_chunk], soff, seg);
         int64_t rn = ClampSeg(counts[recv_chunk], soff, seg);
         char* rc = tmp[s & 1].data();
-        if (!ctx.RingExchangeOn(
-                ring,
+        if (!ctx.ExchangeOn(
+                ring, group,
                 buf + (offsets[send_chunk] +
                        SegOff(counts[send_chunk], soff)) * elem,
                 sn * elem, rc, rn * elem)) {
@@ -424,9 +424,9 @@ static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
   for (int step = 0; step < n - 1; ++step) {
     int send_chunk = (rank - step + n) % n;
     int recv_chunk = (rank - step - 1 + n) % n;
-    if (!ctx.RingExchangeOn(ring, buf + offsets[send_chunk] * elem,
-                            counts[send_chunk] * elem, tmp.data(),
-                            counts[recv_chunk] * elem)) {
+    if (!ctx.ExchangeOn(ring, group, buf + offsets[send_chunk] * elem,
+                        counts[send_chunk] * elem, tmp.data(),
+                        counts[recv_chunk] * elem)) {
       return RingLost(ctx, "ring reduce-scatter exchange failed");
     }
     ReduceSum(buf + offsets[recv_chunk] * elem, tmp.data(), counts[recv_chunk],
@@ -450,9 +450,9 @@ static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
                                    const std::vector<int64_t>& counts,
                                    const std::vector<int64_t>& offsets,
                                    DataType dtype, CompressionMode cmp,
-                                   int64_t pipe_bytes) {
-  int n = ctx.RingSize(ring);
-  int rank = ctx.RingRank(ring);
+                                   int64_t pipe_bytes, uint32_t group = 0) {
+  int n = group ? ctx.GroupSize(group) : ctx.RingSize(ring);
+  int rank = group ? ctx.GroupRank(group) : ctx.RingRank(ring);
   std::size_t elem = DataTypeSize(dtype);
   if (cmp != CompressionMode::NONE) {
     float* f = reinterpret_cast<float*>(buf);
@@ -485,9 +485,9 @@ static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
           int64_t sn = ClampSeg(counts[send_chunk], soff, seg);
           int64_t rn = ClampSeg(counts[recv_chunk], soff, seg);
           char* rc = nxt.data() + s * slot;
-          if (!ctx.RingExchangeOn(ring, cur.data() + s * slot,
-                                  CompressedSize(sn, cmp), rc,
-                                  CompressedSize(rn, cmp))) {
+          if (!ctx.ExchangeOn(ring, group, cur.data() + s * slot,
+                              CompressedSize(sn, cmp), rc,
+                              CompressedSize(rn, cmp))) {
             worker.Drain();
             return RingLost(ctx, "ring allgather exchange failed");
           }
@@ -518,10 +518,10 @@ static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
     for (int step = 0; step < n - 1; ++step) {
       int send_chunk = (rank + 1 - step + n) % n;
       int recv_chunk = (rank - step + n) % n;
-      if (!ctx.RingExchangeOn(ring, send_c.data(),
-                              CompressedSize(counts[send_chunk], cmp),
-                              recv_c.data(),
-                              CompressedSize(counts[recv_chunk], cmp))) {
+      if (!ctx.ExchangeOn(ring, group, send_c.data(),
+                          CompressedSize(counts[send_chunk], cmp),
+                          recv_c.data(),
+                          CompressedSize(counts[recv_chunk], cmp))) {
         return RingLost(ctx, "ring allgather exchange failed");
       }
       DecompressBuffer(recv_c.data(), counts[recv_chunk], cmp,
@@ -533,10 +533,10 @@ static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
   for (int step = 0; step < n - 1; ++step) {
     int send_chunk = (rank + 1 - step + n) % n;
     int recv_chunk = (rank - step + n) % n;
-    if (!ctx.RingExchangeOn(ring, buf + offsets[send_chunk] * elem,
-                            counts[send_chunk] * elem,
-                            buf + offsets[recv_chunk] * elem,
-                            counts[recv_chunk] * elem)) {
+    if (!ctx.ExchangeOn(ring, group, buf + offsets[send_chunk] * elem,
+                        counts[send_chunk] * elem,
+                        buf + offsets[recv_chunk] * elem,
+                        counts[recv_chunk] * elem)) {
       return RingLost(ctx, "ring allgather exchange failed");
     }
   }
@@ -545,17 +545,34 @@ static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
 
 Status RingAllreduceOn(TcpContext& ctx, Ring ring, void* buffer, int64_t count,
                        DataType dtype, CompressionMode cmp,
-                       int64_t pipe_bytes) {
-  int n = ctx.RingSize(ring);
+                       int64_t pipe_bytes, uint32_t group) {
+  int n = group ? ctx.GroupSize(group) : ctx.RingSize(ring);
   if (n == 1 || count == 0) return Status::OK();
   std::vector<int64_t> counts, offsets;
   PartitionChunks(count, n, &counts, &offsets);
   char* buf = static_cast<char*>(buffer);
   Status s = RingReduceScatterOn(ctx, ring, buf, counts, offsets, dtype, cmp,
-                                 pipe_bytes);
+                                 pipe_bytes, group);
   if (!s.ok()) return s;
   return RingAllgatherPhaseOn(ctx, ring, buf, counts, offsets, dtype, cmp,
-                              pipe_bytes);
+                              pipe_bytes, group);
+}
+
+// Lazily builds (or reuses) the group's data ring before a group op
+// executes; a failure is a transport loss (generation restart).
+static Status EnsureGroup(TcpContext& ctx, HorovodGlobalState* state,
+                          uint32_t group) {
+  if (group == 0) return Status::OK();
+  std::vector<int> members = state->group_table.Members(group);
+  if (members.empty()) {
+    return Status::PreconditionError(
+        "unknown process group " + std::to_string(group) +
+        " at execution time; create it with hvd.new_group on every rank");
+  }
+  if (!ctx.EnsureGroupRing(group, members)) {
+    return RingLost(ctx, "group ring rendezvous failed");
+  }
+  return Status::OK();
 }
 
 bool CpuRingAllreduce::Enabled(const std::vector<TensorTableEntry>& entries,
@@ -564,10 +581,12 @@ bool CpuRingAllreduce::Enabled(const std::vector<TensorTableEntry>& entries,
 }
 
 Status CpuRingAllreduce::ReduceBuffer(void* buffer, int64_t count,
-                                      DataType dtype, CompressionMode cmp) {
+                                      DataType dtype, CompressionMode cmp,
+                                      uint32_t group) {
   return RingAllreduceOn(ctx_, Ring::GLOBAL, buffer, count, dtype, cmp,
                          global_state_->parameter_manager
-                             .PipelineChunkBytes());
+                             .PipelineChunkBytes(),
+                         group);
 }
 
 Status CpuRingAllreduce::Execute(std::vector<TensorTableEntry>& entries,
@@ -576,6 +595,11 @@ Status CpuRingAllreduce::Execute(std::vector<TensorTableEntry>& entries,
   void* buffer = nullptr;
   std::size_t buffer_len = 0;
   int64_t total_elements = NumElements(entries);
+  const uint32_t group = response.group_id();
+  {
+    Status s = EnsureGroup(ctx_, global_state_, group);
+    if (!s.ok()) return s;
+  }
 
   if (entries.size() > 1) {
     std::vector<std::string> names = response.tensor_names();
@@ -623,7 +647,8 @@ Status CpuRingAllreduce::Execute(std::vector<TensorTableEntry>& entries,
   }
 
   timeline.ActivityStartAll(response.tensor_names(), ActivityName());
-  Status s = ReduceBuffer(buffer, total_elements, entries[0].dtype, cmp);
+  Status s = ReduceBuffer(buffer, total_elements, entries[0].dtype, cmp,
+                          group);
   timeline.ActivityEndAll(response.tensor_names());
   if (!s.ok()) return s;
 
@@ -649,14 +674,19 @@ Status CpuRingAllreduce::Execute(std::vector<TensorTableEntry>& entries,
 bool CpuHierarchicalAllreduce::Enabled(
     const std::vector<TensorTableEntry>& entries,
     const Response& response) const {
+  // Group collectives ride the group's flat (pipelined) ring: a subgroup
+  // has no guaranteed (local, cross) grid, so the two-level composite
+  // only applies to the world group.
   return entries[0].device == HOST_DEVICE_ID &&
+         response.group_id() == 0 &&
          ctx_.hierarchical_possible() &&
          global_state_->parameter_manager.HierarchicalAllreduce();
 }
 
 Status CpuHierarchicalAllreduce::ReduceBuffer(void* buffer, int64_t count,
                                               DataType dtype,
-                                              CompressionMode cmp) {
+                                              CompressionMode cmp,
+                                              uint32_t /*group*/) {
   // Two-level composite (reference: nccl_operations.cc:150-346):
   //   1. local-ring reduce-scatter — local rank lr ends up owning chunk
   //      (lr+1) % ls, reduced over the local group;
@@ -702,8 +732,15 @@ Status CpuRingReduceScatter::Execute(std::vector<TensorTableEntry>& entries,
   // controller never fuses REDUCESCATTER responses — sharded callers
   // fuse at the source instead (one flat gradient buffer whose offsets
   // ARE the shard boundaries), so entries is normally a single tensor.
-  int n = ctx_.size();
-  int rank = ctx_.rank();
+  // Group-scoped: chunks partition over the GROUP and "rank" is the
+  // group position (shard i goes to member i).
+  const uint32_t group = response.group_id();
+  {
+    Status s = EnsureGroup(ctx_, global_state_, group);
+    if (!s.ok()) return s;
+  }
+  int n = group ? ctx_.GroupSize(group) : ctx_.size();
+  int rank = group ? ctx_.GroupRank(group) : ctx_.rank();
   auto& timeline = global_state_->timeline;
   CompressionMode cmp = EffectiveCompression(
       static_cast<CompressionMode>(response.compression()),
@@ -745,7 +782,7 @@ Status CpuRingReduceScatter::Execute(std::vector<TensorTableEntry>& entries,
     }
     Status s = RingReduceScatterOn(ctx_, Ring::GLOBAL, work.data(),
                                    ring_counts, ring_offsets, e.dtype, cmp,
-                                   pipe);
+                                   pipe, group);
     if (!s.ok()) {
       timeline.ActivityEndAll(response.tensor_names());
       return s;
@@ -905,7 +942,10 @@ static Status GroupedRingReduceScatter(
 bool CpuHierarchicalReduceScatter::Enabled(
     const std::vector<TensorTableEntry>& entries,
     const Response& response) const {
+  // World-group only, like the hierarchical allreduce: subgroups ride
+  // their flat pipelined ring.
   return entries[0].device == HOST_DEVICE_ID &&
+         response.group_id() == 0 &&
          ctx_.hierarchical_possible() &&
          global_state_->parameter_manager.HierarchicalReduceScatter();
 }
@@ -1005,8 +1045,15 @@ bool CpuRingAllgather::Enabled(const std::vector<TensorTableEntry>& entries,
 
 Status CpuRingAllgather::Execute(std::vector<TensorTableEntry>& entries,
                                  const Response& response) {
-  int n = ctx_.size();
-  int rank = ctx_.rank();
+  // Group-scoped: blocks lay out in GROUP order and circulate the
+  // group's ring; response.tensor_sizes() is indexed by group position.
+  const uint32_t group = response.group_id();
+  {
+    Status s = EnsureGroup(ctx_, global_state_, group);
+    if (!s.ok()) return s;
+  }
+  int n = group ? ctx_.GroupSize(group) : ctx_.size();
+  int rank = group ? ctx_.GroupRank(group) : ctx_.rank();
   auto& timeline = global_state_->timeline;
   timeline.ActivityStartAll(response.tensor_names(), "ALLGATHER_RING");
   for (auto& e : entries) {
@@ -1037,10 +1084,11 @@ Status CpuRingAllgather::Execute(std::vector<TensorTableEntry>& entries,
     for (int step = 0; step < n - 1; ++step) {
       int send_block = (rank - step + n) % n;
       int recv_block = (rank - step - 1 + n) % n;
-      if (!ctx_.RingExchange(out + block_offsets[send_block],
-                             static_cast<std::size_t>(block_bytes[send_block]),
-                             out + block_offsets[recv_block],
-                             static_cast<std::size_t>(block_bytes[recv_block]))) {
+      if (!ctx_.ExchangeOn(
+              Ring::GLOBAL, group, out + block_offsets[send_block],
+              static_cast<std::size_t>(block_bytes[send_block]),
+              out + block_offsets[recv_block],
+              static_cast<std::size_t>(block_bytes[recv_block]))) {
         timeline.ActivityEndAll(response.tensor_names());
         return RingLost(ctx_, "ring allgather exchange failed");
       }
@@ -1054,6 +1102,7 @@ bool CpuHierarchicalAllgather::Enabled(
     const std::vector<TensorTableEntry>& entries,
     const Response& response) const {
   return entries[0].device == HOST_DEVICE_ID &&
+         response.group_id() == 0 &&
          ctx_.hierarchical_possible() &&
          global_state_->parameter_manager.HierarchicalAllgather();
 }
@@ -1162,17 +1211,34 @@ Status CpuBroadcast::Execute(std::vector<TensorTableEntry>& entries,
                              const Response& response) {
   auto& timeline = global_state_->timeline;
   timeline.ActivityStartAll(response.tensor_names(), "BROADCAST_RING");
+  const uint32_t group = response.group_id();
+  {
+    Status s = EnsureGroup(ctx_, global_state_, group);
+    if (!s.ok()) {
+      timeline.ActivityEndAll(response.tensor_names());
+      return s;
+    }
+  }
   int rank = ctx_.rank();
   for (auto& e : entries) {
     std::size_t len = e.SizeBytes();
-    // Cut-through pipelined broadcast over the global ring: every byte
-    // crosses each link once and intermediate ranks forward as they
-    // receive, replacing the former star fan-out that serialized N-1 full
-    // copies through rank 0.
+    // Cut-through pipelined broadcast over the global ring (or, for a
+    // group collective, the group's ring with the root remapped to its
+    // group position): every byte crosses each link once and
+    // intermediate ranks forward as they receive, replacing the former
+    // star fan-out that serialized N-1 full copies through rank 0.
     if (rank == e.root_rank && e.output != e.data) {
       std::memcpy(e.output, e.data, len);
     }
-    if (!ctx_.RingBroadcast(e.output, len, e.root_rank)) {
+    bool ok;
+    if (group != 0) {
+      int root_pos = global_state_->group_table.IndexOf(group, e.root_rank);
+      ok = root_pos >= 0 &&
+           ctx_.GroupBroadcast(group, e.output, len, root_pos);
+    } else {
+      ok = ctx_.RingBroadcast(e.output, len, e.root_rank);
+    }
+    if (!ok) {
       timeline.ActivityEndAll(response.tensor_names());
       return RingLost(ctx_, "ring broadcast failed");
     }

@@ -43,8 +43,10 @@ class CpuRingAllreduce : public AllreduceOp {
   // variant. Named activity is used for the timeline. `cmp` is the
   // negotiated wire-compression mode: the buffer stays f32; each ring
   // hop encodes only the bytes it puts on the wire (compression.h).
+  // `group` != 0 runs the reduction over that process group's ring
+  // (group positions replace world ranks; docs/GROUPS.md).
   virtual Status ReduceBuffer(void* buffer, int64_t count, DataType dtype,
-                              CompressionMode cmp);
+                              CompressionMode cmp, uint32_t group);
   virtual const char* ActivityName() const { return "ALLREDUCE_RING"; }
 
   TcpContext& ctx_;
@@ -58,7 +60,7 @@ class CpuHierarchicalAllreduce : public CpuRingAllreduce {
 
  protected:
   Status ReduceBuffer(void* buffer, int64_t count, DataType dtype,
-                      CompressionMode cmp) override;
+                      CompressionMode cmp, uint32_t group) override;
   const char* ActivityName() const override {
     return "ALLREDUCE_HIERARCHICAL";
   }
@@ -141,10 +143,12 @@ void ScaleBuffer(void* buf, int64_t count, DataType dtype, double factor);
 // slices each hop into double-buffered pipeline segments of that many
 // (uncompressed-equivalent) bytes so codec + transport + reduction
 // overlap within the hop; 0 keeps the original unsliced exchange.
+// group != 0 rides that process group's ring instead of the enum ring
+// (the ring must already be built — TcpContext::EnsureGroupRing).
 Status RingAllreduceOn(TcpContext& ctx, Ring ring, void* buffer, int64_t count,
                        DataType dtype,
                        CompressionMode cmp = CompressionMode::NONE,
-                       int64_t pipe_bytes = 0);
+                       int64_t pipe_bytes = 0, uint32_t group = 0);
 
 }  // namespace hvdtpu
 

@@ -136,7 +136,8 @@ bool DivergenceDetector::ShouldForceFullCycle(
 }
 
 std::vector<DivergenceDetector::Diagnosis> DivergenceDetector::Check(
-    const std::unordered_map<std::string, std::vector<Request>>& pending) {
+    const std::unordered_map<std::string, std::vector<Request>>& pending,
+    const GroupTable* groups) {
   std::vector<Diagnosis> out;
   if (ranks_.empty()) return out;
   auto now = Clock::now();
@@ -174,9 +175,46 @@ std::vector<DivergenceDetector::Diagnosis> DivergenceDetector::Check(
     double age =
         std::chrono::duration<double>(now - st.first_seen).count();
 
+    // Group scope: only the GROUP's members owe this tensor. A
+    // group-scoped divergence must name the group and its members, not
+    // implicate (or wait on) the rest of the world.
+    const uint32_t gid = first.group_id();
+    std::string scope;
     std::set<int> missing;
-    for (int r = 0; r < world_size_; ++r) {
-      if (sub.count(r) == 0) missing.insert(r);
+    if (gid != 0 && groups != nullptr) {
+      std::vector<int> members = groups->Members(gid);
+      if (members.empty()) {
+        // The id never registered HERE. The controller's
+        // late-registration sweep covers the benign race (this
+        // process's new_group just hasn't run yet); once the tensor has
+        // aged past the grace window it is provably NOT that race —
+        // this process skipped the new_group call entirely (a
+        // registration-order divergence). Error by name instead of
+        // hanging forever.
+        if (grace_seconds_ > 0.0 && age >= grace_seconds_) {
+          std::ostringstream msg;
+          msg << "collective protocol divergence at '" << name << "' ("
+              << OpName(static_cast<uint8_t>(first.request_type())) << " "
+              << DataTypeName(first.tensor_type())
+              << "): submitted by rank(s) [" << JoinRanks(sub)
+              << "] in process group " << gid << ", but this coordinator "
+              << "never registered that group after " << static_cast<int>(age)
+              << "s — some rank skipped (or reordered) its hvd.new_group "
+              << "call; every rank must create groups with the identical "
+              << "rank lists in the identical order (docs/GROUPS.md).";
+          out.push_back({name, first.tensor_name(), gid, msg.str()});
+        }
+        continue;
+      }
+      for (int r : members) {
+        if (sub.count(r) == 0) missing.insert(r);
+      }
+      scope = " in process group " + std::to_string(gid) + " " +
+              groups->DescribeMembers(gid);
+    } else {
+      for (int r = 0; r < world_size_; ++r) {
+        if (sub.count(r) == 0) missing.insert(r);
+      }
     }
     if (missing.empty()) continue;
 
@@ -190,18 +228,19 @@ std::vector<DivergenceDetector::Diagnosis> DivergenceDetector::Check(
         std::ostringstream msg;
         msg << "collective protocol divergence at '" << name << "' ("
             << OpName(static_cast<uint8_t>(first.request_type())) << " "
-            << DataTypeName(first.tensor_type()) << "): submitted by rank(s) ["
+            << DataTypeName(first.tensor_type()) << scope
+            << "): submitted by rank(s) ["
             << JoinRanks(sub) << "] but rank " << r << " proceeded through "
             << (ranks_[r].seq - at)
             << " other collectives without submitting it; rank " << r
             << " went on to: " << DescribeRecentCalls(r, at, 4)
             << ". A rank-conditional collective or mismatched call order is "
                "the usual cause (run hvd-lint on the training script).";
-        out.push_back({name, msg.str()});
+        out.push_back({name, first.tensor_name(), gid, msg.str()});
         break;
       }
     }
-    if (!out.empty() && out.back().tensor_name == name) continue;
+    if (!out.empty() && out.back().key == name) continue;
 
     // Cross-stall rule: tensor aged past the grace window and every
     // missing rank is itself a submitter of a *different* aged pending
@@ -232,14 +271,14 @@ std::vector<DivergenceDetector::Diagnosis> DivergenceDetector::Check(
     std::ostringstream msg;
     msg << "collective protocol divergence at '" << name << "' ("
         << OpName(static_cast<uint8_t>(first.request_type())) << " "
-        << DataTypeName(first.tensor_type()) << "): rank(s) ["
+        << DataTypeName(first.tensor_type()) << scope << "): rank(s) ["
         << JoinRanks(sub) << "] have waited " << static_cast<int>(age)
         << "s while the missing rank(s) wait on different collectives:"
         << waits.str()
         << " the ranks' collective call sequences have diverged "
            "(rank-conditional collective or mismatched call order; run "
            "hvd-lint on the training script).";
-    out.push_back({name, msg.str()});
+    out.push_back({name, first.tensor_name(), gid, msg.str()});
   }
   return out;
 }

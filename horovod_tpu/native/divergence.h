@@ -32,6 +32,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "group_table.h"
 #include "message.h"
 
 namespace hvdtpu {
@@ -70,7 +71,9 @@ class CallTracker {
 class DivergenceDetector {
  public:
   struct Diagnosis {
-    std::string tensor_name;
+    std::string key;          // pending-table key (GroupQualifiedName)
+    std::string tensor_name;  // bare tensor name (entry lookup on ranks)
+    uint32_t group_id = 0;
     std::string message;
   };
 
@@ -93,8 +96,13 @@ class DivergenceDetector {
 
   // Cross-checks the pending table; returns proven divergences. The
   // caller (controller) erases the tensors and emits ERROR responses.
+  // `groups` scopes the missing-rank set: a tensor pending in a process
+  // group is only waited on by that group's MEMBERS, and its diagnosis
+  // names the group — a rank-divergent collective inside one group must
+  // never read as the whole world hanging.
   std::vector<Diagnosis> Check(
-      const std::unordered_map<std::string, std::vector<Request>>& pending);
+      const std::unordered_map<std::string, std::vector<Request>>& pending,
+      const GroupTable* groups = nullptr);
 
   uint64_t last_seq(int rank) const {
     return rank < static_cast<int>(ranks_.size()) ? ranks_[rank].seq : 0;

@@ -11,6 +11,7 @@
 
 #include "divergence.h"
 #include "fusion_buffer_manager.h"
+#include "group_table.h"
 #include "metrics.h"
 #include "parameter_manager.h"
 #include "response_cache.h"
@@ -50,6 +51,10 @@ struct HorovodGlobalState {
   // ring) — fed by EnqueueTensor, cross-checked by the coordinator's
   // DivergenceDetector and exposed to Python via horovod_tpu_call_digest.
   CallTracker call_tracker;
+  // Process-group registry (docs/GROUPS.md): written by
+  // horovod_tpu_new_group on API threads, read by the controller and
+  // the data-plane ops on the background thread; mutex inside.
+  GroupTable group_table;
   FusionBufferManager fusion_buffer;
   // Live metrics registry (metrics.h). A reference to the process
   // singleton: leaf components without a state pointer (stall inspector,

@@ -148,6 +148,8 @@ void Request::SerializeTo(std::string* out) const {
   PutF64(out, prescale_factor_);
   PutF64(out, postscale_factor_);
   PutU8(out, compression_);
+  PutU32(out, group_id_);
+  PutI64(out, static_cast<int64_t>(group_digest_));
 }
 
 std::size_t Request::ParseFrom(const char* data, std::size_t len) {
@@ -168,6 +170,9 @@ std::size_t Request::ParseFrom(const char* data, std::size_t len) {
   }
   if (!r.GetF64(&prescale_factor_) || !r.GetF64(&postscale_factor_)) return 0;
   if (!r.GetU8(&compression_)) return 0;
+  int64_t digest;
+  if (!r.GetU32(&group_id_) || !r.GetI64(&digest)) return 0;
+  group_digest_ = static_cast<uint64_t>(digest);
   return r.consumed(data);
 }
 
@@ -249,6 +254,7 @@ void Response::SerializeTo(std::string* out) const {
   PutU8(out, static_cast<uint8_t>(response_type_));
   PutU8(out, static_cast<uint8_t>(tensor_type_));
   PutU8(out, compression_);
+  PutU32(out, group_id_);
   PutI32(out, devices_);
   PutStr(out, error_message_);
   PutU32(out, static_cast<uint32_t>(tensor_names_.size()));
@@ -262,7 +268,8 @@ std::size_t Response::ParseFrom(const char* data, std::size_t len) {
   uint8_t rt, tt;
   uint32_t nn, ns;
   if (!r.GetU8(&rt) || !r.GetU8(&tt) || !r.GetU8(&compression_) ||
-      !r.GetI32(&devices_) || !r.GetStr(&error_message_) || !r.GetU32(&nn))
+      !r.GetU32(&group_id_) || !r.GetI32(&devices_) ||
+      !r.GetStr(&error_message_) || !r.GetU32(&nn))
     return 0;
   response_type_ = static_cast<ResponseType>(rt);
   tensor_type_ = static_cast<DataType>(tt);

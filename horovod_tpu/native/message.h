@@ -80,6 +80,16 @@ class Request {
   uint8_t compression() const { return compression_; }
   void set_compression(uint8_t c) { compression_ = c; }
 
+  // Process group this collective is scoped to (group_table.h; 0 = the
+  // world). The coordinator counts readiness against the GROUP's member
+  // set, the response cache keys on it, and the executing op rides the
+  // group's ring. group_digest is the sender's membership digest for the
+  // id — the coordinator rejects mixed-membership groups by name.
+  uint32_t group_id() const { return group_id_; }
+  void set_group_id(uint32_t g) { group_id_ = g; }
+  uint64_t group_digest() const { return group_digest_; }
+  void set_group_digest(uint64_t d) { group_digest_ = d; }
+
   void SerializeTo(std::string* out) const;
   // Returns bytes consumed, 0 on error.
   std::size_t ParseFrom(const char* data, std::size_t len);
@@ -95,6 +105,8 @@ class Request {
   double prescale_factor_ = 1.0;
   double postscale_factor_ = 1.0;
   uint8_t compression_ = 0;  // CompressionMode::NONE
+  uint32_t group_id_ = 0;    // 0 = world
+  uint64_t group_digest_ = 0;
 };
 
 // One entry of a rank's collective call history (divergence.h): enough to
@@ -194,6 +206,14 @@ class Response {
   uint8_t compression() const { return compression_; }
   void set_compression(uint8_t c) { compression_ = c; }
 
+  // Process group scope (0 = world). Executing ranks ride the group's
+  // ring; ranks outside the group skip the response (no table entry)
+  // but still mirror it into their response cache so cache bits stay
+  // rank-identical (response_cache.h). Fusion only merges same-group
+  // responses.
+  uint32_t group_id() const { return group_id_; }
+  void set_group_id(uint32_t g) { group_id_ = g; }
+
   void SerializeTo(std::string* out) const;
   std::size_t ParseFrom(const char* data, std::size_t len);
 
@@ -205,6 +225,7 @@ class Response {
   DataType tensor_type_ = DataType::HVD_FLOAT32;
   int32_t devices_ = -1;
   uint8_t compression_ = 0;  // CompressionMode::NONE
+  uint32_t group_id_ = 0;    // 0 = world
 };
 
 class ResponseList {

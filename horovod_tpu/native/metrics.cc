@@ -74,6 +74,8 @@ const char* SummaryFieldName(int field) {
     case SUM_OPT_STATE_BYTES: return "opt_state_bytes";
     case SUM_AUTOTUNE_ACTIVE: return "autotune_active";
     case SUM_AUTOTUNE_REARMS: return "autotune_rearms_total";
+    case SUM_GROUPS: return "groups";
+    case SUM_GROUP_TENSORS: return "group_tensors_total";
   }
   return "unknown";
 }
@@ -111,6 +113,9 @@ void Metrics::Configure(int world_size_in, int rank_in) {
   queue_depth.store(0, std::memory_order_relaxed);
   pending_negotiation.store(0, std::memory_order_relaxed);
   opt_state_bytes.store(-1, std::memory_order_relaxed);
+  // Groups are per-generation (the registry clears on re-init and
+  // Python re-creates the mesh groups after it).
+  groups.store(0, std::memory_order_relaxed);
   std::lock_guard<std::mutex> lk(rank_mutex_);
   is_coordinator_ = rank_in == 0;
   rank_lag_seconds_.assign(world_size_in, 0.0);
@@ -173,6 +178,8 @@ std::vector<double> Metrics::Summary() const {
   v[SUM_AUTOTUNE_ACTIVE] = static_cast<double>(autotune_active.load());
   v[SUM_AUTOTUNE_REARMS] =
       static_cast<double>(autotune_rearms_total.load());
+  v[SUM_GROUPS] = static_cast<double>(groups.load());
+  v[SUM_GROUP_TENSORS] = static_cast<double>(group_tensors_total.load());
   return v;
 }
 
@@ -321,6 +328,9 @@ std::string Metrics::SnapshotJson() const {
            pipeline_segments_total.load(), &first);
   AppendKV(&out, "autotune_rearms_total",
            autotune_rearms_total.load(), &first);
+  AppendKV(&out, "group_tensors_total", group_tensors_total.load(), &first);
+  AppendKV(&out, "group_negotiated_overflow_total",
+           group_negotiated_overflow_total.load(), &first);
   out.append("},\"gauges\":{");
   first = true;
   AppendKV(&out, "queue_depth", static_cast<double>(queue_depth.load()),
@@ -343,6 +353,24 @@ std::string Metrics::SnapshotJson() const {
            static_cast<double>(autotune_active.load()), &first);
   AppendKV(&out, "pipeline_chunk_bytes",
            static_cast<double>(pipeline_chunk_bytes.load()), &first);
+  AppendKV(&out, "groups", static_cast<double>(groups.load()), &first);
+  out.append("},\"per_group\":{");
+  // Group-labeled negotiation counters (docs/GROUPS.md): one entry per
+  // tracked group id with at least one negotiated tensor. The Python
+  // renderer turns these into
+  // hvdtpu_group_negotiated_total{group="<id>"} families.
+  first = true;
+  for (int g = 0; g < kGroupStatSlots; ++g) {
+    uint64_t n = group_negotiated_total[g].load(std::memory_order_relaxed);
+    if (n == 0) continue;
+    if (!first) out.append(",");
+    first = false;
+    out.append("\"");
+    out.append(std::to_string(g + 1));
+    out.append("\":{\"negotiated_total\":");
+    AppendNum(&out, static_cast<double>(n));
+    out.append("}");
+  }
   out.append("},\"histograms\":{");
   first = true;
   AppendHistogram(&out, "cycle_seconds", cycle_seconds, &first);

@@ -111,12 +111,17 @@ enum SummaryField : int {
   // the tail.
   SUM_REDUCE_SCATTER,
   SUM_OPT_STATE_BYTES,
-  // Always-on closed-loop autotune (docs/AUTOTUNE.md). Appended last:
-  // whether this rank's tuner is actively sampling (1) or converged (0)
-  // and how many times it re-armed; the hvd-top `tun` column renders
-  // them ('-' for a pre-autotune worker's summary).
+  // Always-on closed-loop autotune (docs/AUTOTUNE.md). Appended after
+  // the sharded fields: whether this rank's tuner is actively sampling
+  // (1) or converged (0) and how many times it re-armed; the hvd-top
+  // `tun` column renders them ('-' for a pre-autotune worker's summary).
   SUM_AUTOTUNE_ACTIVE,
   SUM_AUTOTUNE_REARMS,
+  // Process groups (docs/GROUPS.md). Appended last: registered groups
+  // on this rank and group-scoped tensors it executed; the hvd-top
+  // `grp` column renders them ('-' for a pre-groups worker's summary).
+  SUM_GROUPS,
+  SUM_GROUP_TENSORS,
   SUM_FIELD_COUNT
 };
 const char* SummaryFieldName(int field);
@@ -202,6 +207,28 @@ class Metrics {
   // --- always-on closed-loop autotune (parameter_manager / operations.cc) ---
   std::atomic<uint64_t> autotune_rearms_total{0};
 
+  // --- process groups (controller.cc / operations.cc; docs/GROUPS.md) ---
+  // Group-scoped tensors this rank EXECUTED (non-members of a group
+  // skip its responses and contribute nothing).
+  std::atomic<uint64_t> group_tensors_total{0};
+  // Coordinator-side per-group negotiation counters, rendered as
+  // group-labeled Prometheus families. Fixed slots: group ids 1..16
+  // are tracked individually; higher ids still count into
+  // group_negotiated_overflow_total (no silent drop).
+  static constexpr int kGroupStatSlots = 16;
+  std::atomic<uint64_t> group_negotiated_total[kGroupStatSlots] = {};
+  std::atomic<uint64_t> group_negotiated_overflow_total{0};
+  void AddGroupNegotiated(uint32_t group_id, uint64_t tensors) {
+    if (group_id >= 1 &&
+        group_id <= static_cast<uint32_t>(kGroupStatSlots)) {
+      group_negotiated_total[group_id - 1].fetch_add(
+          tensors, std::memory_order_relaxed);
+    } else {
+      group_negotiated_overflow_total.fetch_add(tensors,
+                                                std::memory_order_relaxed);
+    }
+  }
+
   // --- gauges (instantaneous; reset per generation) ---
   std::atomic<int64_t> queue_depth{0};
   std::atomic<int64_t> pending_negotiation{0};
@@ -227,6 +254,9 @@ class Metrics {
   std::atomic<int64_t> autotune_active{0};
   // Pipelined-ring segment size currently in force (0 = slicing off).
   std::atomic<int64_t> pipeline_chunk_bytes{0};
+  // Registered process groups (group_table.h; reset per generation —
+  // re-init clears the table and Python re-creates the mesh groups).
+  std::atomic<int64_t> groups{0};
 
   // --- histograms ---
   MetricHistogram cycle_seconds;        // background work-cycle duration

@@ -559,7 +559,7 @@ static int ConnectOnce(const struct addrinfo* ai, int attempt_ms) {
 
 Conn ConnectPeer(const std::string& host, int port, int my_rank,
                  Channel channel, int timeout_ms, uint32_t generation,
-                 uint64_t opseq, bool reconnect) {
+                 uint64_t opseq, bool reconnect, bool group_ring) {
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
   while (true) {
@@ -592,8 +592,10 @@ Conn ConnectPeer(const std::string& host, int port, int my_rank,
       Conn c(fd, channel);
       char hs[kHandshakeBytes];
       EncodeHandshake(hs, my_rank, channel,
-                      reconnect ? kHandshakeReconnect : 0, generation,
-                      opseq);
+                      static_cast<uint8_t>(
+                          (reconnect ? kHandshakeReconnect : 0) |
+                          (group_ring ? kHandshakeGroupRing : 0)),
+                      generation, opseq);
       if (c.SendAll(hs, sizeof(hs))) {
         if (!reconnect) return c;
         // Reconnects wait for the acceptor's verdict so a rejected

@@ -129,6 +129,11 @@ class Conn {
 // (flags & kHandshakeReconnect) resumes at the exact frame the
 // coordinator expects (see tcp_context.cc).
 constexpr uint8_t kHandshakeReconnect = 0x1;
+// Group-ring connect (docs/GROUPS.md): the connection joins a process
+// group's data ring; opseq carries the GROUP ID instead of a resume
+// cursor. Built lazily by the background thread at a group op's first
+// execution (tcp_context.cc EnsureGroupRing).
+constexpr uint8_t kHandshakeGroupRing = 0x2;
 constexpr std::size_t kHandshakeBytes = 22;
 
 struct PeerHandshake {
@@ -171,10 +176,12 @@ class Listener {
 // instead of hanging in connect() for the kernel default (~2 min).
 // When `reconnect` is set the connection additionally waits for the
 // acceptor's 1-byte verdict (1 = resume; anything else = rejected).
-// Returns an invalid Conn on failure.
+// `group_ring` marks a group-ring connect (kHandshakeGroupRing; opseq
+// then carries the group id). Returns an invalid Conn on failure.
 Conn ConnectPeer(const std::string& host, int port, int my_rank,
                  Channel channel, int timeout_ms, uint32_t generation = 0,
-                 uint64_t opseq = 0, bool reconnect = false);
+                 uint64_t opseq = 0, bool reconnect = false,
+                 bool group_ring = false);
 
 // Splits "host:port" / "h1:p1,h2:p2,..." forms.
 bool ParseHostPort(const std::string& s, std::string* host, int* port);

@@ -135,12 +135,21 @@ HandleManager g_handles;
 std::pair<int64_t, int64_t> PerformOperation(HorovodGlobalState& state,
                                              const Response& response) {
   // Cache the negotiated response while entries are still in the table.
+  // EVERY rank mirrors EVERY response (non-members store foreign
+  // placeholder entries) so cache-bit positions stay rank-identical —
+  // the bit-vector fast path depends on it (response_cache.h).
   if (response.response_type() != Response::ERROR) {
-    state.response_cache.put(response, state.tensor_queue);
+    state.response_cache.put(response, state.tensor_queue,
+                             &state.group_table,
+                             state.controller->rank());
   }
   std::vector<TensorTableEntry> entries;
   state.tensor_queue.GetTensorEntriesFromResponse(response, entries);
   if (entries.empty()) return {0, 0};
+  if (response.group_id() != 0) {
+    state.metrics.group_tensors_total.fetch_add(
+        entries.size(), std::memory_order_relaxed);
+  }
   // Fusion diagnostics: responses vs tensors executed (a fused response
   // carries several tensors; with fusion off the counts are equal).
   state.responses_performed.fetch_add(1);
@@ -276,6 +285,9 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
   // and bit layout, and the protocol counters would mix generations.
   state.connection_lost.store(false);
   state.response_cache.clear();
+  // Groups reference the old membership's ranks; Python re-creates the
+  // mesh groups after every (re-)init (docs/GROUPS.md).
+  state.group_table.Clear();
   state.tcp_context.ResetProtocolCounters();
   state.responses_performed.store(0);
   state.tensors_performed.store(0);
@@ -354,6 +366,7 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
   // on — they only trigger on protocol-divergent programs, which would
   // otherwise hang to the stall timeout.
   state.controller->SetCallTracker(&state.call_tracker);
+  state.controller->SetGroupTable(&state.group_table);
   state.controller->ConfigureDivergence(
       EnvInt64(HVD_TPU_DIVERGENCE_CALLS, 64),
       EnvDouble(HVD_TPU_DIVERGENCE_GRACE, 5.0));
@@ -465,7 +478,7 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
                      const void* data, void* output, int ndim,
                      const int64_t* shape, int dtype, int root_rank,
                      double prescale, double postscale, int compression,
-                     int handle) {
+                     int group, int handle) {
   if (!g_state.initialization_done.load() ||
       g_state.initialization_failed.load()) {
     return Status::PreconditionError("Horovod-TPU has not been initialized.");
@@ -477,6 +490,42 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
     return g_state.connection_lost.load()
                ? Status::UnknownError(CONNECTION_LOST_ERROR)
                : Status::Aborted(SHUT_DOWN_ERROR);
+  }
+  // Group scoping (docs/GROUPS.md): validate HERE, on the calling
+  // thread, so a scoping mistake surfaces as an immediate Python error
+  // instead of a negotiation-time rejection (or a hang).
+  uint64_t group_digest = 0;
+  if (group < 0) {
+    return Status::InvalidArgument("process group id must be >= 0");
+  }
+  if (group > 0) {
+    int my_rank = g_state.controller->rank();
+    if (g_state.group_table.Size(static_cast<uint32_t>(group)) == 0) {
+      return Status::InvalidArgument(
+          "unknown process group " + std::to_string(group) +
+          "; create it with hvd.new_group(ranks) on EVERY rank first");
+    }
+    if (!g_state.group_table.Contains(static_cast<uint32_t>(group),
+                                      my_rank)) {
+      return Status::InvalidArgument(
+          "rank " + std::to_string(my_rank) +
+          " is not a member of process group " + std::to_string(group) +
+          " " +
+          g_state.group_table.DescribeMembers(
+              static_cast<uint32_t>(group)) +
+          "; only members may submit its collectives");
+    }
+    if (type == Request::BROADCAST &&
+        !g_state.group_table.Contains(static_cast<uint32_t>(group),
+                                      root_rank)) {
+      return Status::InvalidArgument(
+          "broadcast root rank " + std::to_string(root_rank) +
+          " is not a member of process group " + std::to_string(group) +
+          " " +
+          g_state.group_table.DescribeMembers(
+              static_cast<uint32_t>(group)));
+    }
+    group_digest = g_state.group_table.Digest(static_cast<uint32_t>(group));
   }
   TensorShape tensor_shape;
   for (int i = 0; i < ndim; ++i) tensor_shape.AddDim(shape[i]);
@@ -499,6 +548,8 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
   message.set_prescale_factor(prescale);
   message.set_postscale_factor(postscale);
   message.set_compression(effective);
+  message.set_group_id(static_cast<uint32_t>(group));
+  message.set_group_digest(group_digest);
 
   TensorTableEntry entry;
   entry.tensor_name = name;
@@ -510,6 +561,7 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
   entry.prescale_factor = prescale;
   entry.postscale_factor = postscale;
   entry.compression = effective;
+  entry.group_id = static_cast<uint32_t>(group);
   entry.callback = [handle](const Status& status,
                             const TensorTableEntry& done_entry) {
     LOG(TRACE) << "done " << done_entry.tensor_name << " handle " << handle
@@ -525,8 +577,12 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
   // negotiation, and counting it would diverge this rank's seq/digest
   // from peers on a protocol-consistent program.
   if (status.ok()) {
-    g_state.call_tracker.Record(static_cast<uint8_t>(type),
-                                static_cast<uint8_t>(dtype), ndim, name);
+    // Group-qualified tracker name: the call fingerprint (and the
+    // divergence reports built from it) must distinguish the same
+    // tensor name used in different groups.
+    g_state.call_tracker.Record(
+        static_cast<uint8_t>(type), static_cast<uint8_t>(dtype), ndim,
+        GroupQualifiedName(static_cast<uint32_t>(group), name));
     g_state.metrics.tensors_enqueued_total.fetch_add(
         1, std::memory_order_relaxed);
   }
@@ -763,6 +819,111 @@ int horovod_tpu_enqueue_allreduce(const char* name, const void* data,
   int handle = g_handles.AllocateHandle();
   Status s = EnqueueTensor(Request::ALLREDUCE, name, data, output, ndim, shape,
                            dtype, 0, prescale, postscale, compression,
+                           /*group=*/0, handle);
+  if (!s.ok()) {
+    g_handles.MarkDone(handle, s);
+  }
+  return handle;
+}
+
+// ---------------- process groups (docs/GROUPS.md) ----------------
+
+// Registers a process group over `ranks` (strictly ascending world
+// ranks). COLLECTIVE BY CONVENTION: every rank — members and
+// non-members alike — must call it with the identical list in the
+// identical order; ids come from a per-process counter, so the same
+// call sequence yields the same ids everywhere (mismatched membership
+// is additionally rejected at negotiation via the group digest).
+// Returns the group id (>= 1) or a negative error code.
+int horovod_tpu_new_group(const int32_t* ranks, int nranks) {
+  if (!g_state.initialization_done.load() ||
+      g_state.initialization_failed.load() || !g_state.controller) {
+    return -1;  // not initialized
+  }
+  if (ranks == nullptr || nranks <= 0) return -2;
+  int world = g_state.controller->size();
+  std::vector<int> members(ranks, ranks + nranks);
+  for (int r : members) {
+    if (r < 0 || r >= world) return -3;  // rank out of range
+  }
+  uint32_t id = g_state.group_table.Register(std::move(members));
+  if (id == 0) return -4;  // not strictly ascending / duplicates
+  GlobalMetrics().groups.store(
+      static_cast<int64_t>(g_state.group_table.Count()),
+      std::memory_order_relaxed);
+  return static_cast<int>(id);
+}
+
+int horovod_tpu_group_size(int group) {
+  if (group == 0) {
+    return g_state.controller ? g_state.controller->size() : -1;
+  }
+  int n = g_state.group_table.Size(static_cast<uint32_t>(group));
+  return n == 0 ? -1 : n;
+}
+
+// This rank's position in the group's ring order; -1 when not a member.
+int horovod_tpu_group_rank(int group) {
+  if (!g_state.controller) return -1;
+  if (group == 0) return g_state.controller->rank();
+  return g_state.group_table.IndexOf(static_cast<uint32_t>(group),
+                                     g_state.controller->rank());
+}
+
+int horovod_tpu_group_count() {
+  return static_cast<int>(g_state.group_table.Count());
+}
+
+int horovod_tpu_enqueue_allreduce_grp(const char* name, const void* data,
+                                      void* output, int ndim,
+                                      const int64_t* shape, int dtype,
+                                      double prescale, double postscale,
+                                      int compression, int group) {
+  int handle = g_handles.AllocateHandle();
+  Status s = EnqueueTensor(Request::ALLREDUCE, name, data, output, ndim,
+                           shape, dtype, 0, prescale, postscale, compression,
+                           group, handle);
+  if (!s.ok()) {
+    g_handles.MarkDone(handle, s);
+  }
+  return handle;
+}
+
+int horovod_tpu_enqueue_reduce_scatter_grp(const char* name,
+                                           const void* data, void* output,
+                                           int ndim, const int64_t* shape,
+                                           int dtype, double prescale,
+                                           double postscale, int compression,
+                                           int group) {
+  int handle = g_handles.AllocateHandle();
+  Status s = EnqueueTensor(Request::REDUCESCATTER, name, data, output, ndim,
+                           shape, dtype, 0, prescale, postscale, compression,
+                           group, handle);
+  if (!s.ok()) {
+    g_handles.MarkDone(handle, s);
+  }
+  return handle;
+}
+
+int horovod_tpu_enqueue_allgather_grp(const char* name, const void* data,
+                                      int ndim, const int64_t* shape,
+                                      int dtype, int group) {
+  int handle = g_handles.AllocateHandle();
+  Status s = EnqueueTensor(Request::ALLGATHER, name, data, nullptr, ndim,
+                           shape, dtype, 0, 1.0, 1.0, 0, group, handle);
+  if (!s.ok()) {
+    g_handles.MarkDone(handle, s);
+  }
+  return handle;
+}
+
+int horovod_tpu_enqueue_broadcast_grp(const char* name, const void* data,
+                                      void* output, int ndim,
+                                      const int64_t* shape, int dtype,
+                                      int root_rank, int group) {
+  int handle = g_handles.AllocateHandle();
+  Status s = EnqueueTensor(Request::BROADCAST, name, data, output, ndim,
+                           shape, dtype, root_rank, 1.0, 1.0, 0, group,
                            handle);
   if (!s.ok()) {
     g_handles.MarkDone(handle, s);
@@ -807,7 +968,7 @@ int horovod_tpu_enqueue_reduce_scatter(const char* name, const void* data,
   int handle = g_handles.AllocateHandle();
   Status s = EnqueueTensor(Request::REDUCESCATTER, name, data, output, ndim,
                            shape, dtype, 0, prescale, postscale, compression,
-                           handle);
+                           /*group=*/0, handle);
   if (!s.ok()) {
     g_handles.MarkDone(handle, s);
   }
@@ -837,7 +998,8 @@ int horovod_tpu_enqueue_allgather(const char* name, const void* data, int ndim,
   // The op writes the gathered result into core-owned buffers; the entry
   // callback surfaces them through the handle for copy-out.
   Status s = EnqueueTensor(Request::ALLGATHER, name, data, nullptr, ndim,
-                           shape, dtype, 0, 1.0, 1.0, 0, handle);
+                           shape, dtype, 0, 1.0, 1.0, 0, /*group=*/0,
+                           handle);
   if (!s.ok()) {
     g_handles.MarkDone(handle, s);
   }
@@ -849,7 +1011,8 @@ int horovod_tpu_enqueue_broadcast(const char* name, const void* data,
                                   int dtype, int root_rank) {
   int handle = g_handles.AllocateHandle();
   Status s = EnqueueTensor(Request::BROADCAST, name, data, output, ndim, shape,
-                           dtype, root_rank, 1.0, 1.0, 0, handle);
+                           dtype, root_rank, 1.0, 1.0, 0, /*group=*/0,
+                           handle);
   if (!s.ok()) {
     g_handles.MarkDone(handle, s);
   }

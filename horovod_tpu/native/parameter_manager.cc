@@ -30,6 +30,7 @@ static constexpr double kChunkLoKbCompressed = 16.0,
 // Wire word layout: (rearm_epoch << 8) | profile bits.
 static constexpr uint64_t kProfileCompression = 1;
 static constexpr uint64_t kProfileReduceScatter = 2;
+static constexpr uint64_t kProfileGroups = 4;
 
 ParameterManager::ParameterManager() = default;
 ParameterManager::~ParameterManager() = default;
@@ -61,6 +62,7 @@ void ParameterManager::Initialize(int32_t rank,
   armed_once_ = false;  // re-opened by the generation's SetAutoTuning
   profile_compression_ = false;
   profile_reduce_scatter_ = false;
+  profile_groups_ = false;
   if (rank == 0 && !autotune_log_file.empty()) {
     log_.open(autotune_log_file, std::ios::out | std::ios::trunc);
     if (log_.is_open()) {
@@ -239,16 +241,22 @@ void ParameterManager::SetPipelineChunkBytes(int64_t bytes, bool fixed) {
 }
 
 void ParameterManager::ObserveWorkload(bool compression_active,
-                                       bool reduce_scatter_active) {
+                                       bool reduce_scatter_active,
+                                       bool groups_active) {
   std::lock_guard<std::mutex> lk(mu_);
   // Sticky: once a capability is seen the search space stays shaped for
-  // it (a job that did one sharded step will do more).
+  // it (a job that did one sharded step will do more; a job that did
+  // one subgroup collective is running a mesh).
   bool comp_changed = compression_active && !profile_compression_;
   bool rs_changed = reduce_scatter_active && !profile_reduce_scatter_;
-  if (!comp_changed && !rs_changed) return;
+  bool grp_changed = groups_active && !profile_groups_;
+  if (!comp_changed && !rs_changed && !grp_changed) return;
   profile_compression_ = profile_compression_ || compression_active;
   profile_reduce_scatter_ = profile_reduce_scatter_ || reduce_scatter_active;
-  TriggerRearm(rs_changed ? "profile-reduce-scatter" : "profile-compression");
+  profile_groups_ = profile_groups_ || groups_active;
+  TriggerRearm(rs_changed ? "profile-reduce-scatter"
+                          : (comp_changed ? "profile-compression"
+                                          : "profile-groups"));
 }
 
 bool ParameterManager::TriggerRearm(const char* reason) {
@@ -281,7 +289,8 @@ uint64_t ParameterManager::WireEpochForBroadcast() {
     Arm();
   }
   uint64_t profile = (profile_compression_ ? kProfileCompression : 0) |
-                     (profile_reduce_scatter_ ? kProfileReduceScatter : 0);
+                     (profile_reduce_scatter_ ? kProfileReduceScatter : 0) |
+                     (profile_groups_ ? kProfileGroups : 0);
   return (static_cast<uint64_t>(rearm_epoch_) << 8) | profile;
 }
 
@@ -293,6 +302,7 @@ void ParameterManager::NoteWireEpoch(uint64_t wire) {
   ++rearms_total_;
   profile_compression_ = (wire & kProfileCompression) != 0;
   profile_reduce_scatter_ = (wire & kProfileReduceScatter) != 0;
+  profile_groups_ = (wire & kProfileGroups) != 0;
   // Deterministic mirror of the coordinator's Arm(): fresh optimizers
   // with fixed seeds propose the same first sample, so every rank holds
   // identical knob values from this cycle on.
@@ -499,7 +509,8 @@ std::string ParameterManager::Json() const {
       "\"fixed\":{\"fusion\":%s,\"cycle\":%s,\"pipeline_chunk\":%s,"
       "\"cache\":%s,\"hierarchical_allreduce\":%s,"
       "\"hierarchical_allgather\":%s,\"hierarchical_reduce_scatter\":%s},"
-      "\"profile\":{\"compression\":%s,\"reduce_scatter\":%s},"
+      "\"profile\":{\"compression\":%s,\"reduce_scatter\":%s,"
+      "\"groups\":%s},"
       "\"baseline\":{\"bytes_per_cycle\":%.6g,\"tensors_per_cycle\":%.6g}}",
       active_ ? "true" : "false", rearm_epoch_,
       static_cast<unsigned long long>(rearms_total_), sample_count_,
@@ -513,7 +524,8 @@ std::string ParameterManager::Json() const {
       hier_ar_fixed_ ? "true" : "false", hier_ag_fixed_ ? "true" : "false",
       hier_rs_fixed_ ? "true" : "false",
       profile_compression_ ? "true" : "false",
-      profile_reduce_scatter_ ? "true" : "false", baseline_bytes_per_cycle_,
+      profile_reduce_scatter_ ? "true" : "false",
+      profile_groups_ ? "true" : "false", baseline_bytes_per_cycle_,
       baseline_tensors_per_cycle_);
   return buf;
 }

@@ -76,8 +76,11 @@ class ParameterManager {
   // Capability profile of the running job, observed by the coordinator
   // from negotiated responses (and seeded from env before the first
   // cycle). A profile change after convergence triggers a re-arm so the
-  // search space is rebuilt compression- and sharded-update-aware.
-  void ObserveWorkload(bool compression_active, bool reduce_scatter_active);
+  // search space is rebuilt compression-, sharded-update-, and
+  // group-aware (a first subgroup collective changes the traffic mix
+  // the knobs were scored under — the tuner must re-score under it).
+  void ObserveWorkload(bool compression_active, bool reduce_scatter_active,
+                       bool groups_active = false);
 
   // Called once per cycle on the coordinator with the tensors/bytes the
   // cycle executed. Advances sampling while tuning; tracks workload
@@ -152,6 +155,7 @@ class ParameterManager {
   // Workload profile (search-space shaping + re-arm trigger).
   bool profile_compression_ = false;
   bool profile_reduce_scatter_ = false;
+  bool profile_groups_ = false;
 
   bool active_ = false;
   int32_t rank_ = -1;

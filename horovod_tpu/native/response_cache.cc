@@ -22,20 +22,46 @@ void ResponseCache::clear() {
   cache_.clear();
   cache_iters_.clear();
   lru_.clear();
-  name_to_bit_.clear();
+  key_to_bit_.clear();
+  name_refs_.clear();
+  non_member_entries_ = 0;
   bits_outdated_ = false;
 }
 
 ResponseCache::CacheState ResponseCache::cached(const Request& request) const {
-  auto it = name_to_bit_.find(request.tensor_name());
-  if (it == name_to_bit_.end()) return CacheState::MISS;
+  const std::string key =
+      GroupQualifiedName(request.group_id(), request.tensor_name());
+  auto it = key_to_bit_.find(key);
+  if (it == key_to_bit_.end()) {
+    // The NAME cached under a different group id is a membership change:
+    // INVALID, so the stale entry is erased on every rank (via the
+    // invalid-bit OR sync) and the tensor renegotiates under its new
+    // group — same contract as a compression-mode change. The bare-name
+    // index keeps the ordinary miss (every auto-named tensor, fresh
+    // each call) a single hash lookup; the scan only runs when the name
+    // genuinely lives under some other group.
+    if (name_refs_.count(request.tensor_name())) {
+      for (const auto& e : cache_) {
+        if (e.response.tensor_names()[0] == request.tensor_name() &&
+            e.group_id != request.group_id()) {
+          return CacheState::INVALID;
+        }
+      }
+    }
+    return CacheState::MISS;
+  }
   const CacheEntry& e = cache_[it->second];
+  // Foreign entries are bit-position mirrors on non-members; they carry
+  // no validation params. A local lookup on one means this rank now
+  // enqueues a (group, name) it never executed — renegotiate.
+  if (e.foreign) return CacheState::INVALID;
   bool same = e.dtype == request.tensor_type() &&
               e.shape == request.tensor_shape() &&
               e.root_rank == request.root_rank() &&
               e.prescale_factor == request.prescale_factor() &&
               e.postscale_factor == request.postscale_factor() &&
-              e.compression == request.compression();
+              e.compression == request.compression() &&
+              e.group_digest == request.group_digest();
   // Response type must match the request type too. The two enums agree
   // numerically for allreduce/allgather/broadcast but diverge at
   // REDUCESCATTER (Response appends it AFTER ERROR for wire
@@ -50,10 +76,17 @@ ResponseCache::CacheState ResponseCache::cached(const Request& request) const {
   return same ? CacheState::HIT : CacheState::INVALID;
 }
 
-void ResponseCache::put_entry(const std::string& name, CacheEntry entry) {
-  auto it = name_to_bit_.find(name);
-  if (it != name_to_bit_.end()) {
+void ResponseCache::put_entry(CacheEntry entry) {
+  // Copies, not references: `entry` is moved into the slot below, and a
+  // reference into the moved-from object would index an empty key.
+  const std::string key = entry.key;
+  const std::string name = entry.response.tensor_names()[0];
+  const bool new_non_member = !entry.is_member;
+  auto it = key_to_bit_.find(key);
+  if (it != key_to_bit_.end()) {
     uint32_t bit = it->second;
+    if (!cache_[bit].is_member) --non_member_entries_;
+    if (new_non_member) ++non_member_entries_;
     cache_[bit] = std::move(entry);
     lru_.erase(cache_iters_[bit]);
     lru_.push_front(bit);
@@ -71,35 +104,46 @@ void ResponseCache::put_entry(const std::string& name, CacheEntry entry) {
     // ranks evict identically because they run identical put sequences.
     bit = lru_.back();
     lru_.pop_back();
-    for (auto& kv : name_to_bit_) {
-      if (kv.second == bit) {
-        name_to_bit_.erase(kv.first);
-        break;
-      }
-    }
+    key_to_bit_.erase(cache_[bit].key);
+    DropNameRef(cache_[bit].response.tensor_names()[0]);
+    if (!cache_[bit].is_member) --non_member_entries_;
     cache_[bit] = std::move(entry);
     lru_.push_front(bit);
     cache_iters_[bit] = lru_.begin();
     bits_outdated_ = true;
   }
-  name_to_bit_[name] = bit;
+  key_to_bit_[key] = bit;
+  name_refs_[name] += 1;
+  if (new_non_member) ++non_member_entries_;
 }
 
-void ResponseCache::put(const Response& response, TensorQueue& tensor_queue) {
+void ResponseCache::put(const Response& response, TensorQueue& tensor_queue,
+                        const GroupTable* groups, int my_rank) {
   if (capacity_ == 0) return;
   if (response.response_type() == Response::ERROR) return;
+  uint32_t gid = response.group_id();
+  bool member = gid == 0 ||
+                (groups != nullptr && groups->Contains(gid, my_rank));
   // Fused responses are cached per-tensor so each tensor can hit alone.
-  for (const auto& name : response.tensor_names()) {
+  for (std::size_t i = 0; i < response.tensor_names().size(); ++i) {
+    const std::string& name = response.tensor_names()[i];
     Response single;
     single.set_response_type(response.response_type());
     single.set_tensor_type(response.tensor_type());
     single.set_devices(response.devices());
     single.set_compression(response.compression());
+    single.set_group_id(gid);
     single.add_tensor_name(name);
     CacheEntry entry;
+    entry.key = GroupQualifiedName(gid, name);
+    entry.group_id = gid;
+    entry.group_digest =
+        gid != 0 && groups != nullptr ? groups->Digest(gid) : 0;
+    entry.is_member = member;
     // Capture validation params from the table entry if it still exists;
-    // callers invoke put() before callbacks fire, so it does.
-    if (tensor_queue.HasEntry(name)) {
+    // member callers invoke put() before callbacks fire, so it does.
+    if (member && tensor_queue.HasEntry(name) &&
+        tensor_queue.GetTensorEntry(name).group_id == gid) {
       const TensorTableEntry& te = tensor_queue.GetTensorEntry(name);
       entry.dtype = te.dtype;
       entry.shape = te.shape.dims();
@@ -117,10 +161,20 @@ void ResponseCache::put(const Response& response, TensorQueue& tensor_queue) {
         single.add_tensor_size(te.shape.num_elements());
       }
     } else {
-      continue;
+      // Foreign mirror: this rank never executes (group, name), but the
+      // bit POSITION must exist here too or the cross-rank bit vectors
+      // desync. Sizes come from the response so fusion weighing stays
+      // rank-identical on the cached fast path.
+      entry.dtype = response.tensor_type();
+      entry.foreign = true;
+      if (response.response_type() == Response::ALLGATHER) {
+        single.set_tensor_sizes(response.tensor_sizes());
+      } else if (i < response.tensor_sizes().size()) {
+        single.add_tensor_size(response.tensor_sizes()[i]);
+      }
     }
     entry.response = single;
-    put_entry(name, std::move(entry));
+    put_entry(std::move(entry));
   }
 }
 
@@ -138,19 +192,46 @@ const Response& ResponseCache::peek_response(uint32_t cache_bit) const {
 }
 
 uint32_t ResponseCache::peek_cache_bit(const Request& request) const {
-  return peek_cache_bit(request.tensor_name());
+  auto it = key_to_bit_.find(
+      GroupQualifiedName(request.group_id(), request.tensor_name()));
+  if (it != key_to_bit_.end()) return it->second;
+  // Membership-change INVALID path: the name lives under another group's
+  // key — return that stale bit so the invalid-bit sync erases it.
+  for (uint32_t bit = 0; bit < cache_.size(); ++bit) {
+    if (cache_[bit].response.tensor_names()[0] == request.tensor_name()) {
+      return bit;
+    }
+  }
+  assert(false && "peek_cache_bit on an uncached request");
+  return 0;
 }
 
-uint32_t ResponseCache::peek_cache_bit(const std::string& tensor_name) const {
-  auto it = name_to_bit_.find(tensor_name);
-  assert(it != name_to_bit_.end());
+uint32_t ResponseCache::peek_cache_bit(const std::string& cache_key) const {
+  auto it = key_to_bit_.find(cache_key);
+  assert(it != key_to_bit_.end());
   return it->second;
+}
+
+void ResponseCache::NonMemberBits(std::vector<uint32_t>* out) const {
+  // O(1) in the common (pure data-parallel) case: no foreign entries,
+  // no scan — this runs every negotiation cycle.
+  if (non_member_entries_ == 0) return;
+  for (uint32_t bit = 0; bit < cache_.size(); ++bit) {
+    if (!cache_[bit].is_member) out->push_back(bit);
+  }
+}
+
+void ResponseCache::DropNameRef(const std::string& name) {
+  auto it = name_refs_.find(name);
+  if (it == name_refs_.end()) return;
+  if (--it->second == 0) name_refs_.erase(it);
 }
 
 void ResponseCache::erase_response(uint32_t cache_bit) {
   if (cache_bit >= cache_.size()) return;
-  const std::string name = cache_[cache_bit].response.tensor_names()[0];
-  name_to_bit_.erase(name);
+  key_to_bit_.erase(cache_[cache_bit].key);
+  DropNameRef(cache_[cache_bit].response.tensor_names()[0]);
+  if (!cache_[cache_bit].is_member) --non_member_entries_;
   lru_.erase(cache_iters_[cache_bit]);
   // Compact: move last entry into the freed slot to keep bits dense.
   uint32_t last = static_cast<uint32_t>(cache_.size()) - 1;
@@ -158,8 +239,7 @@ void ResponseCache::erase_response(uint32_t cache_bit) {
     cache_[cache_bit] = std::move(cache_[last]);
     cache_iters_[cache_bit] = cache_iters_[last];
     *cache_iters_[cache_bit] = cache_bit;
-    const std::string moved = cache_[cache_bit].response.tensor_names()[0];
-    name_to_bit_[moved] = cache_bit;
+    key_to_bit_[cache_[cache_bit].key] = cache_bit;
   }
   cache_.pop_back();
   cache_iters_.pop_back();
@@ -187,9 +267,9 @@ void ResponseCache::update_cache_bits() {
   cache_ = std::move(new_cache);
   lru_ = std::move(new_lru);
   cache_iters_ = std::move(new_iters);
-  name_to_bit_.clear();
+  key_to_bit_.clear();
   for (uint32_t bit = 0; bit < cache_.size(); ++bit) {
-    name_to_bit_[cache_[bit].response.tensor_names()[0]] = bit;
+    key_to_bit_[cache_[bit].key] = bit;
   }
   bits_outdated_ = false;
 }

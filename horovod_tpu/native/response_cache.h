@@ -7,6 +7,18 @@
 // cached entries are globally ready. This is the critical negotiation-latency
 // optimization at large rank counts.
 //
+// Process groups (docs/GROUPS.md): entries are keyed on
+// GroupQualifiedName(group, name), so the same tensor name active in two
+// groups at once occupies two bits, and a tensor renegotiated under a
+// DIFFERENT group id (membership change) reads as INVALID — erase and
+// renegotiate, exactly like a compression-mode change. The bit protocol
+// requires IDENTICAL cache contents on every rank, so ranks outside a
+// response's group still mirror it as a FOREIGN entry (same bit position,
+// no validation params) and treat its bit as vacuously ready each cycle
+// (`NonMemberBits`) — the global AND then spans exactly the group's
+// members, which is what "ready-rank bitmaps sized to the group" means
+// on a bit-vector protocol.
+//
 // Capability parity with /root/reference horovod/common/response_cache.{h,cc}
 // (ResponseCache + CacheCoordinator); fresh implementation.
 #ifndef HVD_TPU_RESPONSE_CACHE_H
@@ -19,6 +31,7 @@
 #include <vector>
 
 #include "common.h"
+#include "group_table.h"
 #include "message.h"
 
 namespace hvdtpu {
@@ -40,18 +53,30 @@ class ResponseCache {
   void clear();
 
   // MISS if never seen; HIT if cached with identical params; INVALID if the
-  // name is cached but shape/dtype/op params changed (entry must be dropped
-  // and renegotiated).
+  // (group, name) key is cached but shape/dtype/op params changed — or the
+  // NAME is cached under a different group id (membership change). Either
+  // way the stale entry must be dropped and renegotiated.
   CacheState cached(const Request& request) const;
 
-  // Inserts (or refreshes) the response after a successful execution.
-  void put(const Response& response, TensorQueue& tensor_queue);
+  // Inserts (or refreshes) the response after a successful execution —
+  // called with the IDENTICAL response list on every rank. Ranks outside
+  // the response's group insert a foreign placeholder entry so bit
+  // positions stay rank-identical; `groups`/`my_rank` decide membership.
+  void put(const Response& response, TensorQueue& tensor_queue,
+           const GroupTable* groups, int my_rank);
 
   // Bit <-> response lookups for the fast path.
   const Response& get_response(uint32_t cache_bit);
   const Response& peek_response(uint32_t cache_bit) const;
   uint32_t peek_cache_bit(const Request& request) const;
-  uint32_t peek_cache_bit(const std::string& tensor_name) const;
+  // Lookup by composite cache key (GroupQualifiedName) — the stall
+  // inspector records cached tensors under this key.
+  uint32_t peek_cache_bit(const std::string& cache_key) const;
+
+  // Bits whose entry belongs to a group THIS rank is not a member of —
+  // recorded as vacuous hits every cycle so the cross-rank AND only
+  // spans actual members.
+  void NonMemberBits(std::vector<uint32_t>* out) const;
 
   void erase_response(uint32_t cache_bit);
   // Re-packs cache bits 0..N-1 in LRU order after evictions/erases so all
@@ -61,26 +86,45 @@ class ResponseCache {
  private:
   struct CacheEntry {
     Response response;
+    std::string key;  // GroupQualifiedName(group_id, name)
     // Params captured from the Request for validity checking.
     DataType dtype;
     std::vector<int64_t> shape;
-    int32_t root_rank;
-    double prescale_factor;
-    double postscale_factor;
+    int32_t root_rank = 0;
+    double prescale_factor = 1.0;
+    double postscale_factor = 1.0;
     // Wire-compression mode is part of the cache key: a hit with a
     // different mode is INVALID (renegotiate), never a silent reuse of
     // a response negotiated under another codec.
     uint8_t compression = 0;
+    // Process-group scope. group_digest guards against a same-id
+    // membership change; is_member gates the vacuous-hit sweep;
+    // foreign entries (mirrored on non-members) carry no validation
+    // params and read INVALID on any local lookup.
+    uint32_t group_id = 0;
+    uint64_t group_digest = 0;
+    bool is_member = true;
+    bool foreign = false;
   };
 
-  void put_entry(const std::string& name, CacheEntry entry);
+  void put_entry(CacheEntry entry);  // keyed by entry.key
+  void DropNameRef(const std::string& name);
 
   uint32_t capacity_ = 1024;
   // LRU list of cache bits; most recent at front. cache_[bit] = entry.
   std::vector<CacheEntry> cache_;
   std::vector<std::list<uint32_t>::iterator> cache_iters_;
   std::list<uint32_t> lru_;
-  std::unordered_map<std::string, uint32_t> name_to_bit_;
+  std::unordered_map<std::string, uint32_t> key_to_bit_;
+  // BARE tensor name -> number of cached entries with it (any group).
+  // Gate for the membership-change INVALID scan in cached(): a plain
+  // miss (e.g. every auto-named tensor, which is fresh each call) must
+  // stay one hash lookup — the O(entries) scan only runs when the name
+  // genuinely exists under some other group.
+  std::unordered_map<std::string, uint32_t> name_refs_;
+  // Entries with is_member == false — gates NonMemberBits' per-cycle
+  // scan off entirely for pure data-parallel jobs.
+  uint32_t non_member_entries_ = 0;
   bool bits_outdated_ = false;
 };
 

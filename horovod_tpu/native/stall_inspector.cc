@@ -10,13 +10,26 @@
 
 namespace hvdtpu {
 
-void StallInspector::RecordUncachedTensorStart(const std::string& tensor_name,
-                                               int rank, int global_size) {
+void StallInspector::RecordUncachedTensorStart(
+    const std::string& tensor_name, int rank, int global_size,
+    const std::vector<int>* members) {
   auto it = uncached_.find(tensor_name);
   if (it == uncached_.end()) {
-    uncached_[tensor_name] = {Clock::now(), {rank}};
+    Uncached u;
+    u.first = Clock::now();
+    u.ready.insert(rank);
+    if (members != nullptr) u.members = *members;
+    uncached_.emplace(tensor_name, std::move(u));
   } else {
-    it->second.second.insert(rank);
+    it->second.ready.insert(rank);
+    // Backfill the group scope: the FIRST announcement can precede this
+    // process's new_group registration (the late-registration race),
+    // arriving with no member list — a later member's announcement
+    // carries it, and without the backfill a stalled group tensor would
+    // list non-members as missing.
+    if (it->second.members.empty() && members != nullptr) {
+      it->second.members = *members;
+    }
   }
   (void)global_size;
 }
@@ -58,8 +71,15 @@ bool StallInspector::CheckForStalledTensors(int global_size) {
     std::ostringstream missing;
     bool first = true;
     int missing_count = 0;
-    for (int r = 0; r < global_size; ++r) {
-      if (kv.second.second.count(r) == 0) {
+    // Group-scoped tensors only wait on their MEMBERS; non-members are
+    // never "missing" (the tensor name itself carries the @g suffix).
+    std::vector<int> expected = kv.second.members;
+    if (expected.empty()) {
+      expected.resize(static_cast<std::size_t>(global_size));
+      for (int r = 0; r < global_size; ++r) expected[r] = r;
+    }
+    for (int r : expected) {
+      if (kv.second.ready.count(r) == 0) {
         if (!first) missing << ", ";
         missing << r;
         first = false;

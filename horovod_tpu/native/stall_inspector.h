@@ -25,9 +25,12 @@ class StallInspector {
   int stall_warning_time_seconds() const { return warning_seconds_; }
   int stall_shutdown_time_seconds() const { return shutdown_seconds_; }
 
-  // Coordinator: a rank announced readiness for this tensor.
+  // Coordinator: a rank announced readiness for this tensor. `members`,
+  // when non-null, scopes the tensor to a process group — only those
+  // ranks are ever reported missing (docs/GROUPS.md).
   void RecordUncachedTensorStart(const std::string& tensor_name, int rank,
-                                 int global_size);
+                                 int global_size,
+                                 const std::vector<int>* members = nullptr);
   // Coordinator: tensor completed negotiation — forget it.
   void RemoveUncachedTensor(const std::string& tensor_name);
 
@@ -50,10 +53,14 @@ class StallInspector {
   using Clock = std::chrono::steady_clock;
   int warning_seconds_ = 60;
   int shutdown_seconds_ = 0;  // 0 = never shut down
-  // name -> (first-request time, set of ready ranks)
-  std::unordered_map<std::string,
-                     std::pair<Clock::time_point, std::unordered_set<int>>>
-      uncached_;
+  // name -> (first-request time, set of ready ranks, expected member
+  // ranks — empty = every rank in 0..global_size)
+  struct Uncached {
+    Clock::time_point first;
+    std::unordered_set<int> ready;
+    std::vector<int> members;
+  };
+  std::unordered_map<std::string, Uncached> uncached_;
   std::unordered_map<std::string, Clock::time_point> cached_;
   Clock::time_point last_check_ = Clock::now();
   // Missing-rank sets already warned about, with repeat counts: identical

@@ -338,10 +338,136 @@ void TcpContext::Finalize() {
   local_prev_.Close();
   cross_next_.Close();
   cross_prev_.Close();
+  for (auto& kv : group_rings_) {
+    kv.second.next.Close();
+    kv.second.prev.Close();
+  }
+  group_rings_.clear();
+  for (auto& kv : pending_group_fds_) ::close(kv.second);
+  pending_group_fds_.clear();
   listener_.Close();
   rank_grid_.clear();
   is_homogeneous_ = false;
   initialized_ = false;
+}
+
+// ---------------- process-group rings (docs/GROUPS.md) ----------------
+
+static uint64_t GroupFdKey(uint32_t gid, int rank) {
+  return (static_cast<uint64_t>(gid) << 32) |
+         static_cast<uint32_t>(rank);
+}
+
+int TcpContext::GroupRank(uint32_t group_id) const {
+  auto it = group_rings_.find(group_id);
+  return it == group_rings_.end() ? -1 : it->second.pos;
+}
+
+int TcpContext::GroupSize(uint32_t group_id) const {
+  auto it = group_rings_.find(group_id);
+  return it == group_rings_.end() ? 0 : it->second.size;
+}
+
+bool TcpContext::EnsureGroupRing(uint32_t group_id,
+                                 const std::vector<int>& members) {
+  if (group_rings_.count(group_id)) return true;
+  int k = static_cast<int>(members.size());
+  int pos = -1;
+  for (int i = 0; i < k; ++i) {
+    if (members[i] == rank_) pos = i;
+  }
+  if (pos < 0) {
+    LOG(ERROR) << "rank " << rank_ << " is not a member of group "
+               << group_id << "; refusing to build its ring";
+    return false;
+  }
+  GroupRing gr;
+  gr.pos = pos;
+  gr.size = k;
+  if (k > 1) {
+    const char* addrs_env = std::getenv("HVD_TPU_ADDRS");
+    std::vector<std::string> addrs =
+        SplitString(addrs_env ? addrs_env : "", ',');
+    int next = members[(pos + 1) % k];
+    int prev = members[(pos - 1 + k) % k];
+    std::string host;
+    int port = 0;
+    if (next >= static_cast<int>(addrs.size()) ||
+        !ParseHostPort(addrs[next], &host, &port)) {
+      LOG(ERROR) << "group " << group_id << ": no address for member rank "
+                 << next;
+      return false;
+    }
+    int timeout_ms = EnvInt("HVD_TPU_START_TIMEOUT", 60) * 1000;
+    // Connect to the ring successor FIRST: the peer's listener backlog
+    // completes the TCP connect even before it accepts, so every member
+    // running connect-then-accept in the same order cannot deadlock.
+    // The handshake carries the group id in the opseq field.
+    gr.next = ConnectPeer(host, port, rank_, Channel::RING, timeout_ms,
+                          generation_, /*opseq=*/group_id,
+                          /*reconnect=*/false, /*group_ring=*/true);
+    if (!gr.next.valid()) {
+      LOG(ERROR) << "group " << group_id << ": connect to member rank "
+                 << next << " failed";
+      return false;
+    }
+    // Accept from the ring predecessor. Group-ring connects for OTHER
+    // groups may arrive first (a member of a later response's group
+    // racing ahead of this op); stash them for that group's own
+    // EnsureGroupRing instead of dropping them.
+    auto stashed = pending_group_fds_.find(GroupFdKey(group_id, prev));
+    if (stashed != pending_group_fds_.end()) {
+      gr.prev = Conn(stashed->second, Channel::RING);
+      pending_group_fds_.erase(stashed);
+    } else {
+      auto deadline = std::chrono::steady_clock::now() +
+                      std::chrono::milliseconds(timeout_ms);
+      while (!gr.prev.valid()) {
+        auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                        deadline - std::chrono::steady_clock::now())
+                        .count();
+        if (left <= 0) {
+          LOG(ERROR) << "group " << group_id
+                     << ": timed out waiting for member rank " << prev;
+          return false;
+        }
+        PeerHandshake hs;
+        int fd = listener_.AcceptPeer(&hs, static_cast<int>(left),
+                                      generation_);
+        if (fd < 0) {
+          LOG(ERROR) << "group " << group_id
+                     << ": accept failed waiting for member rank " << prev;
+          return false;
+        }
+        if (!(hs.flags & kHandshakeGroupRing)) {
+          // Not a group-ring connect (e.g. a control reconnect racing a
+          // group build). Dropping it is safe: reconnects retry with
+          // backoff until their window expires.
+          LOG(WARNING) << "unexpected non-group connection from rank "
+                       << hs.rank << " during group ring build; dropping";
+          ::close(fd);
+          continue;
+        }
+        uint32_t peer_gid = static_cast<uint32_t>(hs.opseq);
+        if (peer_gid == group_id && hs.rank == prev) {
+          gr.prev = Conn(fd, Channel::RING);
+        } else {
+          auto key = GroupFdKey(peer_gid, hs.rank);
+          auto old = pending_group_fds_.find(key);
+          if (old != pending_group_fds_.end()) {
+            ::close(old->second);
+            old->second = fd;
+          } else {
+            pending_group_fds_.emplace(key, fd);
+          }
+        }
+      }
+    }
+  }
+  LOG(DEBUG) << "group " << group_id << " ring built: position " << pos
+             << "/" << k;
+  group_rings_.emplace(group_id, std::move(gr));
+  return true;
 }
 
 // ---------------- worker-side control star with reconnect ----------------
@@ -505,6 +631,22 @@ int TcpContext::TryAcceptControlReconnect(const std::vector<bool>& dead) {
   // bounded by the handshake read (silent clients get dropped inside).
   int fd = listener_.AcceptPeer(&hs, 100, generation_);
   if (fd < 0) return 0;
+  // A group member's ring connect (docs/GROUPS.md) can land while a
+  // control-reconnect window has this thread polling the listener —
+  // the connector is one-shot (no verdict wait), so closing it would
+  // wedge that group's ring build until its timeout. Stash it for the
+  // group's own EnsureGroupRing, exactly like the build-time race.
+  if (hs.flags & kHandshakeGroupRing) {
+    auto key = GroupFdKey(static_cast<uint32_t>(hs.opseq), hs.rank);
+    auto old = pending_group_fds_.find(key);
+    if (old != pending_group_fds_.end()) {
+      ::close(old->second);
+      old->second = fd;
+    } else {
+      pending_group_fds_.emplace(key, fd);
+    }
+    return 0;
+  }
   char verdict = 0;
   if (hs.channel != Channel::CONTROL || !(hs.flags & kHandshakeReconnect) ||
       hs.rank < 1 || hs.rank >= size_ ||
@@ -978,7 +1120,30 @@ bool TcpContext::RingExchangeOn(Ring ring, const void* send_buf,
     prev = &cross_prev_;
     chan = Channel::CROSS_RING;
   }
-  if (RingSize(ring) == 1) {
+  return PairExchange(next, prev, chan, RingSize(ring), send_buf, send_len,
+                      recv_buf, recv_len);
+}
+
+bool TcpContext::GroupExchange(uint32_t group_id, const void* send_buf,
+                               std::size_t send_len, void* recv_buf,
+                               std::size_t recv_len) {
+  auto it = group_rings_.find(group_id);
+  if (it == group_rings_.end()) {
+    LOG(ERROR) << "group " << group_id
+               << " ring not built (EnsureGroupRing must run first)";
+    last_error_ = "group ring missing on ring channel";
+    return false;
+  }
+  return PairExchange(&it->second.next, &it->second.prev, Channel::RING,
+                      it->second.size, send_buf, send_len, recv_buf,
+                      recv_len);
+}
+
+bool TcpContext::PairExchange(Conn* next, Conn* prev, Channel chan,
+                              int ring_size, const void* send_buf,
+                              std::size_t send_len, void* recv_buf,
+                              std::size_t recv_len) {
+  if (ring_size == 1) {
     if (recv_len > 0 && recv_buf != send_buf) {
       std::memcpy(recv_buf, send_buf, std::min(send_len, recv_len));
     }
@@ -1162,12 +1327,32 @@ bool TcpContext::RingExchangeOn(Ring ring, const void* send_buf,
 }
 
 bool TcpContext::RingBroadcast(void* buf, std::size_t len, int root) {
-  if (size_ == 1 || len == 0) return true;
-  int next = (rank_ + 1) % size_;
+  return PairBroadcast(&ring_next_, &ring_prev_, rank_, size_, buf, len,
+                       root);
+}
+
+bool TcpContext::GroupBroadcast(uint32_t group_id, void* buf,
+                                std::size_t len, int root_pos) {
+  auto it = group_rings_.find(group_id);
+  if (it == group_rings_.end()) {
+    LOG(ERROR) << "group " << group_id
+               << " ring not built (EnsureGroupRing must run first)";
+    last_error_ = "group ring missing on ring channel";
+    return false;
+  }
+  return PairBroadcast(&it->second.next, &it->second.prev, it->second.pos,
+                       it->second.size, buf, len, root_pos);
+}
+
+bool TcpContext::PairBroadcast(Conn* next_conn, Conn* prev_conn, int pos,
+                               int n, void* buf, std::size_t len,
+                               int root_pos) {
+  if (n == 1 || len == 0) return true;
+  int next = (pos + 1) % n;
   char* p = static_cast<char*>(buf);
   uint64_t len64 = len;
-  if (rank_ == root) {
-    // Root only streams downstream (size_ > 1 so next != root). One
+  if (pos == root_pos) {
+    // Root only streams downstream (n > 1 so next != root). One
     // frame header up front carries the CRC every hop verifies.
     uint32_t crc = FrameCrc(kTagRing, len64, p, len);
     FaultInjector& inj = GlobalFaultInjector();
@@ -1177,16 +1362,16 @@ bool TcpContext::RingBroadcast(void* buf, std::size_t len, int root) {
         std::this_thread::sleep_for(std::chrono::milliseconds(d.delay_ms));
       } else if (d.action == FaultAction::CLOSE ||
                  d.action == FaultAction::DROP) {
-        ring_next_.Close();
+        next_conn->Close();
       } else if (d.action == FaultAction::CORRUPT) {
         crc ^= 0x1;
       }
     }
     char hdr[kFrameHeaderBytes];
     BuildFrameHeader(hdr, kTagRing, len64, crc);
-    if (!ring_next_.SendAll(hdr, sizeof(hdr)) ||
-        !ring_next_.SendAll(p, len)) {
-      SetLastError(Channel::RING, ring_next_.last_error());
+    if (!next_conn->SendAll(hdr, sizeof(hdr)) ||
+        !next_conn->SendAll(p, len)) {
+      SetLastError(Channel::RING, next_conn->last_error());
       return false;
     }
     GlobalMetrics().net_ring_bytes_sent_total.fetch_add(
@@ -1202,8 +1387,8 @@ bool TcpContext::RingBroadcast(void* buf, std::size_t len, int root) {
   // the same mismatch, so corruption surfaces as a detected error
   // everywhere, never as silently wrong data.
   char rhdr[kFrameHeaderBytes];
-  if (!ring_prev_.RecvAll(rhdr, sizeof(rhdr))) {
-    SetLastError(Channel::RING, ring_prev_.last_error());
+  if (!prev_conn->RecvAll(rhdr, sizeof(rhdr))) {
+    SetLastError(Channel::RING, prev_conn->last_error());
     return false;
   }
   uint32_t rtag;
@@ -1216,33 +1401,33 @@ bool TcpContext::RingBroadcast(void* buf, std::size_t len, int root) {
     SetLastError(Channel::RING, NetError::PROTOCOL);
     return false;
   }
-  bool forward = next != root;
-  if (forward && !ring_next_.SendAll(rhdr, sizeof(rhdr))) {
-    SetLastError(Channel::RING, ring_next_.last_error());
+  bool forward = next != root_pos;
+  if (forward && !next_conn->SendAll(rhdr, sizeof(rhdr))) {
+    SetLastError(Channel::RING, next_conn->last_error());
     return false;
   }
   uint32_t crc_acc = NetCrcEnabled() ? FrameHeaderCrc(rtag, rlen) : 0;
   std::size_t received = 0, sent = 0;
   while (received < len || (forward && sent < len)) {
     struct pollfd pfds[2];
-    int n = 0;
+    int nfds = 0;
     int recv_idx = -1, send_idx = -1;
     if (received < len) {
-      pfds[n] = {ring_prev_.fd(), POLLIN, 0};
-      recv_idx = n++;
+      pfds[nfds] = {prev_conn->fd(), POLLIN, 0};
+      recv_idx = nfds++;
     }
     if (forward && sent < received) {
-      pfds[n] = {ring_next_.fd(), POLLOUT, 0};
-      send_idx = n++;
+      pfds[nfds] = {next_conn->fd(), POLLOUT, 0};
+      send_idx = nfds++;
     }
-    if (n == 0) break;
-    if (::poll(pfds, n, ControlPollMs()) <= 0) {
+    if (nfds == 0) break;
+    if (::poll(pfds, nfds, ControlPollMs()) <= 0) {
       LOG(ERROR) << "ring broadcast poll timeout/error";
       SetLastError(Channel::RING, NetError::TIMEOUT);
       return false;
     }
     if (recv_idx >= 0 && (pfds[recv_idx].revents & (POLLIN | POLLERR))) {
-      ssize_t r = ::recv(ring_prev_.fd(), p + received, len - received,
+      ssize_t r = ::recv(prev_conn->fd(), p + received, len - received,
                          MSG_DONTWAIT);
       if (r == 0) {
         SetLastError(Channel::RING, NetError::CLOSED);
@@ -1261,7 +1446,7 @@ bool TcpContext::RingBroadcast(void* buf, std::size_t len, int root) {
       }
     }
     if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR))) {
-      ssize_t w = ::send(ring_next_.fd(), p + sent, received - sent,
+      ssize_t w = ::send(next_conn->fd(), p + sent, received - sent,
                          MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
         SetLastError(Channel::RING, NetError::CLOSED);

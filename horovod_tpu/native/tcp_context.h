@@ -26,6 +26,7 @@
 #include <cstdint>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 #include "net.h"
@@ -101,6 +102,41 @@ class TcpContext {
   // Root passes its source in `buf` too.
   bool RingBroadcast(void* buf, std::size_t len, int root);
 
+  // --- process-group rings (docs/GROUPS.md) ---
+  // A group collective rides a dedicated ring over the GROUP's member
+  // subset — hops shrink from world-1 to group-1 and disjoint groups'
+  // rings run concurrently. Connections are built lazily by the
+  // background thread at a group op's first execution: every member
+  // connects to its ring successor (the TCP backlog completes the
+  // connect even before the peer accepts), then accepts from its
+  // predecessor — connect-before-accept on every member, so the pairing
+  // cannot deadlock. Accepted connects for OTHER groups (a member of a
+  // later response's group racing ahead) are stashed and consumed by
+  // that group's own EnsureGroupRing. Background thread only.
+  bool EnsureGroupRing(uint32_t group_id, const std::vector<int>& members);
+  // This rank's ring position / member count for a BUILT group ring
+  // (-1 / 0 when EnsureGroupRing has not run for the id).
+  int GroupRank(uint32_t group_id) const;
+  int GroupSize(uint32_t group_id) const;
+  // Neighbor exchange / rooted broadcast on the group's ring (root_pos
+  // is the GROUP-ring position, not the world rank). CRC framing, the
+  // fault injector, deadlines, and the bandwidth throttle apply exactly
+  // as on the global ring (Channel::RING).
+  bool GroupExchange(uint32_t group_id, const void* send_buf,
+                     std::size_t send_len, void* recv_buf,
+                     std::size_t recv_len);
+  bool GroupBroadcast(uint32_t group_id, void* buf, std::size_t len,
+                      int root_pos);
+  // Dispatch helper for the ring ops: group == 0 -> the enum ring.
+  bool ExchangeOn(Ring ring, uint32_t group, const void* send_buf,
+                  std::size_t send_len, void* recv_buf,
+                  std::size_t recv_len) {
+    return group == 0 ? RingExchangeOn(ring, send_buf, send_len, recv_buf,
+                                       recv_len)
+                      : GroupExchange(group, send_buf, send_len, recv_buf,
+                                      recv_len);
+  }
+
   // --- control-plane protocol accounting ---
   // Bytes/messages THIS rank moved on the control star (16-byte frame
   // headers included; data-ring traffic is not counted — these isolate
@@ -121,6 +157,16 @@ class TcpContext {
  private:
   bool ExchangeTopology();
   bool ConnectSubRings(int timeout_ms);
+  // Shared duplex-pump body for all neighbor exchanges (enum rings and
+  // group rings): header swap, CRC-verified full-duplex payload pump,
+  // fault hooks, TX pacing, socket-layer byte accounting.
+  bool PairExchange(Conn* next, Conn* prev, Channel chan, int ring_size,
+                    const void* send_buf, std::size_t send_len,
+                    void* recv_buf, std::size_t recv_len);
+  // Shared cut-through broadcast body (global ring and group rings):
+  // `pos`/`n`/`root_pos` are ring positions on the given conn pair.
+  bool PairBroadcast(Conn* next, Conn* prev, int pos, int n, void* buf,
+                     std::size_t len, int root_pos);
   // Rank 0: receive one frame from every worker concurrently.
   bool MultiRecvFrames(uint32_t expect_tag, std::vector<std::string>* blobs);
   // Rank 0: send per-worker payloads concurrently (all pairs may alias).
@@ -197,6 +243,19 @@ class TcpContext {
   Conn local_prev_;
   Conn cross_next_;       // successor within my local_rank's cross ring
   Conn cross_prev_;
+
+  // Lazily-built per-group rings (background thread only; see
+  // EnsureGroupRing). pending_group_fds_ stashes accepted group-ring
+  // connects that belong to a group whose ring this rank has not built
+  // yet, keyed (group_id << 32) | peer_rank.
+  struct GroupRing {
+    Conn next;
+    Conn prev;
+    int pos = 0;
+    int size = 1;
+  };
+  std::unordered_map<uint32_t, GroupRing> group_rings_;
+  std::unordered_map<uint64_t, int> pending_group_fds_;
 };
 
 }  // namespace hvdtpu

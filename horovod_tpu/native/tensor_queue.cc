@@ -31,6 +31,11 @@ void TensorQueue::GetTensorEntriesFromResponse(
   for (const auto& name : response.tensor_names()) {
     auto it = tensor_table_.find(name);
     if (it == tensor_table_.end()) continue;
+    // Group scoping: a response only claims entries of ITS group — a
+    // rank holding "grad.0" pending in group 2 must not execute it
+    // against group 1's response for the same name (the 2-D mesh's
+    // per-column gradient reduce is exactly this shape).
+    if (it->second.group_id != response.group_id()) continue;
     entries.push_back(std::move(it->second));
     tensor_table_.erase(it);
   }

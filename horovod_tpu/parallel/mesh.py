@@ -63,6 +63,39 @@ def hybrid_mesh(axis_shape, axis_names, backend=None, devices=None):
     return Mesh(arr, tuple(axis_names))
 
 
+def mesh_2d(model_parallel, axis_names=("batch", "model"), backend=None,
+            devices=None):
+    """(batch, model) 2-D mesh matching ``hvd.init(model_parallel=k)``
+    (docs/GROUPS.md) — the SNIPPETS NamedSharding pattern for the in-jit
+    plane.
+
+    Shape is ``(ndev // k, k)`` with the MODEL axis trailing, so model
+    groups are k consecutive devices (ICI neighbors on a real slice,
+    matching the host plane's consecutive-rank model groups) and batch
+    rows stride across them. Shard parameters with
+    ``NamedSharding(mesh, P(None, "model"))``-style specs
+    (``tensor_parallel.tp_param_specs``), psum activations over the
+    ``model`` axis and gradients over the ``batch`` axis only.
+    """
+    devs = list(devices) if devices is not None else _devices(backend)
+    k = int(model_parallel)
+    if k <= 0 or len(devs) % k != 0:
+        raise ValueError(
+            "model_parallel=%d does not divide %d devices" % (k, len(devs)))
+    return hybrid_mesh((len(devs) // k, k), tuple(axis_names),
+                       devices=devs)
+
+
+def hvd_mesh_2d(axis_names=("batch", "model"), backend=None, devices=None):
+    """The jax-side mesh for THIS process's hvd mesh state: a 2-D mesh
+    with the model-parallel width ``hvd.init(model_parallel=k)``
+    established (1-D data-parallel mesh collapses out when k == 1 —
+    the batch axis then spans every device)."""
+    import horovod_tpu as hvd
+    return mesh_2d(hvd.model_parallel_size(), axis_names=axis_names,
+                   backend=backend, devices=devices)
+
+
 def mesh_axis_size(mesh, axis_name):
     return mesh.shape[axis_name]
 

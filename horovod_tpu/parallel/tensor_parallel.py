@@ -52,6 +52,66 @@ def is_tp_sharded(path):
     return any(name in names for name in _TP_DIMS)
 
 
+# ---------------------------------------------------------------------------
+# Host-plane Megatron f/g operators (docs/GROUPS.md): the cross-PROCESS
+# analogue of the in-jit psum pair, riding the model-axis process group
+# of hvd.init(model_parallel=k). With layers column-parallel then
+# row-parallel:
+#   y = g( x_colparallel @ W2_shard )  — g: allreduce fwd, identity bwd
+#   x = f( input )                     — f: identity fwd, allreduce bwd
+# Both are jax.custom_vjp wrappers over hvd.jax.allreduce(group=...),
+# so autodiff never descends into the host collective, and the
+# forward/backward collective ORDER is identical on every member
+# (ordered io_callbacks when traced; eager host ops otherwise).
+# ---------------------------------------------------------------------------
+
+
+def copy_to_model_parallel(x, group, name=None):
+    """Megatron's f operator: identity forward, model-group allreduce
+    backward. Place at the INPUT of a column-parallel layer — each
+    shard's input gradient is partial (its slice of the output), and
+    the backward allreduce completes it."""
+    import horovod_tpu.jax as hvd_jax
+
+    @jax.custom_vjp
+    def _f(v):
+        return v
+
+    def _fwd(v):
+        return v, None
+
+    def _bwd(_, dv):
+        return (hvd_jax.allreduce(dv, average=False, group=group,
+                                  name=name and name + ".bwd"),)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(x)
+
+
+def reduce_from_model_parallel(x, group, name=None):
+    """Megatron's g operator: model-group allreduce forward, identity
+    backward. Place at the OUTPUT of a row-parallel layer — each shard
+    holds a partial product; the forward allreduce completes the
+    activation, and since out = sum(partials), d partial = d out."""
+    import horovod_tpu.jax as hvd_jax
+
+    def _sum(v):
+        return hvd_jax.allreduce(v, average=False, group=group, name=name)
+
+    @jax.custom_vjp
+    def _g(v):
+        return _sum(v)
+
+    def _fwd(v):
+        return _sum(v), None
+
+    def _bwd(_, dv):
+        return (dv,)
+
+    _g.defvjp(_fwd, _bwd)
+    return _g(x)
+
+
 def tp_grad_sync(grads, tp_axis="tp", dp_axis=None):
     """Synchronizes a raw per-shard gradient tree inside shard_map
     under tensor parallelism.

@@ -67,6 +67,23 @@ def _tun_state(cur, prev, dt, ctx):
     return "%s/%d" % (state, rearms) if rearms else state
 
 
+def _grp_state(cur, prev, dt, ctx):
+    """Process groups (docs/GROUPS.md): registered groups on the worker,
+    suffixed with the group-scoped tensor throughput when any flows
+    (e.g. '3/12.0' = 3 groups, 12 group tensors/s). '0' = no groups
+    (pure data-parallel); '-' = the worker's summary predates the group
+    fields (mixed-version elastic job)."""
+    if "groups" not in cur:
+        return "-"
+    g = int(cur.get("groups", 0))
+    rate = _rate(cur, prev, "group_tensors_total", dt)
+    if g <= 0:
+        return "0"
+    if rate is None or rate <= 0:
+        return "%d" % g
+    return "%d/%s" % (g, _fmt_rate(rate))
+
+
 def _cmp_ratio(cur, prev, dt, ctx):
     """Live wire-compression factor (docs/COMPRESSION.md): f32 bytes
     into the codec / bytes put on the wire. '-' when the worker
@@ -123,6 +140,9 @@ _COLUMNS = [
     # Closed-loop autotune posture: tun(actively sampling) / cvg
     # (converged), '/N' = re-armed N times (docs/AUTOTUNE.md).
     ("tun", 6, _tun_state),
+    # Process groups: registered groups (+ group-tensor rate when the
+    # mesh is actually moving traffic) — docs/GROUPS.md.
+    ("grp", 8, _grp_state),
     ("lag_s", 9, lambda cur, prev, dt, ctx: "%.2f" % ctx["lag_total"]),
 ]
 

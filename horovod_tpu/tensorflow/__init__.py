@@ -61,11 +61,19 @@ def _py_collective(fn, tensor, name, out_shape=None):
 
 def allreduce(tensor, average=True, name=None, compression=Compression.none,
               sparse_as_dense=False, prescale_factor=1.0,
-              postscale_factor=1.0):
+              postscale_factor=1.0, group=None):
     """Allreduce; IndexedSlices take the sparse allgather path (reference:
-    tensorflow/__init__.py:65-76)."""
+    tensorflow/__init__.py:65-76). ``group`` scopes a DENSE allreduce to
+    a process group (docs/GROUPS.md); it rides the Python ops layer (the
+    compiled kernel path predates groups)."""
     if isinstance(tensor, tf.IndexedSlices):
-        if sparse_as_dense:
+        if group is not None and sparse_as_dense:
+            tensor = tf.convert_to_tensor(tensor)
+        elif group is not None:
+            raise ValueError(
+                "group-scoped allreduce needs a dense tensor; pass "
+                "sparse_as_dense=True for IndexedSlices")
+        elif sparse_as_dense:
             tensor = tf.convert_to_tensor(tensor)
         else:
             op_name = name or _auto_name("ar_sparse")
@@ -78,16 +86,18 @@ def allreduce(tensor, average=True, name=None, compression=Compression.none,
                                     dense_shape=tensor.dense_shape)
     op_name = name or _auto_name("allreduce")
     compressed, ctx = compression.compress(tensor)
-    if _mpi_ops.native_ops_available():
+    if _mpi_ops.native_ops_available() and group is None:
         out = _mpi_ops.allreduce(
             tf.convert_to_tensor(compressed), op_name, average=average,
             prescale=prescale_factor, postscale=postscale_factor)
         return compression.decompress(out, ctx)
-    post = postscale_factor / size() if average else postscale_factor
+    from horovod_tpu import groups as _grp
+    post = (postscale_factor / _grp.group_size(group) if average
+            else postscale_factor)
 
     def _do(arr):
         return _ops.allreduce(arr, op_name, prescale_factor=prescale_factor,
-                              postscale_factor=post)
+                              postscale_factor=post, group=group)
 
     out = _py_collective(_do, compressed, op_name.replace(".", "_"))
     return compression.decompress(out, ctx)
@@ -244,6 +254,11 @@ def _make_sharded_keras(optimizer, average, compression):
                     "sharded_update runs the host data plane eagerly; "
                     "call apply_gradients outside tf.function (or use "
                     "the jax binding for in-XLA sharded updates)")
+            # Re-checked per apply: a mesh formed AFTER construction
+            # must fail here, not reduce-scatter across model shards.
+            from horovod_tpu.groups import \
+                assert_sharded_update_world_scope
+            assert_sharded_update_world_scope()
             gvs = list(grads_and_vars)
             variables = [v for _, v in gvs]
             if not hasattr(self, "_hvd_shard_var"):
@@ -311,7 +326,8 @@ def _make_sharded_keras(optimizer, average, compression):
 
 def DistributedOptimizer(optimizer, average=True,
                          compression=Compression.none,
-                         sparse_as_dense=False, sharded_update=None):
+                         sparse_as_dense=False, sharded_update=None,
+                         group=None):
     """Wraps an optimizer so gradients are averaged across ranks before
     being applied (reference: tensorflow/__init__.py:231-319).
 
@@ -325,7 +341,10 @@ def DistributedOptimizer(optimizer, average=True,
     switches Keras-3 optimizers to the ZeRO-style sharded weight update
     (docs/ZERO.md): reduce-scatter gradients, shard-local update (slot
     memory drops N-fold), allgather updated params. Eager-only; not
-    supported for v1 optimizers."""
+    supported for v1 optimizers.
+
+    ``group`` scopes the gradient averaging (docs/GROUPS.md); defaults
+    to this rank's batch group under ``hvd.init(model_parallel=k)``."""
     if sharded_update is None:
         sharded_update = _ops.sharded_update_default()
     if isinstance(optimizer, tf.compat.v1.train.Optimizer):
@@ -333,8 +352,10 @@ def DistributedOptimizer(optimizer, average=True,
             raise ValueError("sharded_update is not supported for "
                              "tf.compat.v1 optimizers")
         return _DistributedV1Optimizer(optimizer, average, compression,
-                                       sparse_as_dense)
+                                       sparse_as_dense, group=group)
     if sharded_update:
+        from horovod_tpu.groups import assert_sharded_update_world_scope
+        assert_sharded_update_world_scope(group)
         return _make_sharded_keras(optimizer, average, compression)
 
     base = optimizer.__class__
@@ -343,6 +364,10 @@ def DistributedOptimizer(optimizer, average=True,
         _HVD_WRAPPED = True
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            # group=None resolves to the CURRENT batch group per apply
+            # (construction-time capture goes stale across elastic
+            # re-inits — the mesh re-forms with fresh group ids).
+            grp = group if group is not None else _hvd.batch_group()
             grads_and_vars = list(grads_and_vars)
             reduced = []
             for i, (g, v) in enumerate(grads_and_vars):
@@ -350,7 +375,8 @@ def DistributedOptimizer(optimizer, average=True,
                     g = allreduce(g, average=average,
                                   name="opt_grad.%d" % i,
                                   compression=compression,
-                                  sparse_as_dense=sparse_as_dense)
+                                  sparse_as_dense=sparse_as_dense,
+                                  group=grp)
                 reduced.append((g, v))
             return super().apply_gradients(reduced, *args, **kwargs)
 
@@ -364,11 +390,13 @@ class _DistributedV1Optimizer(tf.compat.v1.train.Optimizer):
     allreduces each gradient (graph ops), everything else delegates —
     the reference's v1 DistributedOptimizer shape."""
 
-    def __init__(self, optimizer, average, compression, sparse_as_dense):
+    def __init__(self, optimizer, average, compression, sparse_as_dense,
+                 group=None):
         self._opt = optimizer
         self._hvd_average = average
         self._hvd_compression = compression
         self._hvd_sparse_as_dense = sparse_as_dense
+        self._hvd_group = group
         # Collective names are the cross-rank rendezvous keys: scope
         # them per wrapper instance (two wrapped optimizers in one
         # graph must not collide) and per VARIABLE, not per position
@@ -386,7 +414,10 @@ class _DistributedV1Optimizer(tf.compat.v1.train.Optimizer):
                               name="%s.grad.%s" % (self._hvd_scope,
                                                    v.name.replace(":", "_")),
                               compression=self._hvd_compression,
-                              sparse_as_dense=self._hvd_sparse_as_dense)
+                              sparse_as_dense=self._hvd_sparse_as_dense,
+                              group=self._hvd_group
+                              if self._hvd_group is not None
+                              else _hvd.batch_group())
             out.append((g, v))
         return out
 

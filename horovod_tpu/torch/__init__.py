@@ -117,10 +117,12 @@ def _start_cext(tensor, dest, enqueue):
     return handle
 
 
-def _start_allreduce(tensor, dest, name, prescale, post):
+def _start_allreduce(tensor, dest, name, prescale, post, group=None):
     """dest=None: allocate a result tensor; dest=tensor: in place."""
     ext = _cext_mod()
-    if ext is not None and _cext_eligible(tensor):
+    # The C extension predates process groups; group-scoped calls ride
+    # the Python ops layer (same core, one extra numpy view).
+    if ext is not None and _cext_eligible(tensor) and group is None:
         return _start_cext(
             tensor, dest,
             lambda dp, op, sh, dt: ext.enqueue_allreduce(
@@ -131,25 +133,30 @@ def _start_allreduce(tensor, dest, name, prescale, post):
         out_view = view if result is tensor else _numpy_view(result)
         handle = _ops.allreduce_async(view, name,
                                       prescale_factor=prescale,
-                                      postscale_factor=post, out=out_view)
+                                      postscale_factor=post, out=out_view,
+                                      group=group)
         _torch_handles[handle] = (tensor, result, True)
         return handle
     handle = _ops.allreduce_async(_to_numpy(tensor), name,
                                   prescale_factor=prescale,
-                                  postscale_factor=post)
+                                  postscale_factor=post, group=group)
     _torch_handles[handle] = (tensor, dest, False)
     return handle
 
 
 def allreduce_async(tensor, average=True, name=None,
-                    prescale_factor=1.0, postscale_factor=1.0):
-    post = postscale_factor / size() if average else postscale_factor
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    group=None):
+    from horovod_tpu import groups as _grp
+    post = (postscale_factor / _grp.group_size(group) if average
+            else postscale_factor)
     return _start_allreduce(tensor, None, name or _auto_name("allreduce"),
-                            prescale_factor, post)
+                            prescale_factor, post, group)
 
 
 def allreduce_async_(tensor, average=True, name=None,
-                     prescale_factor=1.0, postscale_factor=1.0):
+                     prescale_factor=1.0, postscale_factor=1.0,
+                     group=None):
     """In-place variant: the result lands back in `tensor` — zero-copy
     (the core reduces straight into the tensor's storage) when the
     tensor is contiguous CPU.
@@ -159,13 +166,15 @@ def allreduce_async_(tensor, average=True, name=None,
     UNDEFINED — fault-tolerant callers must re-broadcast state after
     catching HorovodInternalError, exactly as with the reference's
     in-place ops."""
-    post = postscale_factor / size() if average else postscale_factor
+    from horovod_tpu import groups as _grp
+    post = (postscale_factor / _grp.group_size(group) if average
+            else postscale_factor)
     return _start_allreduce(tensor, tensor,
                             name or _auto_name("allreduce"),
-                            prescale_factor, post)
+                            prescale_factor, post, group)
 
 
-def allgather_async(tensor, name=None):
+def allgather_async(tensor, name=None, group=None):
     """The gathered result returned by :func:`synchronize` is a
     zero-copy view over the core-owned gather buffer (released when the
     result tensor is garbage-collected). Callers retaining many results
@@ -175,14 +184,16 @@ def allgather_async(tensor, name=None):
     view = _numpy_view(tensor)
     handle = _ops.allgather_async(
         view if view is not None else _to_numpy(tensor),
-        name or _auto_name("allgather"))
+        name or _auto_name("allgather"), group=group)
     _torch_handles[handle] = (tensor, None, False)
     return handle
 
 
-def _start_broadcast(tensor, dest, root_rank, name):
+def _start_broadcast(tensor, dest, root_rank, name, group=None):
     ext = _cext_mod()
-    if ext is not None and _cext_eligible(tensor):
+    # Group-scoped calls ride the Python ops layer (the C extension
+    # predates groups), like _start_allreduce.
+    if ext is not None and _cext_eligible(tensor) and group is None:
         return _start_cext(
             tensor, dest,
             lambda dp, op, sh, dt: ext.enqueue_broadcast(
@@ -191,23 +202,25 @@ def _start_broadcast(tensor, dest, root_rank, name):
     if view is not None:
         result = tensor if dest is tensor else torch.empty_like(tensor)
         out_view = view if result is tensor else _numpy_view(result)
-        handle = _ops.broadcast_async(view, root_rank, name, out=out_view)
+        handle = _ops.broadcast_async(view, root_rank, name, out=out_view,
+                                      group=group)
         _torch_handles[handle] = (tensor, result, True)
         return handle
-    handle = _ops.broadcast_async(_to_numpy(tensor), root_rank, name)
+    handle = _ops.broadcast_async(_to_numpy(tensor), root_rank, name,
+                                  group=group)
     _torch_handles[handle] = (tensor, dest, False)
     return handle
 
 
-def broadcast_async(tensor, root_rank, name=None):
+def broadcast_async(tensor, root_rank, name=None, group=None):
     return _start_broadcast(tensor, None, root_rank,
-                            name or _auto_name("broadcast"))
+                            name or _auto_name("broadcast"), group)
 
 
-def broadcast_async_(tensor, root_rank, name=None):
+def broadcast_async_(tensor, root_rank, name=None, group=None):
     """In-place variant — zero-copy for contiguous CPU tensors."""
     return _start_broadcast(tensor, tensor, root_rank,
-                            name or _auto_name("broadcast"))
+                            name or _auto_name("broadcast"), group)
 
 
 def poll(handle):
@@ -255,28 +268,31 @@ def synchronize(handle):
 
 class _AllreduceFunction(torch.autograd.Function):
     @staticmethod
-    def forward(ctx, tensor, average, name, prescale, postscale):
+    def forward(ctx, tensor, average, name, prescale, postscale, group):
         ctx.average, ctx.name = average, name
         ctx.prescale, ctx.postscale = prescale, postscale
+        ctx.group = group
         return synchronize(
-            allreduce_async(tensor, average, name, prescale, postscale))
+            allreduce_async(tensor, average, name, prescale, postscale,
+                            group=group))
 
     @staticmethod
     def backward(ctx, grad):
         # The gradient of an allreduce is the allreduce of the gradient
-        # with the same scaling.
+        # with the same scaling (over the same group).
         reduced = _AllreduceFunction.apply(
             grad, ctx.average, ctx.name and ctx.name + ".grad",
-            ctx.prescale, ctx.postscale)
-        return reduced, None, None, None, None
+            ctx.prescale, ctx.postscale, ctx.group)
+        return reduced, None, None, None, None, None
 
 
 class _AllgatherFunction(torch.autograd.Function):
     @staticmethod
-    def forward(ctx, tensor, name):
+    def forward(ctx, tensor, name, group):
         ctx.dim0 = tensor.shape[0]
         ctx.name = name or _auto_name("allgather")
-        return synchronize(allgather_async(tensor, ctx.name))
+        ctx.group = group
+        return synchronize(allgather_async(tensor, ctx.name, group=group))
 
     @staticmethod
     def backward(ctx, grad):
@@ -286,21 +302,25 @@ class _AllgatherFunction(torch.autograd.Function):
         # rank's loss. Then slice out this rank's segment; the segment
         # table comes from an allgather of first dims so unequal gathers
         # differentiate correctly.
+        from horovod_tpu import groups as _grp
         grad_sum = synchronize(allreduce_async(
-            grad.contiguous(), average=False, name=ctx.name + ".grad"))
+            grad.contiguous(), average=False, name=ctx.name + ".grad",
+            group=ctx.group))
         sizes = synchronize(allgather_async(
             torch.tensor([ctx.dim0], dtype=torch.int64),
-            name=ctx.name + ".grad_sizes"))
-        offset = int(sizes[:rank()].sum())
-        return grad_sum[offset:offset + ctx.dim0], None
+            name=ctx.name + ".grad_sizes", group=ctx.group))
+        offset = int(sizes[:_grp.group_rank(ctx.group)].sum())
+        return grad_sum[offset:offset + ctx.dim0], None, None
 
 
 class _BroadcastFunction(torch.autograd.Function):
     @staticmethod
-    def forward(ctx, tensor, root_rank, name):
+    def forward(ctx, tensor, root_rank, name, group):
         ctx.root_rank = root_rank
         ctx.name = name or _auto_name("broadcast")
-        return synchronize(broadcast_async(tensor, root_rank, ctx.name))
+        ctx.group = group
+        return synchronize(broadcast_async(tensor, root_rank, ctx.name,
+                                           group=group))
 
     @staticmethod
     def backward(ctx, grad):
@@ -308,38 +328,42 @@ class _BroadcastFunction(torch.autograd.Function):
         # torch/mpi_ops.py:336 uses average=False the same way);
         # non-root inputs are unused.
         reduced = synchronize(allreduce_async(
-            grad.contiguous(), average=False, name=ctx.name + ".grad"))
+            grad.contiguous(), average=False, name=ctx.name + ".grad",
+            group=ctx.group))
         if rank() != ctx.root_rank:
             reduced = torch.zeros_like(reduced)
-        return reduced, None, None
+        return reduced, None, None, None
 
 
 # -- sync wrappers ---------------------------------------------------------
 
 def allreduce(tensor, average=True, name=None, compression=Compression.none,
-              prescale_factor=1.0, postscale_factor=1.0):
+              prescale_factor=1.0, postscale_factor=1.0, group=None):
     compressed, ctx = compression.compress(tensor)
     reduced = _AllreduceFunction.apply(compressed, average, name,
-                                       prescale_factor, postscale_factor)
+                                       prescale_factor, postscale_factor,
+                                       group)
     return compression.decompress(reduced, ctx)
 
 
 def allreduce_(tensor, average=True, name=None,
-               prescale_factor=1.0, postscale_factor=1.0):
+               prescale_factor=1.0, postscale_factor=1.0, group=None):
     return synchronize(allreduce_async_(tensor, average, name,
-                                        prescale_factor, postscale_factor))
+                                        prescale_factor, postscale_factor,
+                                        group=group))
 
 
-def allgather(tensor, name=None):
-    return _AllgatherFunction.apply(tensor, name)
+def allgather(tensor, name=None, group=None):
+    return _AllgatherFunction.apply(tensor, name, group)
 
 
-def broadcast(tensor, root_rank, name=None):
-    return _BroadcastFunction.apply(tensor, root_rank, name)
+def broadcast(tensor, root_rank, name=None, group=None):
+    return _BroadcastFunction.apply(tensor, root_rank, name, group)
 
 
-def broadcast_(tensor, root_rank, name=None):
-    return synchronize(broadcast_async_(tensor, root_rank, name))
+def broadcast_(tensor, root_rank, name=None, group=None):
+    return synchronize(broadcast_async_(tensor, root_rank, name,
+                                        group=group))
 
 
 # -- parameter / optimizer state broadcast --------------------------------
@@ -450,7 +474,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     torch/__init__.py:108-143); `step()` drains the handles first."""
 
     def __init__(self, params, named_parameters, compression,
-                 backward_passes_per_step=1):
+                 backward_passes_per_step=1, group=None):
         # params is the wrapped optimizer's param_groups: each group dict
         # already carries its hyperparameters, so the parent optimizer's
         # defaults never overwrite them (same trick as the reference,
@@ -458,6 +482,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self._backward_passes_per_step = backward_passes_per_step
+        # Gradient-reduction scope (docs/GROUPS.md): None = resolve this
+        # rank's CURRENT batch group at each reduce — resolving at
+        # construction would capture a group id that goes stale across
+        # elastic re-inits (the mesh re-forms with fresh ids) and would
+        # miss a mesh formed after the optimizer was built.
+        self._group = group
         self._allreduce_delay = {}
         self._handles = {}
         self._grad_accs = []
@@ -491,8 +521,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def _allreduce_grad_async(self, p):
         name = self._parameter_names.get(id(p), "grad.%d" % id(p))
         compressed, ctx = self._compression.compress(p.grad)
+        group = self._group if self._group is not None \
+            else _hvd.batch_group()
         handle = allreduce_async(compressed, average=True,
-                                 name="allreduce.%s" % name)
+                                 name="allreduce.%s" % name,
+                                 group=group)
         return handle, ctx
 
     def _make_hook(self, p):
@@ -592,12 +625,14 @@ class _ShardedOptimizer(torch.optim.Optimizer):
     (docs/ZERO.md)."""
 
     def __init__(self, params, named_parameters, compression=None,
-                 backward_passes_per_step=1):
+                 backward_passes_per_step=1, group=None):
         super(self.__class__, self).__init__(params)
         from horovod_tpu import compression as _wire
         if backward_passes_per_step != 1:
             raise ValueError("sharded_update does not support "
                              "backward_passes_per_step > 1")
+        from horovod_tpu.groups import assert_sharded_update_world_scope
+        assert_sharded_update_world_scope(group)
         self._hvd_mode = _wire.resolve_wire_arg(compression,
                                                 Compression.none)
         if named_parameters is not None:
@@ -707,6 +742,11 @@ class _ShardedOptimizer(torch.optim.Optimizer):
 
     def step(self, closure=None):
         import numpy as np
+
+        # Re-checked per step: a mesh formed AFTER construction must
+        # fail here, not reduce-scatter across model shards.
+        from horovod_tpu.groups import assert_sharded_update_world_scope
+        assert_sharded_update_world_scope()
         loss = None
         if closure is not None:
             loss = closure()
@@ -773,7 +813,7 @@ class _ShardedOptimizer(torch.optim.Optimizer):
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step=1,
-                         sharded_update=None):
+                         sharded_update=None, group=None):
     """Wraps `optimizer` into a gradient-averaging distributed optimizer
     (reference: torch/__init__.py DistributedOptimizer factory — dynamic
     subclass so isinstance(opt, type(optimizer)) keeps working).
@@ -783,7 +823,11 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     gradients, apply the optimizer to this rank's 1/N shard (optimizer
     state shrinks N-fold), allgather updated params (docs/ZERO.md).
     ``compression`` is then a wire mode ('none'/'bf16'/'int8'), and
-    mixed sharded/replicated ranks are rejected at negotiation."""
+    mixed sharded/replicated ranks are rejected at negotiation.
+
+    ``group`` scopes the gradient averaging to a process group
+    (docs/GROUPS.md); it defaults to this rank's batch group under
+    ``hvd.init(model_parallel=k)``."""
     if sharded_update is None:
         sharded_update = _ops.sharded_update_default()
     base = (_ShardedOptimizer if sharded_update
@@ -791,4 +835,4 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(base.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
-               backward_passes_per_step)
+               backward_passes_per_step, group)

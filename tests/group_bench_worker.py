@@ -1,0 +1,72 @@
+"""Worker for bench.py --model-parallel (BENCH_r09): the process-group
+wire-bytes and step-time A/B on the host control plane.
+
+Forms the (batch, model) mesh via hvd.init(model_parallel=K), then
+measures per-rank socket bytes (net_ring_bytes_sent_total deltas) and
+latency for:
+  * a full-world allreduce of the payload tensor (the pure-DP baseline);
+  * a MODEL-group allreduce of the SAME tensor (the tensor-parallel
+    activation reduction — the acceptance's wire-ratio numerator);
+  * a BATCH-group allreduce of the same tensor (the mesh's gradient
+    path: same bytes class as the world ring but over N/K members).
+
+numpy+ctypes only — spawned by bench.py _spawn_local_workers."""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import ops
+
+
+def ring_sent():
+    return hvd.metrics()["counters"]["net_ring_bytes_sent_total"]
+
+
+def main():
+    k = int(os.environ.get("HVD_TPU_BENCH_MODEL_PARALLEL", "2"))
+    mb = float(os.environ.get("HVD_TPU_BENCH_PAYLOAD_MB", "1"))
+    iters = int(os.environ.get("HVD_TPU_BENCH_ITERS", "20"))
+    hvd.init(model_parallel=k)
+    r, n = hvd.rank(), hvd.size()
+    bg, mg = hvd.mesh_groups()
+    elems = int(mb * (1 << 20) / 4)
+    x = np.full(elems, float(r + 1), np.float32)
+
+    # Warm-up: settle negotiation, build both group rings.
+    ops.allreduce(x, "warm.world")
+    ops.allreduce(x, "warm.model", group=mg)
+    ops.allreduce(x, "warm.batch", group=bg)
+
+    def measure(tag, group):
+        b0 = ring_sent()
+        t0 = time.perf_counter()
+        for i in range(iters):
+            out = ops.allreduce(x, "%s.%d" % (tag, i), group=group)
+        dt_us = (time.perf_counter() - t0) / iters * 1e6
+        per_iter = (ring_sent() - b0) / iters
+        expect = (sum(m + 1 for m in group.ranks) if group is not None
+                  else n * (n + 1) / 2)
+        assert np.allclose(out, expect), (tag, out[0], expect)
+        return {"bytes_per_iter": per_iter, "us_per_iter": dt_us}
+
+    world = measure("bw.world", None)
+    model = measure("bw.model", mg)
+    batch = measure("bw.batch", bg)
+
+    print("GB_RESULT " + json.dumps({
+        "rank": r, "world_size": n, "model_parallel": k,
+        "payload_mb": mb, "iters": iters,
+        "world": world, "model_group": model, "batch_group": batch,
+        "groups": hvd.metrics()["gauges"]["groups"],
+        "group_tensors": hvd.metrics()["counters"]["group_tensors_total"],
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""Worker for the group-scoped divergence e2e (test_groups.py): a
+rank-divergent collective INSIDE one process group must error in
+seconds naming the group and both call sites — and must not implicate
+(or hang) ranks outside the group, which keep training."""
+
+import os
+import signal
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import ops
+from horovod_tpu.common.ops import HorovodInternalError
+
+
+def alarm(signum, frame):
+    sys.stderr.write("watchdog fired: job deadlocked\n")
+    sys.exit(3)
+
+
+signal.signal(signal.SIGALRM, alarm)
+signal.alarm(90)
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+assert n == 4
+g_front = hvd.new_group([0, 1])
+g_back = hvd.new_group([2, 3])
+
+if r in (0, 1):
+    # The classic rank-divergent collective, scoped to group 1: each
+    # member blocks on a rank-suffixed name the other never submits.
+    try:
+        ops.allreduce(np.ones(4, np.float32), "div.only_%d" % r,
+                      group=g_front)
+        raise AssertionError("group-divergent collective did not fail")
+    except HorovodInternalError as e:
+        msg = str(e)
+        assert "divergence" in msg, msg
+        assert "process group 1" in msg, msg
+        assert "div.only_0" in msg and "div.only_1" in msg, msg
+        print("rank %d divergence reported" % r, flush=True)
+    # Outlive the back group's run: exiting now would race a clean
+    # shutdown into its in-flight collectives.
+    import time
+    time.sleep(8)
+else:
+    # The OTHER group is untouched: it keeps running collectives the
+    # whole time the front group is diverged (paced past the front
+    # group's grace window so this process outlives the detection — an
+    # early exit would race a clean shutdown into the pending tensors).
+    import time
+    for step in range(12):
+        out = ops.allreduce(np.full(8, float(r), np.float32),
+                            "back.step", group=g_back)
+        assert np.allclose(out, 2 + 3), (r, step, out)
+        time.sleep(0.5)
+    print("rank %d unaffected group finished" % r, flush=True)

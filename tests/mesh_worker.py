@@ -1,0 +1,67 @@
+"""Worker for the 2-D mesh e2e (test_groups.py): hvd.init(model_parallel=2)
+at 4 ranks forms the (batch, model) groups, batch-axis collectives span
+the model columns, and the host-plane Megatron f/g operators produce
+exact values and gradients over the model group."""
+
+import signal
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import horovod_tpu as hvd_core
+import horovod_tpu.jax as hvd
+from horovod_tpu.parallel import tensor_parallel as tp
+
+
+def alarm(signum, frame):
+    sys.stderr.write("watchdog fired: job deadlocked\n")
+    sys.exit(3)
+
+
+signal.signal(signal.SIGALRM, alarm)
+signal.alarm(150)
+
+hvd.init(model_parallel=2)
+r, n = hvd.rank(), hvd.size()
+assert n == 4
+bg, mg = hvd_core.mesh_groups()
+assert hvd_core.model_parallel_size() == 2
+# rank r sits at model row r//2 (consecutive ranks) and batch column r%2.
+assert mg.ranks == (2 * (r // 2), 2 * (r // 2) + 1), (r, mg)
+assert bg.ranks == tuple(range(r % 2, n, 2)), (r, bg)
+
+# Batch-axis reduction spans the model COLUMN only.
+out = hvd.allreduce(np.float32(r), average=False, group=bg, name="col.sum")
+assert float(out) == sum(bg.ranks), (r, out)
+
+# DistributedOptimizer defaults to the batch group under the mesh:
+# per-rank gradients rank r -> mean over the batch column.
+import optax
+
+opt = hvd.DistributedOptimizer(optax.sgd(1.0))
+params = jnp.zeros(3)
+state = opt.init(params)
+g = jnp.full(3, float(r))
+updates, state = opt.update(g, state, params)
+expect = -np.mean(bg.ranks)
+assert np.allclose(np.asarray(updates), expect), (r, updates, expect)
+
+# Megatron f/g over the model group: exact forward value and exact
+# shard gradients under jax.grad.
+W = jnp.ones((3, 2)) * (mg.rank() + 1)
+
+
+def loss(w):
+    x = tp.copy_to_model_parallel(jnp.ones((2, 3)), mg, name="mw.f")
+    y = tp.reduce_from_model_parallel(x @ w, mg, name="mw.g")
+    return jnp.sum(y * y)
+
+
+val, grad = jax.value_and_grad(loss)(W)
+assert abs(float(val) - 4 * 81.0) < 1e-4, (r, val)
+assert np.allclose(np.asarray(grad), 36.0), (r, grad)
+
+print("rank %d mesh worker ok" % r, flush=True)

@@ -75,6 +75,22 @@ def main():
     kinds = ("allreduce", "allgather", "broadcast")
     if os.environ.get("HVD_TPU_FUZZ_SHARDED") == "1":
         kinds = ("allreduce", "allgather", "broadcast", "reduce_scatter")
+    # HVD_TPU_FUZZ_GROUPS=1 (the sanitizer grouped-negotiation variant,
+    # native/Makefile): two OVERLAPPING process groups — {0, 1} and {0}
+    # — fold group-scoped collectives into the out-of-order kind cycle,
+    # so group-keyed negotiation, the per-group response-cache bits
+    # (vacuous hits on non-members), lazy group-ring construction, and
+    # rank-remapped execution all run concurrently with the world-group
+    # kinds under compression and injected frame jitter. Rank 0
+    # additionally drives the singleton group each round (its tensors
+    # negotiate with a ready count of ONE while world tensors are
+    # pending — the overlap case).
+    groups_mode = os.environ.get("HVD_TPU_FUZZ_GROUPS") == "1"
+    g_pair = g_solo = None
+    if groups_mode:
+        g_pair = hvd.new_group([0, 1])
+        g_solo = hvd.new_group([0])
+        kinds = kinds + ("group_allreduce", "group_reduce_scatter")
     jobs = []
     for i in range(num_tensors):
         jobs.append((i, kinds[i % len(kinds)]))
@@ -96,6 +112,18 @@ def main():
                 arr = np.full((idx + 1, 3), float(r + 1), np.float32)
                 handles[idx] = ("reduce_scatter",
                                 ops.reduce_scatter_async(arr, name))
+            elif kind == "group_allreduce":
+                if r in g_pair.ranks:
+                    arr = np.full((idx + 1, 3), float(r + 1), np.float32)
+                    handles[idx] = ("group_allreduce",
+                                    ops.allreduce_async(arr, name,
+                                                        group=g_pair))
+            elif kind == "group_reduce_scatter":
+                if r in g_pair.ranks:
+                    arr = np.full((idx + 1, 3), float(r + 1), np.float32)
+                    handles[idx] = ("group_reduce_scatter",
+                                    ops.reduce_scatter_async(
+                                        arr, name, group=g_pair))
             elif kind == "allgather":
                 # Rank-dependent fill so a permuted segment order is
                 # caught.
@@ -109,12 +137,34 @@ def main():
                 handles[idx] = ("broadcast",
                                 ops.broadcast_async(arr, idx % n, name))
 
+        # The overlapping singleton group: rank 0 alone, mid-burst.
+        if groups_mode and r in g_solo.ranks:
+            solo = ops.allreduce(
+                np.full(5, 7.0, np.float32), "fuzz_solo.%d" % rnd,
+                group=g_solo)
+            assert np.allclose(solo, 7.0), solo
+
         # Synchronize in a different rank-specific order.
         sync_order = list(range(num_tensors))
         random.Random(seed * 3 + 7 + r + 101 * rnd).shuffle(sync_order)
         for idx in sync_order:
+            if idx not in handles:
+                continue  # group kind on a non-member rank
             kind, handle = handles[idx]
             out = ops.synchronize(handle)
+            if kind == "group_allreduce":
+                expected = sum(m + 1 for m in g_pair.ranks)
+                assert out.shape == (idx + 1, 3), (idx, out.shape)
+                assert np.allclose(out, expected), (idx, out)
+                continue
+            if kind == "group_reduce_scatter":
+                k = len(g_pair.ranks)
+                expected = sum(m + 1 for m in g_pair.ranks)
+                counts, _ = ops.shard_partition((idx + 1) * 3, k)
+                gr = g_pair.ranks.index(r)
+                assert out.shape == (counts[gr],), (idx, out.shape)
+                assert np.allclose(out, expected), (idx, out)
+                continue
             if kind == "allreduce":
                 expected = sum(rr + 1 for rr in range(n))
                 assert out.shape == (idx + 1, 3), (idx, out.shape)

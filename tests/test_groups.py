@@ -1,0 +1,249 @@
+"""Process groups (docs/GROUPS.md): subgroup collectives in the
+negotiation core + the 2-D (batch x model) mesh on top.
+
+e2e coverage (the ISSUE 11 acceptance set):
+  * every collective kind over disjoint groups with rank remapping and
+    the same tensor name live in two groups at once;
+  * per-group response-cache hits on repeated steps + INVALID on a
+    membership change;
+  * a model-group allreduce's wire bytes <= (group/world + 5%) of the
+    full-world allreduce of the same tensor;
+  * a deliberately group-divergent collective errors in seconds naming
+    the group and both call sites, without disturbing the other group;
+  * non-member / unknown-group / mixed-membership rejection by name;
+  * hvd.init(model_parallel=2) at 4 ranks trains the tensor-parallel
+    transformer example to the single-process reference loss curve.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT, clean_worker_env
+
+
+def test_process_group_handles():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    assert hvd.WORLD.id == 0
+    assert hvd.WORLD.size() == hvd.size()
+    g = hvd.new_group([0])
+    assert g.id >= 1
+    assert g.ranks == (0,)
+    assert g.size() == 1
+    assert g.rank() == 0  # single process: rank 0 is the member
+    assert 0 in g and 1 not in g
+    # Degenerate single-member group collectives are identities.
+    out = hvd.allreduce(np.arange(4, dtype=np.float32), "g1.t", group=g)
+    assert np.allclose(out, np.arange(4))
+    with pytest.raises(ValueError):
+        hvd.new_group([0, 0])
+    with pytest.raises(ValueError):
+        hvd.new_group([0, 99])
+    with pytest.raises(ValueError):
+        hvd.new_group([])
+
+
+def test_group_resolver_helpers():
+    import horovod_tpu as hvd
+    from horovod_tpu.groups import resolve_group
+
+    hvd.init()
+    assert resolve_group(None) == 0
+    assert resolve_group(hvd.WORLD) == 0
+    g = hvd.new_group([0])
+    assert resolve_group(g) == g.id
+    assert resolve_group(3) == 3
+    assert hvd.group_size(None) == hvd.size()
+    assert hvd.group_rank(None) == hvd.rank()
+
+
+@pytest.mark.e2e
+def test_group_collectives_all_kinds(run_launcher):
+    result = run_launcher(4, "group_worker.py",
+                          extra_env={"GROUP_MODE": "ops"})
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert result.stdout.count("group ops ok") == 4
+
+
+@pytest.mark.e2e
+def test_group_cache_hits_and_membership_invalidation(run_launcher):
+    """Acceptance: repeated steps in a 2-group job show cache hits in
+    both groups; re-scoping a name to a new group id invalidates."""
+    result = run_launcher(4, "group_worker.py",
+                          extra_env={"GROUP_MODE": "cache"})
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert result.stdout.count("group cache ok") == 4
+
+
+@pytest.mark.e2e
+def test_group_wire_bytes_ratio(run_launcher):
+    """Acceptance: the model-group (k=2 of 4) allreduce of a 1 MiB
+    tensor moves <= (2/4 + 5%) of the full-world allreduce's summed
+    socket bytes. (A true subgroup ring moves 2(k-1)S total vs the
+    world ring's 2(n-1)S, so the measured ratio should be ~1/3.)"""
+    result = run_launcher(4, "group_worker.py", extra_env={
+        "GROUP_MODE": "wire",
+        # Clean byte accounting: no autotune knob flips mid-measurement,
+        # no pipeline slicing (extra per-segment headers).
+        "HVD_TPU_AUTOTUNE": "0",
+        "HVD_TPU_PIPELINE_CHUNK_BYTES": "0",
+    })
+    assert result.returncode == 0, result.stdout + result.stderr
+    rows = re.findall(r"rank (\d+) wire world=(\d+) group=(\d+)",
+                      result.stdout)
+    assert len(rows) == 4, result.stdout
+    world_total = sum(int(w) for _, w, _ in rows)
+    group_total = sum(int(g) for _, _, g in rows)
+    assert world_total > 0
+    ratio = group_total / world_total
+    assert ratio <= 2 / 4 + 0.05, (ratio, rows)
+
+
+@pytest.mark.e2e
+def test_group_rejections_by_name(run_launcher):
+    result = run_launcher(2, "group_worker.py",
+                          extra_env={"GROUP_MODE": "reject"})
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert result.stdout.count("group reject ok") == 2
+
+
+@pytest.mark.e2e
+def test_unregistered_group_errors_not_hangs(run_launcher):
+    """A group the coordinator never registered (a new_group call-order
+    divergence) must error past the grace window naming the group —
+    the late-registration sweep only covers the benign in-flight race."""
+    result = run_launcher(2, "group_worker.py", extra_env={
+        "GROUP_MODE": "unknown",
+        "HVD_TPU_DIVERGENCE_GRACE_SECONDS": "2",
+    })
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "unregistered group reported" in result.stdout
+
+
+@pytest.mark.e2e
+def test_group_divergence_names_group_and_call_sites(run_launcher):
+    """Acceptance: a deliberately group-divergent collective errors in
+    seconds naming the group and both call sites, while the OTHER
+    group's collectives keep completing."""
+    result = run_launcher(4, "group_divergence_worker.py", extra_env={
+        "HVD_TPU_DIVERGENCE_GRACE_SECONDS": "2",
+    })
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert result.stdout.count("divergence reported") == 2
+    assert result.stdout.count("unaffected group finished") == 2
+
+
+@pytest.mark.e2e
+def test_mesh_formation(run_launcher):
+    result = run_launcher(4, "mesh_worker.py")
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert result.stdout.count("mesh worker ok") == 4
+
+
+@pytest.mark.e2e
+@pytest.mark.slow
+def test_tp_example_matches_reference(run_launcher, tmp_path):
+    """Acceptance: examples/jax_tp_lm.py under hvd.init(model_parallel=2)
+    at 4 ranks matches the single-process reference loss trajectory."""
+    example = os.path.join(REPO_ROOT, "examples", "jax_tp_lm.py")
+    ref_out = str(tmp_path / "ref.json")
+    mesh_out = str(tmp_path / "mesh.json")
+    env = clean_worker_env({"HVD_TPU_TP_REF_ROWS": "2"})
+    ref = subprocess.run(
+        [sys.executable, example, "--reference", "--steps", "6",
+         "--loss-out", ref_out],
+        env=env, timeout=300, capture_output=True, text=True)
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+
+    result = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run.run", "-np", "4", "--",
+         sys.executable, example, "--model-parallel", "2", "--steps", "6",
+         "--loss-out", mesh_out],
+        env=clean_worker_env(), timeout=600, capture_output=True, text=True)
+    assert result.returncode == 0, result.stdout + result.stderr
+
+    ref_losses = json.load(open(ref_out))["losses"]
+    mesh_losses = json.load(open(mesh_out))["losses"]
+    assert len(ref_losses) == len(mesh_losses) == 6
+    div = max(abs(a - b) / max(abs(a), 1e-9)
+              for a, b in zip(ref_losses, mesh_losses))
+    assert div <= 1e-3, (div, ref_losses, mesh_losses)
+
+
+@pytest.mark.e2e
+def test_tp_example_refuses_pure_dp(tmp_path):
+    """The acceptance model must NOT run pure data-parallel at its
+    width: without model_parallel >= 2 it exits with the budget/mesh
+    message."""
+    example = os.path.join(REPO_ROOT, "examples", "jax_tp_lm.py")
+    result = subprocess.run(
+        [sys.executable, example, "--steps", "1"],
+        env=clean_worker_env(), timeout=180, capture_output=True,
+        text=True)
+    assert result.returncode != 0
+    assert "cannot run pure-DP" in (result.stdout + result.stderr)
+
+
+def test_lint_group_scoped_call_not_flagged():
+    """A collective with group= under a rank/membership guard is the
+    legitimate mesh pattern; the rank-conditional rule must not fire
+    (the runtime's group-scoped divergence detection owns misuse)."""
+    import textwrap
+
+    from horovod_tpu.lint import lint_source
+
+    findings = lint_source(textwrap.dedent("""
+        import horovod_tpu as hvd
+        hvd.init()
+        g = hvd.new_group([0, 2])
+        if g.rank() >= 0:
+            hvd.allreduce(x, "scoped", group=g)
+    """))
+    assert not [f for f in findings
+                if f.rule == "rank-conditional-collective"], findings
+
+
+def test_lint_rank_conditional_still_flags_ungrouped():
+    """The classic world-scoped rank-conditional collective still
+    errors — including when group=None is written out explicitly."""
+    import textwrap
+
+    from horovod_tpu.lint import lint_source
+
+    findings = lint_source(textwrap.dedent("""
+        import horovod_tpu as hvd
+        hvd.init()
+        if hvd.rank() == 0:
+            hvd.allreduce(x, "oops", group=None)
+    """))
+    assert [f for f in findings
+            if f.rule == "rank-conditional-collective"], findings
+
+
+def test_mesh_2d_jax_mesh():
+    from horovod_tpu.parallel.mesh import mesh_2d
+
+    mesh = mesh_2d(2)  # 8 virtual CPU devices -> (4, 2)
+    assert mesh.shape["batch"] == 4
+    assert mesh.shape["model"] == 2
+    with pytest.raises(ValueError):
+        mesh_2d(3)
+
+
+def test_group_qualified_summary_fields():
+    """The groups gauge and group_tensors_total ride the metrics
+    snapshot (zero before any group exists)."""
+    import horovod_tpu as hvd
+
+    hvd.init()
+    m = hvd.metrics()
+    assert "groups" in m["gauges"]
+    assert "group_tensors_total" in m["counters"]
+    assert "per_group" in m
