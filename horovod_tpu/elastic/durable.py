@@ -533,18 +533,26 @@ class DurableCheckpointer:
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        # Two-slot queue (bounded at 2 buffered snapshots, never more):
-        # `_pending_sticky` holds the newest STICKY snapshot — a
-        # rank-deterministic 1-in-sticky_every commit that every rank
-        # writes and that newer non-sticky snapshots may not displace.
-        # Without it, each rank's latest-wins skipping follows its own
-        # writer timing, and two ranks under storage slower than the
-        # commit cadence can stably anti-align (rank 0 landing only
-        # even steps, rank 1 only odd) so that NO manifest ever
-        # publishes mid-run. `_pending` holds the newest snapshot
+        # Three-slot queue (bounded at 3 buffered snapshots, never more):
+        # the sticky slots hold STICKY snapshots — rank-deterministic
+        # 1-in-sticky_every commits that every rank writes and that
+        # newer non-sticky snapshots may not displace. Without them,
+        # each rank's latest-wins skipping follows its own writer
+        # timing, and two ranks under storage slower than the commit
+        # cadence can stably anti-align (rank 0 landing only even
+        # steps, rank 1 only odd) so that NO manifest ever publishes
+        # mid-run. `_sticky_head` is the OLDEST unwritten sticky and is
+        # never displaced by anything: its capture is decided at
+        # enqueue time (commit-driven, identical on every rank), not by
+        # when this rank's writer happens to wake — so the first sticky
+        # after any drained period is guaranteed durable on EVERY rank,
+        # scheduler timing notwithstanding. `_sticky_next` is
+        # latest-wins among the stickies that arrive while the head is
+        # still unwritten. `_pending` holds the newest snapshot
         # overall, so the most recent commit still always becomes
         # durable once the writer drains (clean-exit flush included).
-        self._pending_sticky = None
+        self._sticky_head = None
+        self._sticky_next = None
         self._pending = None   # newest (snapshot, step, gen, rank, size)
         self._inflight = False
         self._stop = False
@@ -643,7 +651,12 @@ class DurableCheckpointer:
                self._size(), sticky)
         with self._cv:
             if sticky:
-                self._pending_sticky = job
+                if self._sticky_head is None:
+                    self._sticky_head = job
+                else:
+                    # The head is pinned until written; newer stickies
+                    # are latest-wins among themselves.
+                    self._sticky_next = job
             else:
                 self._pending = job
             if self._thread is None:
@@ -668,7 +681,13 @@ class DurableCheckpointer:
         job = (committed, step, self._generation(), self._rank(),
                self._size(), True)
         with self._cv:
-            self._pending_sticky = job
+            # The drain is the job's final commit: land it in the
+            # latest-wins sticky slot (behind any pinned unwritten
+            # anchor, which the writer drains first anyway).
+            if self._sticky_head is None:
+                self._sticky_head = job
+            else:
+                self._sticky_next = job
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._writer_loop, name="hvd-durable-ckpt",
@@ -682,11 +701,13 @@ class DurableCheckpointer:
         return True
 
     def _take_pending_locked(self):
-        """Next job for the writer: the sticky slot first (it is always
-        the older of the two), then the newest snapshot."""
-        if self._pending_sticky is not None:
-            job = self._pending_sticky
-            self._pending_sticky = None
+        """Next job for the writer: sticky slots first, oldest first
+        (they are always older than the newest snapshot), then the
+        newest snapshot."""
+        if self._sticky_head is not None:
+            job = self._sticky_head
+            self._sticky_head = self._sticky_next
+            self._sticky_next = None
             return job
         job = self._pending
         self._pending = None
@@ -694,7 +715,7 @@ class DurableCheckpointer:
 
     def _has_pending_locked(self):
         return self._pending is not None or \
-            self._pending_sticky is not None
+            self._sticky_head is not None
 
     def flush(self, timeout=None):
         """Blocks until the writer has drained (pending + in-flight).

@@ -21,12 +21,14 @@ every rule for that line.
 import os
 
 from . import checkers as _checkers  # noqa: F401  (registers rules)
+from . import schedule as _schedule  # noqa: F401  (registers verify-*)
 from .rules import CHECKERS, ERROR, INFO, RULES, WARNING, Finding
+from .schedule import verify_paths, verify_source
 from .walker import build_model
 
 __all__ = [
     "CHECKERS", "ERROR", "Finding", "INFO", "RULES", "WARNING",
-    "lint_paths", "lint_source",
+    "lint_paths", "lint_source", "verify_paths", "verify_source",
 ]
 
 

@@ -1,0 +1,127 @@
+"""Interprocedural program model for hvd-verify (docs/LINT.md).
+
+The per-call-site rules in checkers.py are deliberately lexical: they
+see one file and one statement at a time. The schedule verifier needs
+more — a helper function that issues a collective from a rank-dependent
+branch three calls deep is invisible lexically — so this module builds
+the minimal whole-program view the symbolic executor consumes:
+
+* the ENTRY module (the user's training script, ``__name__`` bound to
+  ``"__main__"``), parsed with the same walker Model the lexical rules
+  use (import-alias resolution, suppression table);
+* its LOCAL imports, resolved on disk relative to the entry script's
+  directory (``import helpers`` / ``from helpers import reduce_all``
+  where ``helpers.py`` or ``helpers/__init__.py`` sits next to the
+  script) — third-party and stdlib imports stay opaque;
+* a function table per module (top-level ``def``s, including decorated
+  and async ones) for bounded inlining.
+
+Everything is bounded: at most ``MAX_MODULES`` local modules load, and
+unresolvable imports degrade to unknown values instead of erroring —
+the verifier proves what it can see and says nothing about the rest.
+"""
+
+import ast
+import os
+
+from .walker import build_model
+
+# Local-import budget: a training script's helper closure is a handful
+# of files; hitting this bound means we wandered into a vendored tree.
+MAX_MODULES = 64
+
+
+class FunctionInfo(object):
+    """One inlinable function: its def node plus the module it lives in
+    (the module supplies alias context and the file path for chains)."""
+
+    __slots__ = ("name", "node", "module")
+
+    def __init__(self, name, node, module):
+        self.name = name
+        self.node = node
+        self.module = module
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return "<FunctionInfo %s at %s:%d>" % (
+            self.name, self.module.path, self.node.lineno)
+
+
+class ModuleInfo(object):
+    """One parsed module: tree + walker Model + top-level function and
+    class tables + the on-disk directory its own imports resolve in."""
+
+    def __init__(self, path, source, model, run_name):
+        self.path = path
+        self.source = source
+        self.model = model          # walker Model (aliases, suppressions)
+        self.tree = model.tree
+        self.run_name = run_name    # value of __name__ when executed
+        self.functions = {}         # top-level name -> FunctionInfo
+        self.classes = {}           # top-level name -> ClassDef node
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = FunctionInfo(
+                    node.name, node, self)
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+
+    @property
+    def directory(self):
+        return os.path.dirname(os.path.abspath(self.path))
+
+
+class ProgramGraph(object):
+    """The entry module plus every local module reachable from it.
+
+    ``load_local(directory, modname)`` is the single resolution point:
+    it maps a dotted module name to a file under ``directory`` and
+    parses it once (modules are cached by real path, so diamond imports
+    share one ModuleInfo and one symbolic top-level execution).
+    """
+
+    def __init__(self, entry_path, source=None):
+        self.modules = {}           # realpath -> ModuleInfo
+        self.entry = self._load(entry_path, source=source,
+                                run_name="__main__")
+
+    def _load(self, path, source=None, run_name=None):
+        real = os.path.realpath(path)
+        cached = self.modules.get(real)
+        if cached is not None:
+            return cached
+        if len(self.modules) >= MAX_MODULES:
+            return None
+        if source is None:
+            with open(path, "r", encoding="utf-8", errors="replace") as fh:
+                source = fh.read()
+        # SyntaxError propagates to the caller: the entry file's parse
+        # error becomes the standard parse-error finding; a helper's
+        # parse error degrades that import to unknown.
+        model = build_model(path, source)
+        if run_name is None:
+            run_name = os.path.splitext(os.path.basename(path))[0]
+        info = ModuleInfo(path, source, model, run_name)
+        self.modules[real] = info
+        return info
+
+    def load_local(self, directory, modname):
+        """ModuleInfo for ``modname`` (dotted) resolved under
+        ``directory``, or None when it is not a local file (third-party,
+        stdlib, or the horovod_tpu package itself — the verifier models
+        the framework natively rather than tracing its internals)."""
+        root = modname.split(".")[0]
+        if root in ("horovod_tpu", "horovod"):
+            return None
+        parts = modname.split(".")
+        candidates = (
+            os.path.join(directory, *parts) + ".py",
+            os.path.join(directory, *parts, "__init__.py"),
+        )
+        for cand in candidates:
+            if os.path.isfile(cand):
+                try:
+                    return self._load(cand)
+                except (SyntaxError, OSError):
+                    return None
+        return None

@@ -14,7 +14,8 @@ import os
 import sys
 
 from . import RULES, lint_paths
-from .report import format_human, format_json, summarize_human
+from .report import (format_human, format_json, format_sarif,
+                     summarize_human)
 from .rules import severity_at_least
 
 
@@ -25,7 +26,7 @@ def make_parser():
                     "horovod_tpu training scripts (see docs/LINT.md).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories to lint")
-    parser.add_argument("--format", choices=("human", "json"),
+    parser.add_argument("--format", choices=("human", "json", "sarif"),
                         default="human")
     parser.add_argument("--fail-on", choices=("warning", "error"),
                         default="warning",
@@ -33,6 +34,17 @@ def make_parser():
                              "(default: warning — any finding fails)")
     parser.add_argument("--disable", default="",
                         help="comma-separated rule ids to skip globally")
+    parser.add_argument("--verify", action="store_true",
+                        help="additionally run the hvd-verify symbolic "
+                             "collective-schedule verifier: each .py "
+                             "file is executed for an abstract N-rank "
+                             "world (local imports followed, helpers "
+                             "inlined) and the per-rank collective "
+                             "schedules are diffed (docs/LINT.md)")
+    parser.add_argument("--verify-world", type=int, default=None,
+                        metavar="N",
+                        help="symbolic world size for --verify "
+                             "(default: 4)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule registry and exit")
     return parser
@@ -62,8 +74,18 @@ def main(argv=None):
     enabled = set(RULES) - disabled
     findings, files_checked = lint_paths(args.paths, rules=enabled)
 
+    if args.verify:
+        from .schedule import DEFAULT_WORLD, verify_paths
+        world = args.verify_world or DEFAULT_WORLD
+        vfindings, _ = verify_paths(args.paths, world=world,
+                                    rules=enabled)
+        findings.extend(vfindings)
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
     if args.format == "json":
         format_json(findings, files_checked, sys.stdout)
+    elif args.format == "sarif":
+        format_sarif(findings, files_checked, sys.stdout)
     else:
         format_human(findings, sys.stdout)
         summarize_human(findings, files_checked, sys.stderr)

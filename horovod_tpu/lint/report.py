@@ -1,7 +1,63 @@
-"""Finding reporters: human (path:line:col, grep/editor-friendly) and JSON
-(stable schema for CI and the launcher preflight)."""
+"""Finding reporters: human (path:line:col, grep/editor-friendly), JSON
+(stable schema for CI and the launcher preflight), and SARIF 2.1.0 (so
+CI can annotate diffs and track suppressions).
 
+Every finding carries a stable *fingerprint* — a hash over the rule,
+the file's basename, the message with volatile parts (line/col numbers,
+absolute paths) normalized out, and the text of the anchored source
+line. Fingerprints survive unrelated edits that shift line numbers, so
+CI baselines and SARIF result-matching keep recognizing a finding
+after a refactor above it.
+"""
+
+import hashlib
 import json
+import os
+import re
+
+# Volatile message parts that must not feed the fingerprint: line/col
+# references inside chains ("foo.py:123") and bare "position N" /
+# "after N" counters that shift with unrelated edits.
+_LINE_REF = re.compile(r"(:)\d+")
+_COUNTER = re.compile(r"\b(position|after) \d+")
+
+
+def _read_lines_cached(path, _cache={}):
+    """One read per file per process — the reporters fingerprint every
+    finding, and a noisy file would otherwise be re-read per finding."""
+    if path not in _cache:
+        if len(_cache) > 256:
+            _cache.clear()
+        try:
+            with open(path, "r", encoding="utf-8",
+                      errors="replace") as fh:
+                _cache[path] = fh.read().splitlines()
+        except OSError:
+            _cache[path] = None
+    return _cache[path]
+
+
+def _anchored_line_text(finding, source_lines=None):
+    if source_lines is None:
+        source_lines = _read_lines_cached(finding.path)
+    if source_lines is not None and 0 < finding.line <= len(source_lines):
+        return source_lines[finding.line - 1].strip()
+    return ""
+
+
+def fingerprint(finding, source_lines=None):
+    """Stable hex id for a finding (16 chars): immune to line shifts
+    and to directory moves, sensitive to rule, file name, normalized
+    message, and the anchored line's code."""
+    msg = _LINE_REF.sub(r"\1N", finding.message)
+    msg = _COUNTER.sub(r"\1 N", msg)
+    payload = "\x1f".join([
+        finding.rule,
+        os.path.basename(finding.path),
+        msg,
+        _anchored_line_text(finding, source_lines),
+    ])
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()[:16]
 
 
 def format_human(findings, out):
@@ -31,9 +87,79 @@ def format_json(findings, files_checked, out):
                 "rule": f.rule,
                 "severity": f.severity,
                 "message": f.message,
+                "fingerprint": fingerprint(f),
             }
             for f in findings
         ],
+    }
+    json.dump(payload, out, indent=2, sort_keys=True)
+    out.write("\n")
+
+
+_SARIF_LEVEL = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def format_sarif(findings, files_checked, out):
+    """SARIF 2.1.0: one run, rules from the registry, results with
+    partialFingerprints so SARIF consumers (GitHub code scanning et
+    al.) match findings across commits even when lines shift."""
+    from .rules import RULES
+
+    used = []
+    seen = set()
+    for f in findings:
+        if f.rule not in seen:
+            seen.add(f.rule)
+            used.append(f.rule)
+    rules = []
+    for rule_id in used:
+        rule = RULES.get(rule_id)
+        rules.append({
+            "id": rule_id,
+            "shortDescription": {
+                "text": rule.summary if rule is not None else rule_id},
+            "defaultConfiguration": {
+                "level": _SARIF_LEVEL.get(
+                    rule.default_severity if rule is not None
+                    else "warning", "warning")},
+        })
+    index = {rule_id: i for i, rule_id in enumerate(used)}
+
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace(os.sep, "/")},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col,
+                               "endLine": f.end_line or f.line},
+                },
+            }],
+            "partialFingerprints": {
+                "hvdLintFingerprint/v1": fingerprint(f),
+            },
+        })
+
+    payload = {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "hvd-lint",
+                    "informationUri": "docs/LINT.md",
+                    "rules": rules,
+                },
+            },
+            "results": results,
+        }],
     }
     json.dump(payload, out, indent=2, sort_keys=True)
     out.write("\n")

@@ -42,6 +42,14 @@ def register(rule_id, default_severity, summary):
     return deco
 
 
+def register_meta(rule_id, default_severity, summary):
+    """Registers a rule id WITHOUT a per-model checker — the hvd-verify
+    schedule analyses run over the whole program, not one Model, but
+    their ids still live in the registry so `--disable`, `--list-rules`
+    and inline suppressions treat them like any other rule."""
+    RULES[rule_id] = Rule(rule_id, default_severity, summary)
+
+
 # `end_line` exists so suppression comments work on multi-line statements
 # (a trailing `# hvd-lint: disable=...` on the closing line of a wrapped
 # call must suppress the finding anchored at its first line).

@@ -1,0 +1,415 @@
+"""hvd-verify: whole-program collective-schedule verification.
+
+Runs the symbolic executor (symbolic.py) once per rank of an abstract
+W-rank world over the interprocedural program model (callgraph.py) and
+*diffs the resulting schedules*. The runtime divergence detector
+(native/divergence.cc) proves these bugs only after the job is launched
+and has hung for the grace window; here the same classes of bug are
+proven before launch, each reported with BOTH conflicting call-site
+chains — mirroring the runtime error's "submitted by / went on to"
+format.
+
+Finding classes (rule ids are suppression keys like every other rule):
+
+* ``verify-divergent-schedule`` — two symbolic ranks disagree on the
+  ordered sequence of collectives they must both join (the
+  cross-function generalization of rank-conditional-collective);
+* ``verify-kind-mismatch`` — one tensor name negotiated as different
+  op kinds on different paths/ranks;
+* ``verify-non-member-group-call`` — a group collective reachable on a
+  rank outside the group's membership;
+* ``verify-mixed-modes`` — one tensor name negotiated with different
+  compression or sharded-update modes on different paths/ranks;
+* ``verify-missing-restore-broadcast`` — a rank-local state restore
+  (``state.restore()`` / ``DurableCheckpointer.restore_into``) followed
+  by gradient averaging with no broadcast/sync in between: ranks train
+  on silently different weights.
+"""
+
+import collections
+import os
+
+from .rules import ERROR, WARNING, Finding, RULES, register_meta
+from .symbolic import Executor, format_chain
+
+DEFAULT_WORLD = 4
+
+register_meta("verify-divergent-schedule", ERROR,
+              "symbolic ranks disagree on the collective sequence")
+register_meta("verify-kind-mismatch", ERROR,
+              "one name negotiated as different collective kinds")
+register_meta("verify-non-member-group-call", ERROR,
+              "group collective reachable on a non-member rank")
+register_meta("verify-mixed-modes", ERROR,
+              "one name negotiated with mixed compression/sharded modes")
+register_meta("verify-missing-restore-broadcast", ERROR,
+              "state restore with no broadcast before gradient averaging")
+register_meta("verify-crash", WARNING,
+              "the schedule verifier itself failed on this file")
+
+def _wildcard_name(name):
+    """Names with unresolved parts: identity across call sites is
+    unknowable, so the per-name analyses must not compare them."""
+    return "<?" in name or name.startswith("<auto#")
+
+
+class Schedules(object):
+    """Per-rank schedules plus exec-time findings for one entry file."""
+
+    def __init__(self, path, world):
+        self.path = path
+        self.world = world
+        self.per_rank = []       # rank -> [Event] (full, incl. rank-local)
+        self.exec_findings = []  # ExecFinding, all ranks
+        self.truncated = False
+        self.graph = None        # the shared ProgramGraph (one parse)
+
+
+def extract_schedules(path, source=None, world=DEFAULT_WORLD):
+    """Runs the symbolic world; returns a Schedules (or raises
+    SyntaxError when the ENTRY file does not parse)."""
+    from .callgraph import ProgramGraph
+
+    out = Schedules(path, world)
+    # One parse for all ranks: the graph holds only immutable data
+    # (sources, ASTs, alias models); every mutable bit of execution
+    # state lives on the per-rank Executor.
+    graph = ProgramGraph(path, source=source)
+    out.graph = graph
+    for rank in range(world):
+        ex = Executor(graph, rank, world)
+        events, findings = ex.run()
+        if ex.truncated:
+            out.truncated = True
+        out.per_rank.append(events)
+        out.exec_findings.extend(findings)
+    return out
+
+
+# --------------------------------------------------------------------------
+# analyses over the extracted schedules
+
+
+def _anchor(chain, entry_path):
+    """(line, end_line) for a finding: the DEEPEST frame of the chain
+    that sits in the entry file — the line the user can actually act on
+    (and the line a suppression comment must target)."""
+    entry_real = os.path.realpath(entry_path)
+    line = chain[0][1] if chain else 1
+    for frame in chain:
+        if os.path.realpath(frame[0]) == entry_real:
+            line = frame[1]
+    return line
+
+
+def _mk(path, line, rule, message):
+    return Finding(path=path, line=line, col=1, rule=rule,
+                   severity=RULES[rule].default_severity,
+                   message=message, end_line=line)
+
+
+def _participates(event, rank):
+    if event.group is None or event.group.ranks is None:
+        return True
+    return rank in event.group.ranks
+
+
+def _group_keys_touched(events):
+    return {e.group_key() for e in events
+            if e.group is not None and e.group.ranks is None}
+
+
+def _diff_pair(sched, a, b, path, findings, truncated):
+    """First disagreement between ranks a and b on the collectives they
+    must BOTH join. Events in a group of UNKNOWN membership
+    (model_group()/batch_group(), dynamic rank lists) are compared only
+    between ranks that each touch that group at all: a rank that
+    (correctly) sits the group out via `if g.rank() >= 0:` must not
+    read as a divergence, and whether it was SUPPOSED to sit out is
+    exactly what the verifier cannot know — the runtime group-scoped
+    divergence detection is the backstop there."""
+    keys_a = _group_keys_touched(sched.per_rank[a])
+    keys_b = _group_keys_touched(sched.per_rank[b])
+    shared = keys_a & keys_b
+
+    def relevant(e, other_rank):
+        if not e.collective or not _participates(e, other_rank):
+            return False
+        if e.group is not None and e.group.ranks is None:
+            return e.group_key() in shared
+        return True
+
+    sa = [e for e in sched.per_rank[a] if relevant(e, b)]
+    sb = [e for e in sched.per_rank[b] if relevant(e, a)]
+    n = min(len(sa), len(sb))
+    for i in range(n):
+        ea, eb = sa[i], sb[i]
+        if ea.identity() == eb.identity():
+            continue
+        if ea.kind == eb.kind and ea.name == eb.name:
+            # Same slot, same name, DIFFERENT group identity: e.g. two
+            # same-member registrations (distinct runtime group ids)
+            # with half the ranks submitting under each — the
+            # coordinator sees mixed groups for one name.
+            findings.append((
+                "verify-divergent-schedule",
+                _anchor(ea.chain, path),
+                ("grp", _anchor(ea.chain, path),
+                 _anchor(eb.chain, path)),
+                (ea.name, eb.name),
+                "collective '%s' is submitted under DIFFERENT process "
+                "groups by different ranks: symbolic rank %d uses %s "
+                "but symbolic rank %d uses %s — one name must ride one "
+                "group (runtime: mixed-membership rejection naming the "
+                "rank, docs/GROUPS.md). rank %d call chain: %s; rank "
+                "%d call chain: %s"
+                % (ea.name, a, ea.group.describe() if ea.group else
+                   "the world group", b, eb.group.describe() if
+                   eb.group else "the world group", a,
+                   format_chain(ea.chain), b, format_chain(eb.chain))))
+            return
+        findings.append((
+            "verify-divergent-schedule",
+            _anchor(ea.chain, path),
+            ("pos", _anchor(ea.chain, path), _anchor(eb.chain, path)),
+            (ea.name, eb.name),
+            "collective schedule divergence at shared position %d: "
+            "symbolic rank %d submits %s but symbolic rank %d submits "
+            "%s — every rank must issue the same collectives in the "
+            "same order (runtime: divergence cross-check names both "
+            "sides after the grace window). rank %d call chain: %s; "
+            "rank %d call chain: %s"
+            % (i, a, ea.describe(), b, eb.describe(), a,
+               format_chain(ea.chain), b, format_chain(eb.chain))))
+        return
+    if len(sa) != len(sb) and not truncated:
+        longer, shorter = (a, b) if len(sa) > len(sb) else (b, a)
+        extra = (sa if len(sa) > len(sb) else sb)[n]
+        findings.append((
+            "verify-divergent-schedule",
+            _anchor(extra.chain, path),
+            ("extra", _anchor(extra.chain, path)),
+            (extra.name,),
+            "collective schedule divergence: symbolic rank %d submits "
+            "%s that symbolic rank %d never submits (its schedule ends "
+            "after %d shared collectives) — the submitting ranks hang "
+            "in negotiation (runtime: divergence cross-check / stall "
+            "inspector). rank %d call chain: %s"
+            % (longer, extra.describe(), shorter, n, longer,
+               format_chain(extra.chain))))
+
+
+def _per_name_events(sched):
+    by_name = collections.OrderedDict()
+    for events in sched.per_rank:
+        for e in events:
+            if e.collective and not _wildcard_name(e.name):
+                by_name.setdefault(e.name, []).append(e)
+    return by_name
+
+
+def _kind_mismatches(sched, path, findings):
+    for name, events in _per_name_events(sched).items():
+        kinds = collections.OrderedDict()
+        for e in events:
+            kinds.setdefault(e.kind, e)
+        if len(kinds) < 2:
+            continue
+        (k1, e1), (k2, e2) = list(kinds.items())[:2]
+        findings.append((
+            "verify-kind-mismatch", _anchor(e1.chain, path),
+            (name, k1, k2), (name,),
+            "collective name '%s' is negotiated as %s on one path but "
+            "as %s on another: whichever rank reaches the second path "
+            "submits incompatible metadata for the same tensor name "
+            "and the coordinator rejects it (runtime: cross-rank "
+            "validation names the mismatched field). %s chain: %s; %s "
+            "chain: %s"
+            % (name, k1, k2, k1, format_chain(e1.chain), k2,
+               format_chain(e2.chain))))
+
+
+def _norm_comp(comp):
+    if comp in (None, "", "none", 0, False):
+        return "none"
+    return comp
+
+
+def _mode_mismatches(sched, path, findings):
+    for name, events in _per_name_events(sched).items():
+        comps = collections.OrderedDict()
+        shardeds = collections.OrderedDict()
+        for e in events:
+            c = _norm_comp(e.compression)
+            if c != "<?>":
+                comps.setdefault(c, e)
+            if e.sharded is not None:
+                shardeds.setdefault(bool(e.sharded), e)
+        if len(comps) > 1:
+            (c1, e1), (c2, e2) = list(comps.items())[:2]
+            findings.append((
+                "verify-mixed-modes", _anchor(e1.chain, path),
+                (name, "compression", c1, c2), (name,),
+                "collective name '%s' rides compression mode '%s' on "
+                "one path and '%s' on another: the mode is part of the "
+                "negotiated wire format, so mixed modes for one name "
+                "either corrupt the decoded values or are rejected "
+                "cross-rank (docs/COMPRESSION.md). '%s' chain: %s; "
+                "'%s' chain: %s"
+                % (name, c1, c2, c1, format_chain(e1.chain), c2,
+                   format_chain(e2.chain))))
+        if len(shardeds) > 1:
+            (s1, e1), (s2, e2) = list(shardeds.items())[:2]
+            findings.append((
+                "verify-mixed-modes", _anchor(e1.chain, path),
+                (name, "sharded", s1, s2), (name,),
+                "collective name '%s' runs with sharded_update=%s on "
+                "one path and sharded_update=%s on another: sharded "
+                "ranks negotiate REDUCESCATTER while replicated ranks "
+                "negotiate ALLREDUCE for the same name — the runtime "
+                "rejects the mix naming both ranks and modes "
+                "(docs/ZERO.md). sharded=%s chain: %s; sharded=%s "
+                "chain: %s"
+                % (name, s1, s2, s1, format_chain(e1.chain), s2,
+                   format_chain(e2.chain))))
+
+
+_SYNCING_KINDS = {"sync", "broadcast", "checkpoint.restore"}
+
+
+def _missing_restore_broadcast(sched, path, findings):
+    # EVERY restore site is audited (a later unsynced restore after an
+    # earlier synced one is the classic elastic re-init bug); each
+    # site is inspected once, on the first rank that reaches it.
+    seen_sites = set()
+    for rank, events in enumerate(sched.per_rank):
+        for i, e in enumerate(events):
+            if e.kind != "restore":
+                continue
+            site = (e.path, e.line)
+            if site in seen_sites:
+                continue
+            seen_sites.add(site)
+            for later in events[i + 1:]:
+                if later.kind in _SYNCING_KINDS:
+                    break
+                if later.kind in ("allreduce", "reducescatter"):
+                    findings.append((
+                        "verify-missing-restore-broadcast",
+                        _anchor(e.chain, path),
+                        (e.name, later.name), (),
+                        "state restore at %s is followed by gradient "
+                        "averaging (%s at %s) with no broadcast or "
+                        "state.sync() in between: restore is "
+                        "rank-local, so after an elastic restart "
+                        "survivors and fresh ranks average gradients "
+                        "from different weights and silently train "
+                        "unsynchronized (runtime: no error at all — "
+                        "the job completes with wrong results). "
+                        "restore chain: %s; allreduce chain: %s"
+                        % (format_chain(e.chain[-1:]), later.describe(),
+                           format_chain(later.chain[-1:]),
+                           format_chain(e.chain),
+                           format_chain(later.chain))))
+                    break
+
+
+def analyze(sched):
+    """All schedule analyses; returns a list of
+    (rule, line, dedupe_key, names, message) tuples."""
+    raw = []
+    _kind_mismatches(sched, sched.path, raw)
+    _mode_mismatches(sched, sched.path, raw)
+    # Pairwise diffs. A kind mismatch also shows up as a sequence diff
+    # of the SAME name on both sides — report that once with the
+    # sharper per-name message. A diff pairing two DIFFERENT names is
+    # its own divergence even when one of them happens to carry an
+    # unrelated kind/mode finding, so it is kept.
+    owned = set()
+    for rule, line, key, names, msg in raw:
+        owned.update(names)
+    diffs = []
+    for a in range(sched.world):
+        for b in range(a + 1, sched.world):
+            _diff_pair(sched, a, b, sched.path, diffs, sched.truncated)
+    for rule, line, key, names, msg in diffs:
+        if len(names) == 2 and names[0] == names[1] and \
+                names[0] in owned:
+            continue
+        raw.append((rule, line, key, names, msg))
+    _missing_restore_broadcast(sched, sched.path, raw)
+    return raw
+
+
+# --------------------------------------------------------------------------
+# public entry points
+
+
+def verify_source(source, path="<string>", world=DEFAULT_WORLD,
+                  rules=None):
+    """Verifies one entry script; returns a list of Findings
+    (suppressions applied; a syntax error returns [] — the lexical pass
+    already reports parse-error for it)."""
+    try:
+        sched = extract_schedules(path, source=source, world=world)
+    except SyntaxError:
+        return []
+    except RecursionError:
+        return [_mk(path, 1, "verify-crash",
+                    "schedule verification hit the recursion limit; "
+                    "the file was NOT verified")]
+    except Exception as e:  # a verifier bug must not mask the report
+        return [_mk(path, 1, "verify-crash",
+                    "schedule verification failed (%s: %s); the file "
+                    "was NOT verified" % (type(e).__name__, e))]
+
+    findings = []
+    seen = set()
+    for f in sched.exec_findings:
+        key = (f.rule, f.line, f.message.split("symbolic rank")[0])
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(Finding(
+            path=path, line=f.line, col=1, rule=f.rule,
+            severity=RULES[f.rule].default_severity,
+            message=f.message, end_line=f.end_line))
+    for rule, line, key, names, msg in analyze(sched):
+        dkey = (rule,) + tuple(key)
+        if dkey in seen:
+            continue
+        seen.add(dkey)
+        findings.append(_mk(path, line, rule, msg))
+
+    # Suppressions come from the entry module's walker model — the
+    # same parse the executors ran on (one per file, not three).
+    model = sched.graph.entry.model
+    out = []
+    for f in findings:
+        if rules is not None and f.rule not in rules:
+            continue
+        if model.is_suppressed(f.line, f.rule, f.end_line):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.line, f.col, f.rule))
+    return out
+
+
+def verify_paths(paths, world=DEFAULT_WORLD, rules=None):
+    """Verifies files/directories (each .py file is its own entry
+    script); returns (findings, files_checked)."""
+    from . import iter_python_files
+
+    findings = []
+    files_checked = 0
+    for fpath in iter_python_files(paths):
+        try:
+            with open(fpath, "r", encoding="utf-8",
+                      errors="replace") as fh:
+                source = fh.read()
+        except OSError:
+            continue  # the lexical pass reports io-error for it
+        files_checked += 1
+        findings.extend(verify_source(source, path=fpath, world=world,
+                                      rules=rules))
+    return findings, files_checked

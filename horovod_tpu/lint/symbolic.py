@@ -1,0 +1,1322 @@
+"""Abstract interpreter for hvd-verify: one symbolic rank's execution.
+
+The Horovod coordinator's core correctness condition (arxiv 1802.05799)
+is that every rank submits the SAME ordered sequence of collectives.
+This module runs the user's program once per *symbolic rank* of an
+abstract W-rank world — ``hvd.rank()`` evaluates to that rank's
+concrete index, ``hvd.size()`` to W — and records the ordered
+*collective schedule* the rank would submit: ``(kind, name, group,
+compression, sharded)`` events, each with the full interprocedural call
+chain that reached it. schedule.py then diffs the schedules across
+ranks; any disagreement is a statically-proven divergence.
+
+Abstraction choices (the "what it can/cannot prove" contract,
+docs/LINT.md):
+
+* values are CONST (a concrete Python value), structured handles
+  (GROUP / OPT / STATE / CKPT / FUNC / MODULE), or UNKNOWN with a
+  rank-dependence taint;
+* conditions: decidable ones branch concretely per rank; uniform
+  unknowns execute BOTH branches in order (every rank does the same,
+  so no false divergence and no missed uniform collectives);
+  rank-dependent unknowns split the world deterministically (low half
+  true) — a divergence is then reported only if the branches actually
+  disagree about collectives, which is strictly more precise than the
+  lexical rank-conditional rule;
+* loops unroll concretely up to MAX_UNROLL iterations, else run once
+  with the target unknown; user functions (local imports included)
+  inline to MAX_DEPTH with recursion cut off; everything is capped by
+  a step budget so the verifier always terminates.
+
+Exceptional control flow (``raise``, ``except`` bodies) is out of
+scope: ``try`` bodies and ``finally`` run, handlers do not.
+"""
+
+import ast
+import os
+
+from .walker import (COLLECTIVES, INITIAL_BROADCASTS, _call_base_attr,
+                     _is_hvd_base, collective_call_name)
+
+MAX_DEPTH = 10        # user-function inline depth
+MAX_UNROLL = 8        # concrete loop iterations explored
+MAX_STEPS = 60000     # AST-node evaluation budget per symbolic rank
+MAX_EVENTS = 2048     # schedule length cap per symbolic rank
+
+# hvd informational calls the executor evaluates concretely for the
+# symbolic world (single symbolic host: local == world, cross == 1).
+_INFO_FUNCS = {"rank", "local_rank", "cross_rank", "size", "local_size",
+               "cross_size", "is_initialized", "is_homogeneous"}
+
+# Optimizer-ish methods that stand for "run the wrapped gradient
+# allreduce now" on a DistributedOptimizer / DistributedGradientTape.
+_OPT_STEP_METHODS = {"update", "apply_gradients", "step", "minimize",
+                     "compute_gradients", "gradient"}
+
+
+class SymVal(object):
+    """One abstract value. kind in {"const", "group", "opt", "state",
+    "ckpt", "func", "module", "unknown"}; `rank_dep` marks values
+    derived from per-rank sources (meaningful for "unknown")."""
+
+    __slots__ = ("kind", "value", "rank_dep")
+
+    def __init__(self, kind, value=None, rank_dep=False):
+        self.kind = kind
+        self.value = value
+        self.rank_dep = rank_dep
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return "SymVal(%s, %r%s)" % (
+            self.kind, self.value, ", rank" if self.rank_dep else "")
+
+
+def const(v, rank_dep=False):
+    return SymVal("const", v, rank_dep)
+
+
+def unknown(rank_dep=False):
+    return SymVal("unknown", None, rank_dep)
+
+
+class GroupVal(object):
+    """A hvd.new_group() handle: `ranks` is the concrete member tuple
+    when the registration's rank list evaluated concretely, else None
+    (membership unknown — every check is vacuous). `label` names
+    implicit groups (model_group/batch_group) whose membership the
+    verifier cannot know but whose identity it can still compare."""
+
+    __slots__ = ("gid", "ranks", "label", "chain")
+
+    def __init__(self, gid, ranks, label, chain):
+        self.gid = gid
+        self.ranks = tuple(ranks) if ranks is not None else None
+        self.label = label
+        self.chain = chain  # call chain of the new_group() registration
+
+    def key(self):
+        """Identity for schedule comparison. The gid is part of it:
+        two registrations with IDENTICAL member lists are still two
+        distinct groups at runtime (ids come from the per-process
+        counter), so a collective issued under gA by some ranks and gB
+        by others is a mixed-group divergence, not a match. Counters
+        align across symbolic ranks whenever the registration sequence
+        is uniform — and a non-uniform sequence is itself reported via
+        the new_group schedule events."""
+        if self.ranks is not None:
+            return ("g", self.gid, self.ranks)
+        return ("g?", self.gid, self.label)
+
+    def describe(self):
+        if self.ranks is not None:
+            return "group#%d[%s]" % (
+                self.gid, ",".join(str(r) for r in self.ranks))
+        return self.label
+
+
+class OptVal(object):
+    """DistributedOptimizer / DistributedGradientTape handle carrying
+    the negotiation-relevant modes its gradient allreduce will use."""
+
+    __slots__ = ("sharded", "compression", "group", "chain", "prefix")
+
+    def __init__(self, sharded, compression, group, chain,
+                 prefix=None):
+        self.sharded = sharded          # True | False | None (unknown)
+        self.compression = compression  # str | None | "<?>"
+        self.group = group              # GroupVal | None
+        self.chain = chain
+        self.prefix = prefix            # explicit name_prefix= or None
+
+    def grads_name(self):
+        """Symbolic name for this optimizer's gradient negotiation.
+        Two optimizers with DISTINCT explicit name_prefix= values
+        negotiate disjoint tensor names at runtime, so they must not
+        collide in the per-name analyses; default-prefix optimizers
+        genuinely alias (both negotiate grad.<i>) and share the
+        placeholder."""
+        if self.prefix:
+            return "<grads:%s>" % self.prefix
+        return "<grads>"
+
+
+class Event(object):
+    """One schedule entry."""
+
+    __slots__ = ("kind", "name", "group", "compression", "sharded",
+                 "collective", "chain", "path", "line")
+
+    def __init__(self, kind, name, group=None, compression=None,
+                 sharded=False, collective=True, chain=(), path="",
+                 line=0):
+        self.kind = kind
+        self.name = name
+        self.group = group              # GroupVal | None
+        self.compression = compression
+        self.sharded = sharded
+        self.collective = collective    # False: rank-local (restore)
+        self.chain = chain              # tuple of (path, line, func)
+        self.path = path
+        self.line = line
+
+    def group_key(self):
+        return None if self.group is None else self.group.key()
+
+    def identity(self):
+        """What two ranks must agree on for this schedule slot."""
+        return (self.kind, self.name, self.group_key())
+
+    def describe(self):
+        bits = [self.kind, "'%s'" % self.name]
+        if self.group is not None:
+            bits.append("in " + self.group.describe())
+        if self.compression not in (None, "none"):
+            bits.append("compression=%s" % self.compression)
+        if self.sharded:
+            bits.append("sharded")
+        return " ".join(bits)
+
+
+class ExecFinding(object):
+    """A hazard proven during execution itself (not by diffing)."""
+
+    __slots__ = ("rule", "message", "path", "line", "end_line")
+
+    def __init__(self, rule, message, path, line, end_line=None):
+        self.rule = rule
+        self.message = message
+        self.path = path
+        self.line = line
+        self.end_line = end_line or line
+
+
+def format_chain(chain):
+    return " -> ".join("%s:%d in %s" % (os.path.basename(p), ln, fn)
+                       for p, ln, fn in chain)
+
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+class _Raise(Exception):
+    """A `raise` statement: ends the enclosing function (or module)
+    unless an enclosing `try` absorbs it — the closest sound-enough
+    approximation while handler bodies stay out of scope."""
+
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
+class _Budget(Exception):
+    """Step/event budget exhausted — stop quietly, keep what we have."""
+
+
+class Executor(object):
+    """Executes the program for ONE symbolic rank."""
+
+    def __init__(self, graph, rank, world):
+        self.graph = graph
+        self.rank = rank
+        self.world = world
+        self.events = []
+        self.findings = []
+        self.steps = 0
+        self.depth = 0
+        self.stack = ()          # call chain: tuple of (path, line, func)
+        self.inlining = ()       # (path, funcname) pairs, recursion cut
+        self.group_counter = 0
+        self.auto_counter = 0
+        self.truncated = False
+        self._module_envs = {}   # realpath -> env dict (top-level run once)
+
+    # -- entry ------------------------------------------------------------
+
+    def run(self):
+        entry = self.graph.entry
+        env = self._fresh_module_env(entry)
+        self._module_envs[os.path.realpath(entry.path)] = env
+        try:
+            self._exec_body(entry.tree.body, env, entry, "<module>")
+        except _Budget:
+            self.truncated = True
+        except (_Return, _Raise, _Break, _Continue):
+            pass  # stray signals at top level
+        return self.events, self.findings
+
+    def _fresh_module_env(self, module):
+        return {"__name__": const(module.run_name),
+                "__file__": const(module.path)}
+
+    def _module_env(self, module):
+        """Top-level of a local import runs once per symbolic rank; the
+        resulting globals are shared by later imports (Python
+        semantics) and by calls into its functions."""
+        real = os.path.realpath(module.path)
+        env = self._module_envs.get(real)
+        if env is None:
+            env = self._fresh_module_env(module)
+            self._module_envs[real] = env  # pre-bind: import cycles stop
+            try:
+                self._exec_body(module.tree.body, env, module, "<module>")
+            except (_Return, _Raise, _Break, _Continue):
+                pass
+        return env
+
+    def _tick(self):
+        self.steps += 1
+        if self.steps > MAX_STEPS or len(self.events) > MAX_EVENTS:
+            raise _Budget()
+
+    # -- statements -------------------------------------------------------
+
+    def _exec_body(self, body, env, module, funcname):
+        for stmt in body:
+            self._exec_stmt(stmt, env, module, funcname)
+
+    def _exec_stmt(self, node, env, module, funcname):
+        self._tick()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            from .callgraph import FunctionInfo
+            env[node.name] = SymVal(
+                "func", FunctionInfo(node.name, node, module))
+        elif isinstance(node, ast.ClassDef):
+            env[node.name] = unknown()
+        elif isinstance(node, ast.Import):
+            self._exec_import(node, env, module, funcname)
+        elif isinstance(node, ast.ImportFrom):
+            self._exec_import_from(node, env, module, funcname)
+        elif isinstance(node, ast.Assign):
+            # Literal tuple unpack binds element-wise in one pass:
+            # `r, n = hvd.rank(), hvd.size()` must taint r but NOT n
+            # (a folded const tuple only knows a combined taint), and
+            # the elements must be evaluated exactly once (they may
+            # emit events).
+            if len(node.targets) == 1 and \
+                    isinstance(node.targets[0], (ast.Tuple, ast.List)) \
+                    and isinstance(node.value, (ast.Tuple, ast.List)) \
+                    and len(node.targets[0].elts) == \
+                    len(node.value.elts):
+                for tgt, val_node in zip(node.targets[0].elts,
+                                         node.value.elts):
+                    self._bind(tgt,
+                               self._eval(val_node, env, module,
+                                          funcname),
+                               None, env, module, funcname)
+            else:
+                value = self._eval(node.value, env, module, funcname)
+                for target in node.targets:
+                    self._bind(target, value, node.value, env, module,
+                               funcname)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                value = self._eval(node.value, env, module, funcname)
+                self._bind(node.target, value, node.value, env, module,
+                           funcname)
+        elif isinstance(node, ast.AugAssign):
+            value = self._eval(node.value, env, module, funcname)
+            if isinstance(node.target, ast.Name):
+                old = env.get(node.target.id, unknown())
+                env[node.target.id] = self._binop_val(old, node.op, value)
+        elif isinstance(node, ast.Expr):
+            self._eval(node.value, env, module, funcname)
+        elif isinstance(node, ast.If):
+            self._exec_if(node, env, module, funcname)
+        elif isinstance(node, ast.While):
+            self._exec_while(node, env, module, funcname)
+        elif isinstance(node, ast.For):
+            self._exec_for(node, env, module, funcname)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                val = self._eval(item.context_expr, env, module, funcname)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, val, item.context_expr,
+                               env, module, funcname)
+            self._exec_body(node.body, env, module, funcname)
+        elif isinstance(node, ast.Try):
+            try:
+                self._exec_body(node.body, env, module, funcname)
+                # `else:` runs on the normal path — the path the
+                # executor models
+                self._exec_body(node.orelse, env, module, funcname)
+            except _Raise:
+                pass  # assume some handler catches; handlers not run
+            finally:
+                self._exec_body(node.finalbody, env, module, funcname)
+        elif isinstance(node, ast.Return):
+            value = const(None)
+            if node.value is not None:
+                value = self._eval(node.value, env, module, funcname)
+            raise _Return(value)
+        elif isinstance(node, ast.Raise):
+            if node.exc is not None:
+                self._eval(node.exc, env, module, funcname)
+            raise _Raise()
+        elif isinstance(node, ast.Break):
+            raise _Break()
+        elif isinstance(node, ast.Continue):
+            raise _Continue()
+        elif isinstance(node, ast.Assert):
+            self._eval(node.test, env, module, funcname)
+        elif isinstance(node, (ast.Delete, ast.Global, ast.Nonlocal,
+                               ast.Pass)):
+            pass
+        # anything else (Match, etc.): evaluated conservatively as no-op
+
+    def _imported_env(self, local, node, module, funcname):
+        """Runs (once) and returns a local import's module env with the
+        IMPORT SITE on the chain, so collectives at the imported
+        module's top level anchor at the entry file's import line
+        (where a suppression can actually reach them)."""
+        old = self.stack
+        self.stack = self.stack + (self._site(node, module, funcname),)
+        try:
+            return self._module_env(local)
+        finally:
+            self.stack = old
+
+    def _exec_import(self, node, env, module, funcname):
+        for alias in node.names:
+            local = self.graph.load_local(module.directory, alias.name)
+            bound = alias.asname or alias.name.split(".")[0]
+            if local is not None:
+                # run its top level (events!)
+                self._imported_env(local, node, module, funcname)
+                env[bound] = SymVal("module", local)
+            # hvd/3rd-party imports: the walker model already indexed
+            # the aliases; names stay unbound (syntactic resolution).
+
+    def _exec_import_from(self, node, env, module, funcname):
+        if node.module is None or node.level:
+            # relative import: resolve against this module's directory
+            modname = node.module or ""
+            local = self.graph.load_local(module.directory, modname) \
+                if modname else None
+        else:
+            local = self.graph.load_local(module.directory, node.module)
+        if local is None:
+            return
+        menv = self._imported_env(local, node, module, funcname)
+        for alias in node.names:
+            if alias.name == "*":
+                for k, v in menv.items():
+                    if not k.startswith("__"):
+                        env[k] = v
+                continue
+            bound = alias.asname or alias.name
+            if alias.name in menv:
+                env[bound] = menv[alias.name]
+            else:
+                sub = self.graph.load_local(
+                    os.path.join(local.directory), alias.name)
+                if sub is not None:
+                    self._imported_env(sub, node, module, funcname)
+                    env[bound] = SymVal("module", sub)
+                else:
+                    env[bound] = unknown()
+
+    def _bind(self, target, value, value_node, env, module, funcname):
+        if isinstance(target, ast.Name):
+            env[target.id] = value
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            # (literal-tuple unpacks are handled element-wise by the
+            # Assign statement itself; this path sees computed values)
+            elts = None
+            if value.kind == "const" and \
+                    isinstance(value.value, (tuple, list)) and \
+                    len(value.value) == len(target.elts):
+                elts = [const(v, value.rank_dep) for v in value.value]
+            for i, sub in enumerate(target.elts):
+                self._bind(sub, elts[i] if elts is not None
+                           else unknown(value.rank_dep),
+                           None, env, module, funcname)
+        # attribute/subscript targets: state mutation we do not model
+
+    # -- control flow -----------------------------------------------------
+
+    def _truth(self, val):
+        """True/False when decidable, else None."""
+        if val.kind == "const":
+            try:
+                return bool(val.value)
+            except Exception:
+                return None
+        if val.kind in ("group", "opt", "optunion", "state", "ckpt",
+                        "func", "module"):
+            return True
+        return None
+
+    def _exec_if(self, node, env, module, funcname):
+        test = self._eval(node.test, env, module, funcname)
+        decision = self._truth(test)
+        if decision is True:
+            self._exec_body(node.body, env, module, funcname)
+        elif decision is False:
+            self._exec_body(node.orelse, env, module, funcname)
+        elif test.rank_dep:
+            # Undecidable but rank-derived: split the symbolic world
+            # deterministically. If the two halves' schedules agree the
+            # branch was harmless; if not, the diff names it.
+            if self.rank < (self.world + 1) // 2:
+                self._exec_body(node.body, env, module, funcname)
+            else:
+                self._exec_body(node.orelse, env, module, funcname)
+        else:
+            # Uniform unknown: every rank makes the SAME choice at run
+            # time, whichever it is. Executing both arms in order keeps
+            # the schedules rank-identical while still surfacing each
+            # arm's collectives for the per-name mode/kind analyses.
+            # Each arm runs on its own env copy and the results merge,
+            # so `opt = DistributedOptimizer(..., sharded_update=True)`
+            # in one arm vs a replicated one in the other survives as
+            # an either-of value the later opt.step() can expand.
+            env_a = dict(env)
+            env_b = dict(env)
+            self._exec_body(node.body, env_a, module, funcname)
+            self._exec_body(node.orelse, env_b, module, funcname)
+            self._merge_envs(env, env_a, env_b)
+
+    @staticmethod
+    def _vals_equal(a, b):
+        if a is b:
+            return True
+        if a.kind != b.kind:
+            return False
+        if a.kind == "const":
+            try:
+                return a.value == b.value and a.rank_dep == b.rank_dep
+            except Exception:
+                return False
+        if a.kind == "unknown":
+            return a.rank_dep == b.rank_dep
+        return a.value is b.value
+
+    def _merge_envs(self, env, env_a, env_b):
+        for key in set(env_a) | set(env_b):
+            va, vb = env_a.get(key), env_b.get(key)
+            if va is None or vb is None:
+                env[key] = va or vb
+            elif self._vals_equal(va, vb):
+                env[key] = va
+            elif va.kind == "opt" and vb.kind == "opt":
+                env[key] = SymVal("optunion", (va.value, vb.value))
+            else:
+                env[key] = unknown(va.rank_dep or vb.rank_dep)
+
+    def _exec_while(self, node, env, module, funcname):
+        test = self._eval(node.test, env, module, funcname)
+        if self._truth(test) is False:
+            self._exec_body(node.orelse, env, module, funcname)
+            return
+        try:
+            self._exec_body(node.body, env, module, funcname)  # one pass
+        except _Break:
+            return
+        except _Continue:
+            pass
+        self._exec_body(node.orelse, env, module, funcname)
+
+    def _iter_items(self, val):
+        """Concrete iteration values, or None when unknown."""
+        if val.kind != "const":
+            return None
+        v = val.value
+        if isinstance(v, (list, tuple)):
+            return list(v)
+        if isinstance(v, range):
+            return list(v)
+        if isinstance(v, dict):
+            return list(v.keys())
+        if isinstance(v, str):
+            return list(v)
+        return None
+
+    def _exec_for(self, node, env, module, funcname):
+        it = self._eval(node.iter, env, module, funcname)
+        items = self._iter_items(it)
+        broke = False
+        if items is None:
+            self._bind(node.target, unknown(it.rank_dep), None, env,
+                       module, funcname)
+            try:
+                self._exec_body(node.body, env, module, funcname)
+            except _Break:
+                broke = True
+            except _Continue:
+                pass
+        else:
+            for item in items[:MAX_UNROLL]:
+                self._bind(node.target,
+                           item if isinstance(item, SymVal)
+                           else const(item, it.rank_dep),
+                           None, env, module, funcname)
+                try:
+                    self._exec_body(node.body, env, module, funcname)
+                except _Break:
+                    broke = True
+                    break
+                except _Continue:
+                    continue
+        if not broke:
+            self._exec_body(node.orelse, env, module, funcname)
+
+    # -- expressions ------------------------------------------------------
+
+    def _eval(self, node, env, module, funcname):
+        self._tick()
+        if node is None:
+            return const(None)
+        if isinstance(node, ast.Constant):
+            return const(node.value)
+        if isinstance(node, ast.Name):
+            if node.id in env:
+                return env[node.id]
+            menv = self._module_envs.get(os.path.realpath(module.path))
+            if menv is not None and menv is not env and node.id in menv:
+                return menv[node.id]
+            if node.id in module.functions:
+                return SymVal("func", module.functions[node.id])
+            return unknown()
+        if isinstance(node, (ast.Tuple, ast.List)):
+            vals = [self._eval(e, env, module, funcname)
+                    for e in node.elts]
+            if all(v.kind == "const" for v in vals):
+                seq = [v.value for v in vals]
+                return const(tuple(seq) if isinstance(node, ast.Tuple)
+                             else seq,
+                             any(v.rank_dep for v in vals))
+            return unknown(any(v.rank_dep for v in vals))
+        if isinstance(node, ast.Set):
+            for e in node.elts:
+                self._eval(e, env, module, funcname)
+            return unknown()
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if k is not None:
+                    self._eval(k, env, module, funcname)
+            for v in node.values:
+                self._eval(v, env, module, funcname)
+            return unknown()
+        if isinstance(node, ast.JoinedStr):
+            return self._eval_fstring(node, env, module, funcname)
+        if isinstance(node, ast.FormattedValue):
+            return self._eval(node.value, env, module, funcname)
+        if isinstance(node, ast.BinOp):
+            left = self._eval(node.left, env, module, funcname)
+            right = self._eval(node.right, env, module, funcname)
+            return self._binop_val(left, node.op, right)
+        if isinstance(node, ast.UnaryOp):
+            val = self._eval(node.operand, env, module, funcname)
+            if val.kind == "const":
+                try:
+                    if isinstance(node.op, ast.Not):
+                        return const(not val.value, val.rank_dep)
+                    if isinstance(node.op, ast.USub):
+                        return const(-val.value, val.rank_dep)
+                    if isinstance(node.op, ast.UAdd):
+                        return const(+val.value, val.rank_dep)
+                except Exception:
+                    pass
+            return unknown(val.rank_dep)
+        if isinstance(node, ast.BoolOp):
+            return self._eval_boolop(node, env, module, funcname)
+        if isinstance(node, ast.Compare):
+            return self._eval_compare(node, env, module, funcname)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env, module, funcname)
+        if isinstance(node, ast.Attribute):
+            return self._eval_attribute(node, env, module, funcname)
+        if isinstance(node, ast.Subscript):
+            return self._eval_subscript(node, env, module, funcname)
+        if isinstance(node, ast.IfExp):
+            test = self._eval(node.test, env, module, funcname)
+            decision = self._truth(test)
+            if decision is True:
+                return self._eval(node.body, env, module, funcname)
+            if decision is False:
+                return self._eval(node.orelse, env, module, funcname)
+            if test.rank_dep:
+                branch = node.body if self.rank < (self.world + 1) // 2 \
+                    else node.orelse
+                val = self._eval(branch, env, module, funcname)
+                return SymVal(val.kind, val.value, True) \
+                    if val.kind == "const" else unknown(True)
+            a = self._eval(node.body, env, module, funcname)
+            b = self._eval(node.orelse, env, module, funcname)
+            if a.kind == "const" and b.kind == "const" and \
+                    a.value == b.value:
+                return a
+            return unknown(a.rank_dep or b.rank_dep)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self._eval_comp(node, env, module, funcname)
+        if isinstance(node, ast.DictComp):
+            return unknown()
+        if isinstance(node, ast.Lambda):
+            return unknown()
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value, env, module, funcname)
+        if isinstance(node, ast.Slice):
+            return unknown()
+        if isinstance(node, ast.Await):
+            return self._eval(node.value, env, module, funcname)
+        return unknown()
+
+    def _eval_fstring(self, node, env, module, funcname):
+        parts = []
+        rank_dep = False
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+                continue
+            val = self._eval(piece.value, env, module, funcname)
+            rank_dep = rank_dep or val.rank_dep
+            if val.kind == "const":
+                parts.append(str(val.value))
+            elif val.rank_dep:
+                # A rank-tainted unknown in a collective name: make the
+                # symbolic names differ across ranks so the schedule
+                # diff exposes it (mirrors the lexical
+                # rank-dependent-name rule interprocedurally).
+                parts.append("<?r%d>" % self.rank)
+            else:
+                parts.append("<?>")
+        return const("".join(parts), rank_dep)
+
+    def _binop_val(self, left, op, right):
+        rank_dep = left.rank_dep or right.rank_dep
+        if left.kind == "const" and right.kind == "const":
+            try:
+                lv, rv = left.value, right.value
+                if isinstance(op, ast.Add):
+                    return const(lv + rv, rank_dep)
+                if isinstance(op, ast.Sub):
+                    return const(lv - rv, rank_dep)
+                if isinstance(op, ast.Mult):
+                    return const(lv * rv, rank_dep)
+                if isinstance(op, ast.Div):
+                    return const(lv / rv, rank_dep)
+                if isinstance(op, ast.FloorDiv):
+                    return const(lv // rv, rank_dep)
+                if isinstance(op, ast.Mod):
+                    return const(lv % rv, rank_dep)
+                if isinstance(op, ast.Pow):
+                    return const(lv ** rv, rank_dep)
+                if isinstance(op, ast.BitAnd):
+                    return const(lv & rv, rank_dep)
+                if isinstance(op, ast.BitOr):
+                    return const(lv | rv, rank_dep)
+            except Exception:
+                return unknown(rank_dep)
+        # "prefix.%s" % unknown-rank-dep: keep the divergence visible.
+        if isinstance(op, ast.Mod) and left.kind == "const" and \
+                isinstance(left.value, str):
+            filler = "<?r%d>" % self.rank if right.rank_dep else "<?>"
+            try:
+                n = left.value.count("%") - 2 * left.value.count("%%")
+                return const(left.value.replace("%%", "%")
+                             .replace("%d", filler).replace("%s", filler)
+                             .replace("%i", filler) if n else left.value,
+                             rank_dep)
+            except Exception:
+                return unknown(rank_dep)
+        return unknown(rank_dep)
+
+    def _eval_boolop(self, node, env, module, funcname):
+        """Python semantics: `or`/`and` return an OPERAND, not a bool
+        — `args.name or "grad.w"` must evaluate to the operand value
+        (collective names routinely use the idiom). Left-to-right:
+        the first operand with an undecidable truth makes the result
+        unknown; a deciding operand's VALUE is returned only when
+        every operand before it decided the other way."""
+        # Lazy, left-to-right: once an operand DECIDES the result, the
+        # remaining operands are not evaluated at all — at runtime they
+        # never run, so any collectives inside them must not leak into
+        # this rank's schedule (`rank() != 0 and hvd.allreduce(...)`
+        # short-circuits on rank 0). Undecidable operands keep the scan
+        # going (their successors may or may not run; evaluating them
+        # is the same every-rank-does-the-same convention as
+        # uniform-unknown branches).
+        want_continue = isinstance(node.op, ast.And)  # And: skip Trues
+        rank_dep = False
+        for i, sub in enumerate(node.values):
+            val = self._eval(sub, env, module, funcname)
+            rank_dep = rank_dep or val.rank_dep
+            if i == len(node.values) - 1:
+                break
+            t = self._truth(val)
+            if t is None:
+                continue
+            if t is not want_continue:
+                # short-circuit: `and` stops at the first False,
+                # `or` at the first True — returning that operand
+                return SymVal(val.kind, val.value, rank_dep) \
+                    if val.kind == "const" else val
+        if val.kind == "const":
+            return SymVal("const", val.value, rank_dep)
+        if val.kind == "unknown":
+            # `rank-ish and unknown` is still rank-derived: the taint
+            # of every operand reaches the result
+            return unknown(rank_dep)
+        return val
+
+    def _eval_compare(self, node, env, module, funcname):
+        left = self._eval(node.left, env, module, funcname)
+        rank_dep = left.rank_dep
+        result = True
+        known = left.kind == "const"
+        prev = left
+        for op, comp in zip(node.ops, node.comparators):
+            cur = self._eval(comp, env, module, funcname)
+            rank_dep = rank_dep or cur.rank_dep
+            if not (known and cur.kind == "const"):
+                known = False
+                prev = cur
+                continue
+            try:
+                lv, rv = prev.value, cur.value
+                if isinstance(op, ast.Eq):
+                    ok = lv == rv
+                elif isinstance(op, ast.NotEq):
+                    ok = lv != rv
+                elif isinstance(op, ast.Lt):
+                    ok = lv < rv
+                elif isinstance(op, ast.LtE):
+                    ok = lv <= rv
+                elif isinstance(op, ast.Gt):
+                    ok = lv > rv
+                elif isinstance(op, ast.GtE):
+                    ok = lv >= rv
+                elif isinstance(op, ast.In):
+                    ok = lv in rv
+                elif isinstance(op, ast.NotIn):
+                    ok = lv not in rv
+                elif isinstance(op, ast.Is):
+                    ok = lv is rv or lv == rv
+                elif isinstance(op, ast.IsNot):
+                    ok = not (lv is rv or lv == rv)
+                else:
+                    known = False
+                    prev = cur
+                    continue
+                result = result and ok
+            except Exception:
+                known = False
+            prev = cur
+        if known:
+            return const(result, rank_dep)
+        return unknown(rank_dep)
+
+    def _eval_subscript(self, node, env, module, funcname):
+        base = self._eval(node.value, env, module, funcname)
+        if isinstance(node.slice, ast.Slice):
+            for part in (node.slice.lower, node.slice.upper,
+                         node.slice.step):
+                if part is not None:
+                    self._eval(part, env, module, funcname)
+            return unknown(base.rank_dep)
+        idx = self._eval(node.slice, env, module, funcname)
+        rank_dep = base.rank_dep or idx.rank_dep
+        if base.kind == "const" and idx.kind == "const":
+            try:
+                return const(base.value[idx.value], rank_dep)
+            except Exception:
+                return unknown(rank_dep)
+        return unknown(rank_dep)
+
+    def _eval_attribute(self, node, env, module, funcname):
+        base = self._eval(node.value, env, module, funcname)
+        if base.kind == "module":
+            minfo = base.value
+            menv = self._module_env(minfo)
+            if node.attr in menv:
+                return menv[node.attr]
+            if node.attr in minfo.functions:
+                return SymVal("func", minfo.functions[node.attr])
+            return unknown()
+        if base.kind == "group":
+            if node.attr == "ranks":
+                return const(base.value.ranks) \
+                    if base.value.ranks is not None else unknown()
+            if node.attr == "id":
+                return const(base.value.gid)
+        return unknown(base.rank_dep)
+
+    def _eval_comp(self, node, env, module, funcname):
+        """Single-generator comprehensions over concrete iterables
+        evaluate concretely (new_group/name lists); the rest degrade."""
+        if len(node.generators) != 1 or node.generators[0].ifs or \
+                node.generators[0].is_async:
+            return unknown()
+        gen = node.generators[0]
+        it = self._eval(gen.iter, env, module, funcname)
+        items = self._iter_items(it)
+        if items is None:
+            self._bind(gen.target, unknown(it.rank_dep), None, env,
+                       module, funcname)
+            self._eval(node.elt, env, module, funcname)
+            return unknown(it.rank_dep)
+        out = []
+        ok = True
+        for item in items[:MAX_UNROLL]:
+            self._bind(gen.target, const(item, it.rank_dep), None, env,
+                       module, funcname)
+            val = self._eval(node.elt, env, module, funcname)
+            if val.kind == "const":
+                out.append(val.value)
+            else:
+                ok = False
+        if ok and len(items) <= MAX_UNROLL:
+            return const(out, it.rank_dep)
+        return unknown(it.rank_dep)
+
+    # -- calls ------------------------------------------------------------
+
+    def _eval_call(self, node, env, module, funcname):
+        # Evaluate arguments first, IN ORDER — nested collective calls
+        # inside argument lists must land in the schedule before the
+        # outer call acts.
+        args = [self._eval(a, env, module, funcname) for a in node.args]
+        kwargs = {}
+        for kw in node.keywords:
+            val = self._eval(kw.value, env, module, funcname)
+            if kw.arg:
+                kwargs[kw.arg] = val
+
+        model = module.model
+        cname = collective_call_name(model, node)
+        base, attr = _call_base_attr(node.func)
+        hvd_call = base is not None and _is_hvd_base(model, base) or \
+            (base is None and attr in model.hvd_members)
+
+        # 1. hvd informational/topology calls -> concrete values.
+        if hvd_call and attr in _INFO_FUNCS:
+            return self._info_value(attr)
+        # 2. hvd structural constructors.
+        if hvd_call and attr == "new_group":
+            return self._make_group(node, args, kwargs, module,
+                                    funcname)
+        if hvd_call and attr in ("model_group", "batch_group"):
+            return SymVal("group", self._implicit_group(attr))
+        if cname in ("DistributedOptimizer", "DistributedGradientTape"):
+            return self._make_opt(node, kwargs, module, funcname)
+        if hvd_call and (attr or "").endswith("State"):
+            return SymVal("state", None)
+        if hvd_call and attr == "DurableCheckpointer":
+            return SymVal("ckpt", None)
+        if hvd_call and attr == "run" and args and \
+                args[0].kind == "func":
+            return args[0]  # hvd.elastic.run(train) decorator-as-call
+        # The receiver of an attribute call is evaluated exactly ONCE
+        # (its expression may itself contain collective calls — they
+        # must land in the schedule a single time).
+        receiver = None
+        if isinstance(node.func, ast.Attribute):
+            receiver = self._eval(node.func.value, env, module, funcname)
+        # 3. collectives (and commit/sync/checkpoint entry points).
+        if cname is not None:
+            return self._emit_collective(cname, node, args, kwargs,
+                                         receiver, env, module, funcname)
+        # 4. receiver-dispatched methods (opt.step, state.restore,
+        #    g.rank(), mod.helper()).
+        if receiver is not None:
+            handled = self._method_call(receiver, attr, node, args,
+                                        kwargs, module, funcname)
+            if handled is not None:
+                return handled
+            if receiver.kind == "module":
+                minfo = receiver.value
+                menv = self._module_env(minfo)
+                target = menv.get(attr)
+                if target is None and attr in minfo.functions:
+                    target = SymVal("func", minfo.functions[attr])
+                if target is not None and target.kind == "func":
+                    return self._inline(target.value, node, args,
+                                        kwargs, module, funcname)
+            if receiver.kind == "func":
+                return self._inline(receiver.value, node, args, kwargs,
+                                    module, funcname)
+            # str methods on consts: "g.{}".format(...) / "-".join(...)
+            if attr in ("format", "join"):
+                return self._str_method(receiver, attr, args)
+        # 5. user functions and builtins by name.
+        if isinstance(node.func, ast.Name):
+            target = env.get(node.func.id)
+            if target is None:
+                menv = self._module_envs.get(
+                    os.path.realpath(module.path))
+                if menv is not None and menv is not env:
+                    target = menv.get(node.func.id)
+            if target is None and node.func.id in module.functions:
+                target = SymVal("func", module.functions[node.func.id])
+            if target is not None and target.kind == "func":
+                return self._inline(target.value, node, args, kwargs,
+                                    module, funcname)
+            builtin = self._eval_builtin(node.func.id, args, kwargs)
+            if builtin is not None:
+                return builtin
+        return unknown(any(a.rank_dep for a in args) or
+                       any(v.rank_dep for v in kwargs.values()))
+
+    def _info_value(self, attr):
+        # One symbolic host: local == world, cross == 1. Rank values
+        # carry the rank_dep taint so rank-derived UNKNOWNS (e.g.
+        # `table[hvd.rank()]` with an opaque table) still trigger the
+        # world-split branch in _exec_if; decidable predicates are
+        # unaffected (const-ness is checked before the taint).
+        if attr in ("rank", "local_rank"):
+            return const(self.rank, rank_dep=True)
+        if attr == "cross_rank":
+            return const(0, rank_dep=True)
+        if attr in ("size", "local_size"):
+            return const(self.world)
+        if attr == "cross_size":
+            return const(1)
+        return const(True)  # is_initialized / is_homogeneous
+
+    def _site(self, node, module, funcname):
+        return (module.path, getattr(node, "lineno", 1), funcname)
+
+    def _chain(self, node, module, funcname):
+        return self.stack + (self._site(node, module, funcname),)
+
+    def _make_group(self, node, args, kwargs, module, funcname):
+        self.group_counter += 1
+        ranks = None
+        # groups.py: new_group(ranks) — the keyword spelling is valid
+        ranks_val = args[0] if args else kwargs.get("ranks")
+        if ranks_val is not None:
+            items = self._iter_items(ranks_val)
+            if items is not None and not ranks_val.rank_dep and \
+                    all(isinstance(i, int) for i in items):
+                ranks = tuple(sorted(items))
+        chain = self._chain(node, module, funcname)
+        group = GroupVal(self.group_counter, ranks,
+                         "group#%d" % self.group_counter, chain)
+        # Registration IS ordering-relevant: every rank must call
+        # new_group with the same lists in the same order.
+        name = "new_group#%d" % self.group_counter
+        if ranks is not None:
+            name = "new_group[%s]" % ",".join(str(r) for r in ranks)
+        elif ranks_val is not None and ranks_val.rank_dep:
+            name = "new_group[<?r%d>]" % self.rank
+        self._push_event(Event(
+            "new_group", name, group=None, collective=True,
+            chain=chain, path=module.path,
+            line=getattr(node, "lineno", 1)))
+        return SymVal("group", group)
+
+    def _implicit_group(self, label):
+        self.group_counter += 1
+        return GroupVal(self.group_counter, None, label, self.stack)
+
+    def _make_opt(self, node, kwargs, module, funcname):
+        sharded = False
+        su = kwargs.get("sharded_update")
+        if su is not None:
+            if su.kind == "const":
+                sharded = bool(su.value)
+            else:
+                sharded = None  # dynamic
+        compression = None
+        comp = kwargs.get("compression")
+        if comp is not None:
+            if comp.kind == "const":
+                compression = comp.value
+            else:
+                compression = "<?>"
+        group = None
+        g = kwargs.get("group")
+        if g is not None and g.kind == "group":
+            group = g.value
+        prefix = None
+        pf = kwargs.get("name_prefix")
+        if pf is not None and pf.kind == "const":
+            prefix = str(pf.value)
+        return SymVal("opt", OptVal(
+            sharded, compression, group,
+            self._chain(node, module, funcname), prefix=prefix))
+
+    def _method_call(self, receiver, attr, node, args, kwargs, module,
+                     funcname):
+        """Returns a SymVal when the method call was modeled, else None."""
+        if receiver.kind in ("opt", "optunion") and \
+                attr in _OPT_STEP_METHODS:
+            opts = receiver.value if receiver.kind == "optunion" \
+                else (receiver.value,)
+            for opt in opts:
+                self._push_event(Event(
+                    "allreduce", opt.grads_name(), group=opt.group,
+                    compression=opt.compression,
+                    sharded=opt.sharded,  # True | False | None (dynamic)
+                    collective=True,
+                    chain=opt.chain if len(opts) > 1
+                    else self._chain(node, module, funcname),
+                    path=module.path, line=getattr(node, "lineno", 1)))
+            if attr == "update":
+                return const((None, None))  # (updates, new_state) shape
+            return unknown()
+        if receiver.kind == "state":
+            if attr == "restore":
+                self._push_event(Event(
+                    "restore", "<state>", collective=False,
+                    chain=self._chain(node, module, funcname),
+                    path=module.path, line=getattr(node, "lineno", 1)))
+                return const(None)
+            if attr in ("save", "check_host_updates", "check_drain",
+                        "register"):
+                return const(None)
+        if receiver.kind == "ckpt" and attr == "restore_into":
+            self._push_event(Event(
+                "restore", "<durable>", collective=False,
+                chain=self._chain(node, module, funcname),
+                path=module.path, line=getattr(node, "lineno", 1)))
+            return unknown()
+        if receiver.kind == "group":
+            g = receiver.value
+            if attr == "rank":
+                # rank_dep taint, like hvd.rank(): opaque lookups fed
+                # by a group position must still split the world
+                if g.ranks is not None:
+                    pos = g.ranks.index(self.rank) \
+                        if self.rank in g.ranks else -1
+                    return const(pos, rank_dep=True)
+                return unknown(True)
+            if attr == "size":
+                if g.ranks is not None:
+                    return const(len(g.ranks))
+                return unknown()
+        return None
+
+    def _str_method(self, recv, attr, args):
+        if recv.kind != "const" or not isinstance(recv.value, str):
+            return unknown(recv.rank_dep or
+                           any(a.rank_dep for a in args))
+        rank_dep = recv.rank_dep or any(a.rank_dep for a in args)
+        if attr == "format":
+            out = recv.value
+            for a in args:
+                filler = str(a.value) if a.kind == "const" else (
+                    "<?r%d>" % self.rank if a.rank_dep else "<?>")
+                out = out.replace("{}", filler, 1)
+            return const(out, rank_dep)
+        if attr == "join" and args:
+            items = self._iter_items(args[0])
+            if items is not None:
+                return const(recv.value.join(str(i) for i in items),
+                             rank_dep)
+        return unknown(rank_dep)
+
+    def _eval_builtin(self, name, args, kwargs):
+        rank_dep = any(a.rank_dep for a in args)
+        consts = [a.value for a in args if a.kind == "const"]
+        all_const = len(consts) == len(args) and not kwargs
+        try:
+            if name == "range" and all_const and args:
+                return const(range(*consts), rank_dep)
+            if name == "len" and all_const and args:
+                return const(len(consts[0]), rank_dep)
+            if name == "sorted" and all_const and args:
+                return const(sorted(consts[0]), rank_dep)
+            if name == "list" and all_const:
+                return const(list(consts[0]) if consts else [], rank_dep)
+            if name == "tuple" and all_const:
+                return const(tuple(consts[0]) if consts else (),
+                             rank_dep)
+            if name in ("int", "str", "float", "bool") and all_const \
+                    and len(consts) == 1:
+                return const({"int": int, "str": str, "float": float,
+                              "bool": bool}[name](consts[0]), rank_dep)
+            if name in ("min", "max") and all_const and args:
+                fn = min if name == "min" else max
+                if len(consts) == 1:
+                    return const(fn(consts[0]), rank_dep)
+                return const(fn(consts), rank_dep)
+            if name == "enumerate" and all_const and args:
+                return const(list(enumerate(consts[0])), rank_dep)
+            if name == "print":
+                return const(None)
+        except Exception:
+            return unknown(rank_dep)
+        return None
+
+    def _inline(self, finfo, node, args, kwargs, module, funcname):
+        """Bounded inlining of a user function with the call site
+        recorded on the chain. Recursion and over-depth calls degrade
+        to unknown."""
+        key = (finfo.module.path, finfo.name)
+        if self.depth >= MAX_DEPTH or key in self.inlining:
+            return unknown()
+        site = self._site(node, module, funcname)
+        # The callee's globals are its module's env; locals start from
+        # a copy so callee assignments never leak back.
+        fenv = dict(self._module_env(finfo.module))
+        self._bind_params(finfo, fenv, args, kwargs, module, funcname)
+        self.depth += 1
+        old_stack, old_inlining = self.stack, self.inlining
+        self.stack = self.stack + (site,)
+        self.inlining = self.inlining + (key,)
+        result = const(None)
+        try:
+            self._exec_body(finfo.node.body, fenv, finfo.module,
+                            finfo.name)
+        except _Return as ret:
+            result = ret.value
+        finally:
+            self.depth -= 1
+            self.stack, self.inlining = old_stack, old_inlining
+        return result
+
+    def _bind_params(self, finfo, fenv, args, kwargs, module, funcname):
+        node = finfo.node
+        params = [a.arg for a in node.args.args]
+        posonly = getattr(node.args, "posonlyargs", [])
+        params = [a.arg for a in posonly] + params
+        defaults = node.args.defaults
+        # defaults align to the tail of params
+        for i, p in enumerate(params):
+            fenv[p] = unknown()
+        offset = len(params) - len(defaults)
+        for i, d in enumerate(defaults):
+            fenv[params[offset + i]] = self._eval(
+                d, fenv, finfo.module, finfo.name)
+        for i, a in enumerate(args):
+            if i < len(params):
+                fenv[params[i]] = a
+        for k, v in kwargs.items():
+            fenv[k] = v
+        for kwarg in node.args.kwonlyargs:
+            if kwarg.arg not in fenv:
+                fenv[kwarg.arg] = unknown()
+
+    # -- the recursion-marker stack needs the emit/real split above;
+    #    events always use self.stack at emission time -------------------
+
+    def _push_event(self, event):
+        if len(self.events) >= MAX_EVENTS:
+            raise _Budget()
+        self.events.append(event)
+
+    # -- collective emission ----------------------------------------------
+
+    _KIND = {
+        "allreduce": "allreduce", "allreduce_async": "allreduce",
+        "allreduce_gradients": "allreduce", "allreduce_sparse":
+        "allreduce", "grouped_allreduce": "allreduce",
+        "metric_average": "allreduce",
+        "reduce_scatter": "reducescatter",
+        "reduce_scatter_async": "reducescatter",
+        "allgather": "allgather", "allgather_async": "allgather",
+        "alltoall": "alltoall",
+        "broadcast": "broadcast", "broadcast_async": "broadcast",
+        "broadcast_object": "broadcast", "broadcast_parameters":
+        "broadcast", "broadcast_optimizer_state": "broadcast",
+        "broadcast_variables": "broadcast",
+        "broadcast_global_variables": "broadcast",
+        "BroadcastGlobalVariablesHook": "broadcast",
+        "BroadcastGlobalVariablesCallback": "broadcast",
+        "commit": "commit", "sync": "sync",
+        "checkpoint.save": "checkpoint.save",
+        "checkpoint.restore": "checkpoint.restore",
+    }
+
+    def _emit_collective(self, cname, node, args, kwargs, receiver, env,
+                         module, funcname):
+        if cname in ("commit", "sync") and receiver is not None and \
+                receiver.kind not in ("state", "unknown"):
+            return unknown()  # definitely not an elastic state
+        kind = self._KIND.get(cname)
+        if kind is None:
+            return unknown()
+
+        # name / name_prefix — from the ALREADY-EVALUATED kwargs (the
+        # expression may contain collective calls; re-evaluating it
+        # would duplicate their schedule events)
+        name_val = kwargs.get("name")
+        if name_val is None:
+            name_val = kwargs.get("name_prefix")
+        if name_val is None:
+            for pos in COLLECTIVES.get(cname, ()):
+                if pos < len(node.args):
+                    cand = args[pos]
+                    if cand.kind == "const" and \
+                            isinstance(cand.value, str):
+                        name_val = cand
+                        break
+                    if cand.rank_dep:
+                        name_val = cand
+                        break
+        if name_val is None:
+            if cname in ("checkpoint.save", "checkpoint.restore"):
+                # kind-qualified: save and restore are different
+                # negotiations, not one name with two kinds
+                name = "<%s>" % cname
+            elif cname in ("commit", "sync"):
+                name = "<%s>" % cname
+            elif cname in INITIAL_BROADCASTS or \
+                    cname == "broadcast_global_variables":
+                name = "<params>"
+            elif cname in ("allreduce_gradients",):
+                name = "<grads>"
+            else:
+                self.auto_counter += 1
+                name = "<auto#%d>" % self.auto_counter
+        elif name_val.kind == "const":
+            name = str(name_val.value)
+        elif name_val.rank_dep:
+            name = "<?r%d>" % self.rank
+        else:
+            name = "<?>"
+
+        group = None
+        g = kwargs.get("group")
+        if g is not None:
+            if g.kind == "group":
+                group = g.value
+            elif g.kind == "const" and g.value is None:
+                group = None
+            else:
+                self.group_counter += 1
+                group = GroupVal(self.group_counter, None, "group<?>",
+                                 self.stack)
+
+        compression = None
+        comp = kwargs.get("compression")
+        if comp is not None:
+            compression = comp.value if comp.kind == "const" else "<?>"
+
+        chain = self._chain(node, module, funcname)
+        line = getattr(node, "lineno", 1)
+
+        # Non-member reachability: a group collective on a rank outside
+        # the group's membership is the static form of the runtime
+        # "submitted by rank(s) outside the group" rejection.
+        if group is not None and group.ranks is not None and \
+                self.rank not in group.ranks:
+            anchor = chain[0]  # outermost frame: always the entry file
+            self.findings.append(ExecFinding(
+                "verify-non-member-group-call",
+                "group collective `%s` '%s' in %s is reachable on "
+                "symbolic rank %d, which is NOT a member of the group "
+                "(runtime: the coordinator rejects the report naming "
+                "the rank, or the member ranks hang waiting). Guard the "
+                "call with the group's membership. call chain: %s; "
+                "group registration chain: %s"
+                % (cname, name, group.describe(), self.rank,
+                   format_chain(chain),
+                   format_chain(group.chain) or "unknown"),
+                anchor[0], anchor[1],
+                getattr(node, "end_lineno", None)
+                if len(chain) == 1 else anchor[1]))
+            return unknown()
+
+        self._push_event(Event(
+            kind, name, group=group, compression=compression,
+            sharded=False, collective=True, chain=chain,
+            path=module.path, line=line))
+        return unknown()

@@ -35,6 +35,10 @@ COLLECTIVES = {
     "allreduce_gradients": (2,),
     "allreduce_sparse": (2,),
     "grouped_allreduce": (1,),
+    # host-ops layer: reduce_scatter(tensor, name); the jax binding
+    # takes name= at position 2
+    "reduce_scatter": (1, 2),
+    "reduce_scatter_async": (1,),
     "allgather": (1,),
     "allgather_async": (1,),
     "alltoall": (1,),
@@ -542,7 +546,7 @@ class _Walker(ast.NodeVisitor):
     # call sites ------------------------------------------------------------
 
     def visit_Call(self, node):
-        func = self._collective_name(node)
+        func = collective_call_name(self.m, node)
         if func is not None:
             name_node = self._name_argument(node, func)
             self.m.call_sites.append(CallSite(
@@ -551,38 +555,6 @@ class _Walker(ast.NodeVisitor):
                 list(node.args),
                 {kw.arg: kw.value for kw in node.keywords if kw.arg}))
         self.generic_visit(node)
-
-    def _collective_name(self, node):
-        base, attr = _call_base_attr(node.func)
-        if attr is None:
-            return None
-        interesting = (attr in COLLECTIVES or attr in TRAIN_MARKERS or
-                       attr in INITIAL_BROADCASTS)
-        if interesting:
-            if base is None:
-                if attr in self.m.hvd_members or attr in INITIAL_BROADCASTS \
-                        and attr[0].isupper():
-                    return attr
-                return None
-            if _is_hvd_base(self.m, base):
-                return attr
-            return None
-        # elastic commit points: state.commit()/state.sync() — only when the
-        # file actually uses hvd.elastic (keeps `dict.sync()`-ish code on
-        # unrelated objects out).
-        if attr in ELASTIC_COMMITS and self.m.uses_elastic and \
-                base is not None:
-            return attr
-        # checkpoint.save()/restore(): only when the receiver is the
-        # horovod checkpoint module (`from horovod_tpu.jax import
-        # checkpoint` binds it as an hvd alias; dotted access like
-        # hvd.jax.checkpoint.save resolves through the alias root) —
-        # bare `model.save(...)` / `state.save()` never match.
-        if attr in CHECKPOINT_CALLS and base is not None and \
-                (base == "checkpoint" or base.endswith(".checkpoint")) \
-                and _is_hvd_base(self.m, base):
-            return "checkpoint." + attr
-        return None
 
     def _name_argument(self, node, func):
         for kw in node.keywords:
@@ -594,6 +566,42 @@ class _Walker(ast.NodeVisitor):
                 if _looks_like_name(arg):
                     return arg
         return None
+
+
+def collective_call_name(model, node):
+    """Canonical collective name for a Call node, or None when the call
+    is not a horovod collective in `model`'s alias context. Shared by
+    the lexical walker and the hvd-verify symbolic executor."""
+    base, attr = _call_base_attr(node.func)
+    if attr is None:
+        return None
+    interesting = (attr in COLLECTIVES or attr in TRAIN_MARKERS or
+                   attr in INITIAL_BROADCASTS)
+    if interesting:
+        if base is None:
+            if attr in model.hvd_members or attr in INITIAL_BROADCASTS \
+                    and attr[0].isupper():
+                return attr
+            return None
+        if _is_hvd_base(model, base):
+            return attr
+        return None
+    # elastic commit points: state.commit()/state.sync() — only when the
+    # file actually uses hvd.elastic (keeps `dict.sync()`-ish code on
+    # unrelated objects out).
+    if attr in ELASTIC_COMMITS and model.uses_elastic and \
+            base is not None:
+        return attr
+    # checkpoint.save()/restore(): only when the receiver is the
+    # horovod checkpoint module (`from horovod_tpu.jax import
+    # checkpoint` binds it as an hvd alias; dotted access like
+    # hvd.jax.checkpoint.save resolves through the alias root) —
+    # bare `model.save(...)` / `state.save()` never match.
+    if attr in CHECKPOINT_CALLS and base is not None and \
+            (base == "checkpoint" or base.endswith(".checkpoint")) \
+            and _is_hvd_base(model, base):
+        return "checkpoint." + attr
+    return None
 
 
 def _looks_like_name(node):

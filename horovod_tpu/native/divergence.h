@@ -63,9 +63,10 @@ class CallTracker {
 
  private:
   mutable std::mutex mutex_;
-  uint64_t seq_ = 0;
-  uint64_t digest_ = 14695981039346656037ULL;  // FNV-1a offset basis
-  std::deque<CallRecord> ring_;
+  uint64_t seq_ = 0;     // guarded_by(mutex_)
+  // FNV-1a offset basis
+  uint64_t digest_ = 14695981039346656037ULL;  // guarded_by(mutex_)
+  std::deque<CallRecord> ring_;                // guarded_by(mutex_)
 };
 
 class DivergenceDetector {

@@ -133,8 +133,8 @@ class GroupTable {
   };
 
   mutable std::mutex mu_;
-  std::unordered_map<uint32_t, Entry> groups_;
-  uint32_t next_id_ = 1;
+  std::unordered_map<uint32_t, Entry> groups_;  // guarded_by(mu_)
+  uint32_t next_id_ = 1;                        // guarded_by(mu_)
 };
 
 }  // namespace hvdtpu

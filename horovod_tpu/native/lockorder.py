@@ -1,0 +1,531 @@
+"""Source-level lock-order audit for the native core (`make
+check-lockorder`).
+
+TSAN (`make check-tsan`) proves the absence of *data races it happens
+to observe*; a lock-order inversion deadlocks without racing, so TSAN's
+happened-before engine only reports it if both orders actually execute
+in one run. This pass proves the stronger static property over
+``native/*.cc`` / ``*.h`` directly:
+
+* **mutex-acquisition graph**: every RAII acquisition
+  (``std::lock_guard`` / ``std::unique_lock`` / ``std::scoped_lock``)
+  and explicit ``mu_.lock()`` is scanned per function body with brace
+  scoping; acquiring B while A is held adds edge A -> B (including
+  one level through calls to functions whose *bare name uniquely*
+  identifies a lock-acquiring function). A cycle in the graph is a
+  potential deadlock, reported with every edge's acquisition site —
+  the static analogue of the runtime's "both call sites" divergence
+  report.
+* **guard audit**: fields annotated ``// guarded_by(mu_)`` on their
+  declaration must only be touched in method bodies while that mutex
+  is held. Constructors/destructors are exempt (no concurrent access
+  before/after the object's lifetime).
+
+The parser is a token scanner, not a C++ front end: it strips comments
+and strings, tracks braces, and recognizes the repo's idioms (SURVEY
+5.2 single-background-thread discipline keeps the native core's
+locking shallow, which is exactly what makes this decidable here).
+Findings are deliberately high-confidence — `make check-lockorder`
+gates the sanitizer targets, so a false positive would block CI.
+"""
+
+import argparse
+import collections
+import os
+import re
+import sys
+
+GUARD_RE = re.compile(
+    r"\bstd::(?:lock_guard|unique_lock)\s*<[^>]*>\s*"
+    r"(?P<var>\w+)\s*[({](?P<mu>[\w.\->:]+)")
+SCOPED_RE = re.compile(
+    r"\bstd::scoped_lock\s*(?:<[^>]*>)?\s*(?P<var>\w+)\s*"
+    r"[({](?P<mus>[^;)]+)[)}]")
+BARE_LOCK_RE = re.compile(r"\b(?P<mu>[\w.\->:]+?)\.lock\(\)")
+BARE_UNLOCK_RE = re.compile(r"\b(?P<mu>[\w.\->:]+?)\.unlock\(\)")
+FUNC_START_RE = re.compile(
+    r"(?:(?P<cls>\w+(?:<[^<>]*>)?)::)?(?P<name>~?\w+)\s*\(")
+_NOT_FUNCS = {"if", "for", "while", "switch", "return", "catch",
+              "sizeof", "defined", "do", "else", "new", "delete",
+              "assert", "static_assert", "alignof", "decltype",
+              "constexpr", "throw"}
+GUARDED_BY_RE = re.compile(r"guarded_by\((?P<mu>\w+)\)", re.I)
+FIELD_DECL_RE = re.compile(r"\b(?P<field>[a-zA-Z_]\w*_)\s*[;={(\[]")
+CALL_RE = re.compile(r"\b(?P<name>[A-Z]\w+)\s*\(")
+
+Finding = collections.namedtuple(
+    "Finding", ["rule", "path", "line", "message"])
+
+
+def _strip(source):
+    """Removes comments and string/char literals (preserving line
+    structure) but first harvests `guarded_by` annotations:
+    {line_number: mutex_name}."""
+    annotations = {}
+    out = []
+    i, n = 0, len(source)
+    line = 1
+    while i < n:
+        ch = source[i]
+        if ch == "\n":
+            line += 1
+            out.append(ch)
+            i += 1
+        elif source.startswith("//", i):
+            j = source.find("\n", i)
+            j = n if j < 0 else j
+            m = GUARDED_BY_RE.search(source[i:j])
+            if m:
+                annotations[line] = m.group("mu")
+            i = j
+        elif source.startswith("/*", i):
+            j = source.find("*/", i)
+            j = n if j < 0 else j + 2
+            seg = source[i:j]
+            m = GUARDED_BY_RE.search(seg)
+            if m:
+                annotations[line] = m.group("mu")
+            line += seg.count("\n")
+            out.append("\n" * seg.count("\n"))
+            i = j
+        elif ch in "\"'":
+            quote = ch
+            j = i + 1
+            while j < n:
+                if source[j] == "\\":
+                    j += 2
+                    continue
+                if source[j] == quote:
+                    j += 1
+                    break
+                if source[j] == "\n":  # unterminated; bail on the line
+                    break
+                j += 1
+            out.append(quote + quote)
+            i = j
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out), annotations
+
+
+class Acquisition(object):
+    __slots__ = ("mutex", "depth", "var", "path", "line", "top_level")
+
+    def __init__(self, mutex, depth, var, path, line, top_level):
+        self.mutex = mutex
+        self.depth = depth
+        self.var = var
+        self.path = path
+        self.line = line
+        self.top_level = top_level
+
+
+class FunctionBody(object):
+    __slots__ = ("qualname", "cls", "name", "path", "line", "text",
+                 "start_line")
+
+    def __init__(self, qualname, cls, name, path, line, text):
+        self.qualname = qualname
+        self.cls = cls
+        self.name = name
+        self.path = path
+        self.line = line
+        self.text = text
+        self.start_line = line
+
+
+def _norm_mutex(cls, token):
+    """Canonical graph node for a mutex token: member mutexes qualify
+    by class (the same field name in two classes is two locks); locals
+    and globals keep their own name."""
+    token = token.strip().lstrip("&*")
+    token = token.replace("this->", "")
+    if token.endswith("_") and cls:
+        return "%s::%s" % (cls, token)
+    return token
+
+
+def _match_paren(text, open_idx, open_ch="(", close_ch=")"):
+    depth = 0
+    j = open_idx
+    while j < len(text):
+        if text[j] == open_ch:
+            depth += 1
+        elif text[j] == close_ch:
+            depth -= 1
+            if depth == 0:
+                return j
+        j += 1
+    return -1
+
+
+def _extract_functions(text, path):
+    """Function bodies: identifier + matched parameter parens +
+    optional const/noexcept/override/initializer-list + a brace block.
+    Declarations (`;` before `{`) and calls are rejected by the scan;
+    matches inside an already-captured body are skipped."""
+    functions = []
+    covered_end = -1
+    for m in FUNC_START_RE.finditer(text):
+        if m.start() < covered_end:
+            continue  # inside the previous function's body
+        name = m.group("name")
+        if name in _NOT_FUNCS:
+            continue
+        close = _match_paren(text, m.end() - 1)
+        if close < 0:
+            continue
+        # Walk from ')' to the body '{'; any ';' or '=' first means a
+        # declaration, call, or initializer — not a definition.
+        j = close + 1
+        open_idx = -1
+        while j < len(text):
+            ch = text[j]
+            if ch == "{":
+                open_idx = j
+                break
+            if ch in ";=":
+                break
+            if ch == "(":  # initializer-list member init `: a_(1)`
+                j = _match_paren(text, j)
+                if j < 0:
+                    break
+                j += 1
+                continue
+            j += 1
+        if open_idx < 0:
+            continue
+        end = _match_paren(text, open_idx, "{", "}")
+        if end < 0:
+            end = len(text) - 1
+        body = text[open_idx:end + 1]
+        line = text.count("\n", 0, open_idx) + 1
+        cls = m.group("cls") or _enclosing_class(text, m.start())
+        qual = "%s::%s" % (cls, name) if cls else name
+        functions.append(FunctionBody(qual, cls, name, path, line, body))
+        covered_end = end
+    return functions
+
+
+def _enclosing_class(text, pos):
+    """Best-effort: the innermost `class X {` / `struct X {` whose brace
+    block contains `pos` (inline methods in headers)."""
+    best = None
+    for m in re.finditer(r"\b(?:class|struct)\s+(\w+)[^;{]*\{", text):
+        if m.end() > pos:
+            break
+        depth = 0
+        j = m.end() - 1
+        while j < len(text):
+            if text[j] == "{":
+                depth += 1
+            elif text[j] == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        if m.end() <= pos < j:
+            best = m.group(1)
+    return best
+
+
+def _scan_function(fn):
+    """Walks one body; returns (edges, top_level_mutexes, accesses)
+    where edges are (held, acquired, path, line), top_level_mutexes the
+    locks taken while holding nothing (for one-level call edges), and
+    accesses [(token_line, held_mutex_names_set)] for the guard audit —
+    accesses is a callable mapping a regex to occurrences for
+    efficiency."""
+    text = fn.text
+    edges = []
+    top_level = []
+    held = []  # Acquisition stack
+    depth = 0
+    line = fn.start_line
+    i = 0
+    calls = []     # (name, line, held_snapshot)
+    accesses = []  # (line, frozenset(held mutex names)) per source line
+    line_held = {}
+
+    def record_line():
+        prev = line_held.get(line)
+        cur = frozenset(a.mutex for a in held)
+        line_held[line] = cur if prev is None else (prev | cur)
+
+    while i < len(text):
+        ch = text[i]
+        if ch == "\n":
+            record_line()
+            line += 1
+            i += 1
+            continue
+        if ch == "{":
+            depth += 1
+            i += 1
+            continue
+        if ch == "}":
+            depth -= 1
+            while held and held[-1].depth > depth:
+                held.pop()
+            i += 1
+            continue
+        # try the lock idioms at this position
+        m = GUARD_RE.match(text, i)
+        if m is None:
+            m2 = SCOPED_RE.match(text, i)
+            if m2 is not None:
+                for tok in m2.group("mus").split(","):
+                    _acquire(fn, tok, depth, m2.group("var"), line,
+                             held, edges, top_level)
+                i = m2.end()
+                continue
+            m3 = BARE_UNLOCK_RE.match(text, i)
+            if m3 is not None:
+                tok = _norm_mutex(fn.cls, m3.group("mu"))
+                for k in range(len(held) - 1, -1, -1):
+                    if held[k].mutex == tok or held[k].var == \
+                            m3.group("mu").strip():
+                        del held[k]
+                        break
+                i = m3.end()
+                continue
+            m4 = BARE_LOCK_RE.match(text, i)
+            if m4 is not None:
+                raw = m4.group("mu").strip()
+                # `lk.lock()` re-locks through a unique_lock var; a
+                # direct `mu_.lock()` names the mutex itself.
+                _acquire(fn, raw, depth, raw, line, held, edges,
+                         top_level)
+                i = m4.end()
+                continue
+            m5 = CALL_RE.match(text, i)
+            if m5 is not None and held:
+                calls.append((m5.group("name"), line,
+                              tuple(a.mutex for a in held)))
+                i = m5.end()
+                continue
+            record_line()
+            i += 1
+            continue
+        _acquire(fn, m.group("mu"), depth, m.group("var"), line, held,
+                 edges, top_level)
+        i = m.end()
+    record_line()
+    return edges, top_level, calls, line_held
+
+
+def _acquire(fn, token, depth, var, line, held, edges, top_level):
+    mutex = _norm_mutex(fn.cls, token)
+    for prior in held:
+        if prior.mutex != mutex:
+            edges.append((prior.mutex, mutex, fn.path, line, fn.qualname))
+    if not held:
+        top_level.append(mutex)
+    held.append(Acquisition(mutex, depth, var, fn.path, line,
+                            not held))
+
+
+def analyze_files(paths):
+    """Returns (findings, stats)."""
+    findings = []
+    functions = []
+    file_annotations = {}  # path -> {line: mutex}
+    texts = {}
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8",
+                      errors="replace") as fh:
+                raw = fh.read()
+        except OSError as e:
+            findings.append(Finding(
+                "io-error", path, 1, "cannot read: %s" % e))
+            continue
+        text, annotations = _strip(raw)
+        texts[path] = text
+        file_annotations[path] = annotations
+        functions.extend(_extract_functions(text, path))
+
+    # Pass 1: per-function scans.
+    edges = []           # (A, B, path, line, func)
+    acquires_by_name = collections.defaultdict(set)  # bare fn name
+    top_by_name = collections.defaultdict(set)
+    fn_results = []
+    for fn in functions:
+        f_edges, top_level, calls, line_held = _scan_function(fn)
+        edges.extend(f_edges)
+        fn_results.append((fn, calls, line_held))
+        if top_level:
+            acquires_by_name[fn.name].add(fn.qualname)
+            top_by_name[fn.name].update(top_level)
+
+    # Pass 2: one-level call edges — only through bare names that
+    # UNIQUELY identify a lock-acquiring function (ambiguity would
+    # fabricate edges and block CI on a false cycle).
+    for fn, calls, _ in fn_results:
+        for name, line, held_snapshot in calls:
+            if name == fn.name or len(acquires_by_name.get(name,
+                                                           ())) != 1:
+                continue
+            for target in top_by_name[name]:
+                for held_mu in held_snapshot:
+                    if held_mu != target:
+                        edges.append((held_mu, target, fn.path, line,
+                                      "%s (calls %s)" % (fn.qualname,
+                                                         name)))
+
+    # Pass 3: cycle detection over the acquisition graph.
+    graph = collections.defaultdict(set)
+    edge_sites = {}
+    for a, b, path, line, func in edges:
+        graph[a].add(b)
+        edge_sites.setdefault((a, b), (path, line, func))
+    for cycle in _find_cycles(graph):
+        chain = []
+        for i in range(len(cycle)):
+            a, b = cycle[i], cycle[(i + 1) % len(cycle)]
+            path, line, func = edge_sites[(a, b)]
+            chain.append("%s -> %s at %s:%d in %s"
+                         % (a, b, os.path.basename(path), line, func))
+        path, line, _ = edge_sites[(cycle[0], cycle[1 % len(cycle)])]
+        findings.append(Finding(
+            "lock-order-cycle", path, line,
+            "lock-order cycle %s: two threads taking these locks in "
+            "the two different orders deadlock without racing (TSAN "
+            "cannot prove this; the acquisition graph can). %s"
+            % (" -> ".join(cycle + [cycle[0]]), "; ".join(chain))))
+
+    # Pass 4: guarded-field audit (annotation-driven).
+    guarded = _collect_guarded_fields(texts, file_annotations)
+    for fn, _, line_held in fn_results:
+        if fn.cls is None or fn.name == fn.cls or fn.name.startswith("~"):
+            continue  # free function / constructor / destructor
+        fields = guarded.get(fn.cls)
+        if not fields:
+            continue
+        text = fn.text
+        offset_line = fn.start_line
+        for m in re.finditer(r"\b([a-zA-Z_]\w*_)\b", text):
+            field = m.group(1)
+            mu = fields.get(field)
+            if mu is None:
+                continue
+            line = offset_line + text.count("\n", 0, m.start())
+            held = line_held.get(line, frozenset())
+            want = _norm_mutex(fn.cls, mu)
+            if want in held:
+                continue
+            findings.append(Finding(
+                "guarded-field-unlocked", fn.path, line,
+                "field %s::%s is annotated guarded_by(%s) but %s "
+                "touches it at %s:%d without holding %s — a data race "
+                "the annotation promises cannot happen"
+                % (fn.cls, field, mu, fn.qualname,
+                   os.path.basename(fn.path), line, mu)))
+
+    stats = {"files": len(texts), "functions": len(functions),
+             "edges": len(set((a, b) for a, b, _, _, _ in edges)),
+             "guarded_fields": sum(len(v) for v in guarded.values())}
+    return findings, stats
+
+
+def _collect_guarded_fields(texts, file_annotations):
+    """{class: {field: mutex}} from `// guarded_by(mu)` annotations on
+    field declaration lines."""
+    guarded = collections.defaultdict(dict)
+    for path, annotations in file_annotations.items():
+        if not annotations:
+            continue
+        text = texts[path]
+        lines = text.split("\n")
+        for line_no, mu in annotations.items():
+            if line_no - 1 >= len(lines):
+                continue
+            decl = lines[line_no - 1]
+            fm = FIELD_DECL_RE.search(decl)
+            if fm is None:
+                continue
+            # byte offset of the line for class resolution
+            pos = sum(len(l) + 1 for l in lines[:line_no - 1])
+            cls = _enclosing_class(text, pos)
+            if cls is None:
+                continue
+            guarded[cls][fm.group("field")] = mu
+    return guarded
+
+
+def _find_cycles(graph):
+    """Simple cycles via DFS, deduplicated by node set (a cycle is one
+    finding, not one per rotation)."""
+    cycles = []
+    seen_sets = set()
+
+    def dfs(start, node, path, on_path):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start and len(path) > 1:
+                key = frozenset(path)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    cycles.append(list(path))
+            elif nxt not in on_path and nxt > start:
+                # node ordering prunes rotations: only explore nodes
+                # "greater" than the start so each cycle is found once
+                path.append(nxt)
+                on_path.add(nxt)
+                dfs(start, nxt, path, on_path)
+                on_path.discard(nxt)
+                path.pop()
+    # self-deadlock: A -> A (re-acquiring a non-recursive mutex)
+    for a in sorted(graph):
+        if a in graph[a]:
+            key = frozenset((a,))
+            if key not in seen_sets:
+                seen_sets.add(key)
+                cycles.append([a])
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def iter_sources(paths):
+    exts = (".cc", ".h", ".cpp", ".hpp", ".cxx")
+    for path in paths:
+        if os.path.isdir(path):
+            for name in sorted(os.listdir(path)):
+                if name.endswith(exts):
+                    yield os.path.join(path, name)
+        else:
+            yield path
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="lockorder",
+        description="Static lock-order + guard audit over the native "
+                    "core (docs/LINT.md; `make check-lockorder`).")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories (default: this "
+                             "module's own native/ directory)")
+    parser.add_argument("--stats", action="store_true",
+                        help="print graph statistics to stderr")
+    args = parser.parse_args(argv)
+
+    paths = args.paths or [os.path.dirname(os.path.abspath(__file__))]
+    files = list(iter_sources(paths))
+    findings, stats = analyze_files(files)
+    for f in findings:
+        sys.stdout.write("%s:%d: [%s] %s\n"
+                         % (f.path, f.line, f.rule, f.message))
+    if args.stats or not findings:
+        sys.stderr.write(
+            "check-lockorder: %d file(s), %d function(s), %d "
+            "acquisition edge(s), %d guarded field(s): %s\n"
+            % (stats["files"], stats["functions"], stats["edges"],
+               stats["guarded_fields"],
+               "clean" if not findings else
+               "%d finding(s)" % len(findings)))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,8 +41,9 @@ class TensorQueue {
 
  private:
   mutable std::mutex mutex_;
-  std::unordered_map<std::string, TensorTableEntry> tensor_table_;
-  std::deque<Request> message_queue_;
+  std::unordered_map<std::string, TensorTableEntry>
+      tensor_table_;               // guarded_by(mutex_)
+  std::deque<Request> message_queue_;  // guarded_by(mutex_)
 };
 
 }  // namespace hvdtpu

@@ -186,13 +186,17 @@ def make_parser():
                              "view at /job — poll it with bin/hvd-top); "
                              "see docs/METRICS.md")
     parser.add_argument("--lint", nargs="?", const="warn",
-                        choices=("warn", "strict"), default=None,
+                        choices=("warn", "strict", "verify"), default=None,
                         help="hvd-lint preflight: statically check the "
                              "training script for cross-rank divergence "
                              "hazards before spawning workers; 'warn' "
                              "(default when the flag is bare) reports and "
                              "launches anyway, '--lint=strict' refuses to "
-                             "launch on any finding (see docs/LINT.md)")
+                             "launch on any finding, '--lint=verify' "
+                             "additionally runs the hvd-verify symbolic "
+                             "collective-schedule verifier (interproc, "
+                             "N symbolic ranks) and refuses to launch on "
+                             "any finding (see docs/LINT.md)")
     parser.add_argument("--disable-cache", action="store_true",
                         help="re-run host checks even if cached "
                              "(reference: horovodrun --disable-cache; "
@@ -655,7 +659,7 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
             server.stop()
 
 
-def lint_preflight(command, mode, out=sys.stderr):
+def lint_preflight(command, mode, out=sys.stderr, num_proc=None):
     """Statically checks the training script(s) in `command` for
     cross-rank divergence hazards before any worker spawns (the silent
     hangs the stall inspector and digest cross-check can only catch
@@ -671,15 +675,30 @@ def lint_preflight(command, mode, out=sys.stderr):
                   "skipping preflight\n")
         return True
     findings, _ = lint_paths(targets)
+    if mode == "verify":
+        # Whole-program pass: symbolic N-rank schedules over the script
+        # and its local imports, diffed (docs/LINT.md "hvd-verify") —
+        # the static twin of the runtime divergence cross-check. The
+        # symbolic world matches the job's -np (a group of [0, 1] is
+        # world-covering at -np 2 but not at 4), capped at 8 symbolic
+        # ranks to bound the preflight's cost on wide jobs.
+        from horovod_tpu.lint.schedule import DEFAULT_WORLD, verify_paths
+        world = DEFAULT_WORLD if not num_proc \
+            else max(2, min(int(num_proc), 8))
+        vfindings, _ = verify_paths(targets, world=world)
+        findings = sorted(findings + vfindings,
+                          key=lambda f: (f.path, f.line, f.col, f.rule))
     if not findings:
-        out.write("[hvd-lint] %s: clean\n" % ", ".join(targets))
+        out.write("[hvd-lint] %s: clean%s\n" %
+                  (", ".join(targets),
+                   " (schedules verified)" if mode == "verify" else ""))
         return True
     format_human(findings, out)
-    if mode == "strict":
+    if mode in ("strict", "verify"):
         out.write("[hvd-lint] %d finding(s); refusing to launch "
-                  "(--lint=strict). Fix them or suppress intentional "
+                  "(--lint=%s). Fix them or suppress intentional "
                   "patterns with `# hvd-lint: disable=<rule>`.\n"
-                  % len(findings))
+                  % (len(findings), mode))
         return False
     out.write("[hvd-lint] %d finding(s); launching anyway (use "
               "--lint=strict to fail instead)\n" % len(findings))
@@ -699,7 +718,8 @@ def main(argv=None):
         command = command[1:]
     if not command:
         parser.error("no command given")
-    if args.lint and not lint_preflight(command, args.lint):
+    if args.lint and not lint_preflight(command, args.lint,
+                                        num_proc=args.num_proc):
         return 1
     if args.ckpt_dir:
         # Both launch paths (static run_command and the elastic driver)

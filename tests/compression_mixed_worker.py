@@ -24,7 +24,7 @@ def main():
 
     mode = "bf16" if r == 0 else "int8"
     try:
-        ops.allreduce(x, "mixed", compression=mode)
+        ops.allreduce(x, "mixed", compression=mode)  # hvd-lint: disable=verify-mixed-modes
     except HorovodInternalError as e:
         msg = str(e)
         assert "Mismatched compression modes" in msg, msg

@@ -94,9 +94,9 @@ def main():
     # the mode — must invalidate (miss) and renegotiate, not reuse.
     x = np.ones(100, np.float32)
     for _ in range(3):
-        ops.allreduce(x, "ck", compression="none")
+        ops.allreduce(x, "ck", compression="none")  # hvd-lint: disable=verify-mixed-modes
     inval_before = counters()["cache_invalid_total"]
-    out = ops.allreduce(x, "ck", compression="bf16")
+    out = ops.allreduce(x, "ck", compression="bf16")  # hvd-lint: disable=duplicate-collective-name
     assert np.allclose(out, n), out
     assert counters()["cache_invalid_total"] > inval_before
 

@@ -132,7 +132,7 @@ def test_error_mismatched_root(r, n):
 def test_duplicate_name(r, n):
     h1 = hvd.allreduce_async(np.zeros(4, dtype=np.float32), "dup")
     try:
-        h2 = hvd.allreduce_async(np.zeros(4, dtype=np.float32), "dup")
+        h2 = hvd.allreduce_async(np.zeros(4, dtype=np.float32), "dup")  # hvd-lint: disable=duplicate-collective-name
         try:
             hvd.synchronize(h2)
         except HorovodInternalError:

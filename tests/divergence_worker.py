@@ -45,7 +45,7 @@ if mode == "cross_stall":
     t0 = time.time()
     try:
         # hvd-lint: disable=rank-dependent-name
-        hvd.allreduce(np.ones(4, dtype=np.float32), "diverged.%d" % r)
+        hvd.allreduce(np.ones(4, dtype=np.float32), "diverged.%d" % r)  # hvd-lint: disable=verify-divergent-schedule
         sys.stderr.write("rank %d: divergent collective completed?!\n" % r)
         sys.exit(4)
     except HorovodInternalError as e:

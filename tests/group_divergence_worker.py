@@ -32,7 +32,7 @@ if r in (0, 1):
     # The classic rank-divergent collective, scoped to group 1: each
     # member blocks on a rank-suffixed name the other never submits.
     try:
-        ops.allreduce(np.ones(4, np.float32), "div.only_%d" % r,
+        ops.allreduce(np.ones(4, np.float32), "div.only_%d" % r,  # hvd-lint: disable=rank-dependent-name,verify-divergent-schedule
                       group=g_front)
         raise AssertionError("group-divergent collective did not fail")
     except HorovodInternalError as e:

@@ -41,7 +41,7 @@ assert float(out) == sum(bg.ranks), (r, out)
 # per-rank gradients rank r -> mean over the batch column.
 import optax
 
-opt = hvd.DistributedOptimizer(optax.sgd(1.0))
+opt = hvd.DistributedOptimizer(optax.sgd(1.0))  # hvd-lint: disable=missing-initial-broadcast
 params = jnp.zeros(3)
 state = opt.init(params)
 g = jnp.full(3, float(r))

@@ -33,12 +33,12 @@ def main():
     # Warmup: populates the response cache (first sight of a name is
     # always a full negotiation) and lets autotune warmup cycles pass.
     for i in range(8):
-        ops.allreduce(np.ones(16, np.float32), name % 0)
+        ops.allreduce(np.ones(16, np.float32), name % 0)  # hvd-lint: disable=loop-auto-name
 
     basics.protocol_counters_reset()
     n_ops = 64
     for i in range(n_ops):
-        ops.allreduce(np.ones(16, np.float32), name % 0)
+        ops.allreduce(np.ones(16, np.float32), name % 0)  # hvd-lint: disable=loop-auto-name
     counters = basics.protocol_counters()
     counters["ops"] = n_ops
     counters["rank"] = r

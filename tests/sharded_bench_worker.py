@@ -83,7 +83,7 @@ def _convergence(steps=40):
 
     curves = {}
     for mode in ("replicated", "sharded"):
-        opt = hvd_jax.DistributedOptimizer(
+        opt = hvd_jax.DistributedOptimizer(  # hvd-lint: disable=missing-initial-broadcast
             optax.adam(5e-2), sharded_update=(mode == "sharded"),
             name_prefix="conv_%s" % mode)
         p = init_params()
@@ -94,7 +94,7 @@ def _convergence(steps=40):
             if mode == "sharded":
                 u, s = opt.update(g, s, p)
             else:
-                u, s = opt.update(g, s)
+                u, s = opt.update(g, s)  # hvd-lint: disable=verify-mixed-modes
             p = optax.apply_updates(p, u)
             # Global loss over the FULL batch (identical on every rank).
             h = np.tanh(x @ np.asarray(p["w1"]))
@@ -141,12 +141,12 @@ def main():
         # verify: base + mean(rank offsets).
         g_local = 0.01 * params + 0.001 * r
         if sharded:
-            g = ops.reduce_scatter(g_local, "sb.grad", average=True)
+            g = ops.reduce_scatter(g_local, "sb.grad", average=True)  # hvd-lint: disable=verify-kind-mismatch
             p_new, mu, nu = _adam(params[lo:hi], g, mu, nu, t)
             params = np.asarray(ops.allgather(
                 np.ascontiguousarray(p_new), "sb.param_ag"))
         else:
-            g = ops.allreduce(g_local, "sb.grad", average=True)
+            g = ops.allreduce(g_local, "sb.grad", average=True)  # hvd-lint: disable=name-attr-mismatch
             params, mu, nu = _adam(params, g, mu, nu, t)
         assert params.size == elems
 

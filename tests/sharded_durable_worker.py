@@ -83,7 +83,7 @@ def train(state):
     import optax
 
     opt = optax.adam(LR)
-    sharded = hvd_jax.DistributedOptimizer(opt, sharded_update=True)
+    sharded = hvd_jax.DistributedOptimizer(opt, sharded_update=True)  # hvd-lint: disable=missing-initial-broadcast
     params = {k: jnp.asarray(v) for k, v in state.params.items()}
     # Re-shard the world-independent full form for THIS rank and world
     # size — fresh start (main() seeds the full form of a fresh init,

@@ -32,9 +32,9 @@ def main():
     # Raw collective level: the coordinator's type check fires.
     try:
         if r == 0:
-            ops.reduce_scatter(x, "mixed")
+            ops.reduce_scatter(x, "mixed")  # hvd-lint: disable=rank-conditional-collective,verify-kind-mismatch
         else:
-            ops.allreduce(x, "mixed")
+            ops.allreduce(x, "mixed")  # hvd-lint: disable=rank-conditional-collective,name-attr-mismatch
     except HorovodInternalError as e:
         _assert_mixed_error(str(e))
         print("rank %d: mixed-mode rejected naming both ranks and modes"
@@ -53,13 +53,13 @@ def main():
 
     from horovod_tpu import jax as hvd_jax
 
-    opt = hvd_jax.DistributedOptimizer(optax.sgd(0.1),
+    opt = hvd_jax.DistributedOptimizer(optax.sgd(0.1),  # hvd-lint: disable=missing-initial-broadcast
                                        sharded_update=(r == 0))
     params = {"w": jnp.ones(10, jnp.float32)}
     state = opt.init(params)
     grads = {"w": jnp.full(10, float(r + 1))}
     try:
-        opt.update(grads, state, params)
+        opt.update(grads, state, params)  # hvd-lint: disable=verify-mixed-modes
     except HorovodInternalError as e:
         _assert_mixed_error(str(e))
         print("rank %d: optimizer-level mixed mode rejected" % r,

@@ -458,3 +458,47 @@ def test_repo_examples_and_models_are_clean():
     assert findings == [], "\n".join(
         "%s:%d %s %s" % (f.path, f.line, f.rule, f.message)
         for f in findings)
+
+
+def _worker_scripts():
+    tests_dir = os.path.join(REPO_ROOT, "tests")
+    return sorted(
+        os.path.join(tests_dir, name) for name in os.listdir(tests_dir)
+        if name.endswith("_worker.py"))
+
+
+def test_repo_elastic_fleet_and_workers_lint_clean():
+    """Self-lint beyond the example corpus: the elastic and fleet
+    packages (library code that itself issues collectives) and every
+    tests/*_worker.py launch script. Workers deliberately exercise
+    hazards (divergence, mixed modes, non-member submissions) — those
+    sites carry inline `# hvd-lint: disable=` suppressions, so a NEW
+    unsuppressed hazard fails tier-1 here."""
+    workers = _worker_scripts()
+    assert len(workers) >= 30
+    findings, checked = lint_paths([
+        os.path.join(REPO_ROOT, "horovod_tpu", "elastic"),
+        os.path.join(REPO_ROOT, "horovod_tpu", "fleet"),
+    ] + workers)
+    assert checked >= 40
+    assert findings == [], "\n".join(
+        "%s:%d %s %s" % (f.path, f.line, f.rule, f.message)
+        for f in findings)
+
+
+def test_repo_schedules_verify_clean():
+    """hvd-verify self-check: the example corpus, the model zoo, and
+    every worker script run through the symbolic schedule verifier.
+    Intentional-hazard fixtures (divergence_worker and friends) carry
+    suppressions; tests/test_verify.py separately proves the findings
+    reappear when the suppressions are stripped."""
+    from horovod_tpu.lint import verify_paths
+
+    findings, checked = verify_paths([
+        os.path.join(REPO_ROOT, "examples"),
+        os.path.join(REPO_ROOT, "horovod_tpu", "models"),
+    ] + _worker_scripts())
+    assert checked >= 60
+    assert findings == [], "\n".join(
+        "%s:%d %s %s" % (f.path, f.line, f.rule, f.message)
+        for f in findings)

@@ -1,0 +1,836 @@
+"""hvd-verify: the symbolic collective-schedule verifier (docs/LINT.md).
+
+Covers: schedule-extraction goldens (helper inlining, loop unrolling,
+group-membership branches), one failing example per verifier finding
+class with its clean twin, suppression/CLI/SARIF integration, finding
+fingerprints surviving line shifts, the static-vs-runtime e2e (the same
+divergent script test_divergence.py proves hangs-then-errors at
+runtime must be flagged BEFORE launch), and the native lock-order
+audit (`make check-lockorder`): clean on the real native tree, firing
+on synthetic cycle / guard-violation fixtures.
+"""
+
+import json
+import os
+import re
+import textwrap
+
+import pytest
+
+from horovod_tpu.lint import RULES, lint_source, verify_source
+from horovod_tpu.lint.cli import main as lint_main
+from horovod_tpu.lint.report import fingerprint
+from horovod_tpu.lint.schedule import extract_schedules
+from horovod_tpu.native import lockorder
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(source):
+    return [f.rule for f in verify_source(textwrap.dedent(source),
+                                          path="verify_case.py")]
+
+
+def schedules_of(source, world=2):
+    sched = extract_schedules("golden_case.py",
+                              source=textwrap.dedent(source),
+                              world=world)
+    return [[(e.kind, e.name) for e in events if e.collective]
+            for events in sched.per_rank]
+
+
+# --- schedule-extraction goldens --------------------------------------------
+
+def test_golden_straight_line_schedule():
+    per_rank = schedules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        hvd.broadcast(x, 0, "init.w")
+        hvd.allreduce(x, "grad.w")
+        hvd.allgather(x, "metrics")
+    """)
+    expected = [("broadcast", "init.w"), ("allreduce", "grad.w"),
+                ("allgather", "metrics")]
+    assert per_rank == [expected, expected]
+
+
+def test_golden_helper_inlined_with_chain():
+    """Collectives inside user helpers land in the schedule with the
+    full call chain (entry call site -> helper site)."""
+    sched = extract_schedules("golden_case.py", source=textwrap.dedent("""
+        import horovod_tpu as hvd
+
+        def reduce_all(x, tag):
+            return hvd.allreduce(x, name="g." + tag)
+
+        def train_step(x):
+            return reduce_all(x, "w")
+
+        hvd.init()
+        train_step(1)
+    """), world=2)
+    events = [e for e in sched.per_rank[0] if e.collective]
+    assert [(e.kind, e.name) for e in events] == [("allreduce", "g.w")]
+    # chain: top-level call -> train_step's call -> the collective
+    assert len(events[0].chain) == 3
+    assert events[0].chain[0][2] == "<module>"
+    assert events[0].chain[1][2] == "train_step"
+    assert events[0].chain[2][2] == "reduce_all"
+
+
+def test_golden_loop_unrolled_names():
+    per_rank = schedules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        for i in range(3):
+            hvd.allreduce(x, name="g.%d" % i)
+    """)
+    expected = [("allreduce", "g.0"), ("allreduce", "g.1"),
+                ("allreduce", "g.2")]
+    assert per_rank == [expected, expected]
+
+
+def test_golden_group_branch_membership():
+    """A group collective correctly guarded by membership appears only
+    in member ranks' schedules — and that asymmetry is NOT a
+    divergence, because non-members never join that negotiation."""
+    src = """
+        import horovod_tpu as hvd
+        hvd.init()
+        g = hvd.new_group([0, 1])
+        if hvd.rank() in (0, 1):
+            hvd.allreduce(x, "model.grad", group=g)
+        hvd.allreduce(x, "batch.grad")
+    """
+    per_rank = schedules_of(src, world=4)
+    assert per_rank[0] == [("new_group", "new_group[0,1]"),
+                           ("allreduce", "model.grad"),
+                           ("allreduce", "batch.grad")]
+    assert per_rank[3] == [("new_group", "new_group[0,1]"),
+                           ("allreduce", "batch.grad")]
+    assert rules_of(src) == []
+
+
+def test_golden_local_import_and_helper(tmp_path):
+    """Local imports are followed: a helper module's collectives are
+    part of the entry script's schedule, and a rank-guarded collective
+    INSIDE the helper is still found (the lexical rules cannot see
+    this)."""
+    (tmp_path / "helpers.py").write_text(textwrap.dedent("""
+        import horovod_tpu as hvd
+
+        def reduce_all(x, tag):
+            return hvd.allreduce(x, name="h." + tag)
+
+        def maybe_extra(x):
+            if hvd.rank() == 0:
+                hvd.allreduce(x, name="h.extra")
+    """))
+    entry = tmp_path / "train.py"
+    entry.write_text(textwrap.dedent("""
+        import horovod_tpu as hvd
+        from helpers import maybe_extra, reduce_all
+
+        hvd.init()
+        maybe_extra(1)
+        reduce_all(2, "loss")
+    """))
+    from horovod_tpu.lint import verify_paths
+    findings, checked = verify_paths([str(entry)])
+    assert checked == 1
+    assert [f.rule for f in findings] == ["verify-divergent-schedule"]
+    # Both call-site chains are named, through the helper file.
+    assert "helpers.py" in findings[0].message
+    assert "rank 0 call chain" in findings[0].message
+    assert "rank 1 call chain" in findings[0].message
+
+
+# --- one failing example per finding class, with its clean twin -------------
+
+BAD = {
+    "verify-divergent-schedule": """
+        import horovod_tpu as hvd
+
+        def log_helper(x):
+            hvd.allreduce(x, "log.extra")
+
+        hvd.init()
+        if hvd.rank() == 0:
+            log_helper(1)
+        hvd.allreduce(2, "grad.w")
+    """,
+    "verify-kind-mismatch": """
+        import horovod_tpu as hvd
+        hvd.init()
+        if flag:
+            hvd.allreduce(x, "t")
+        else:
+            hvd.allgather(x, "t")
+    """,
+    "verify-non-member-group-call": """
+        import horovod_tpu as hvd
+        hvd.init()
+        g = hvd.new_group([0, 1])
+        hvd.allreduce(x, "grad", group=g)
+    """,
+    "verify-mixed-modes": """
+        import horovod_tpu as hvd
+        hvd.init()
+        if hvd.rank() < 2:
+            hvd.allreduce(x, "g", compression="int8")
+        else:
+            hvd.allreduce(x, "g", compression="none")
+    """,
+    "verify-missing-restore-broadcast": """
+        import horovod_tpu as hvd
+        from horovod_tpu import elastic
+        hvd.init()
+        state = elastic.ElasticState(step=0)
+        ck = hvd.elastic.DurableCheckpointer("/ckpt")
+        ck.restore_into(state)
+        hvd.allreduce(grads, "grads")
+    """,
+}
+
+GOOD = {
+    "verify-divergent-schedule": """
+        import horovod_tpu as hvd
+
+        def log_helper(x):
+            hvd.allreduce(x, "log.extra")
+
+        hvd.init()
+        log_helper(1)
+        hvd.allreduce(2, "grad.w")
+        if hvd.rank() == 0:
+            print("logged")
+    """,
+    "verify-kind-mismatch": """
+        import horovod_tpu as hvd
+        hvd.init()
+        if flag:
+            hvd.allreduce(x, "t.reduce")
+        else:
+            hvd.allgather(x, "t.gather")
+    """,
+    "verify-non-member-group-call": """
+        import horovod_tpu as hvd
+        hvd.init()
+        g = hvd.new_group([0, 1])
+        if hvd.rank() in (0, 1):
+            hvd.allreduce(x, "grad", group=g)
+    """,
+    "verify-mixed-modes": """
+        import horovod_tpu as hvd
+        hvd.init()
+        hvd.allreduce(x, "g", compression="int8")
+    """,
+    "verify-missing-restore-broadcast": """
+        import horovod_tpu as hvd
+        from horovod_tpu import elastic
+        hvd.init()
+        state = elastic.ElasticState(step=0)
+        ck = hvd.elastic.DurableCheckpointer("/ckpt")
+        ck.restore_into(state)
+        state.sync()
+        hvd.allreduce(grads, "grads")
+    """,
+}
+
+
+@pytest.mark.parametrize("rule", sorted(BAD))
+def test_verify_bad_flags(rule):
+    assert rule in rules_of(BAD[rule])
+
+
+@pytest.mark.parametrize("rule", sorted(BAD))
+def test_verify_bad_names_both_chains(rule):
+    """Acceptance: every verifier finding names BOTH conflicting
+    call-site chains (mirroring the runtime divergence report)."""
+    findings = [f for f in verify_source(textwrap.dedent(BAD[rule]),
+                                         path="verify_case.py")
+                if f.rule == rule]
+    assert findings, rule
+    assert findings[0].message.count("chain") >= 2, findings[0].message
+
+
+@pytest.mark.parametrize("rule", sorted(GOOD))
+def test_verify_good_clean(rule):
+    assert rules_of(GOOD[rule]) == []
+
+
+def test_group_rank_method_membership_guard():
+    # `if g.rank() >= 0:` — the ProcessGroup API's own membership test.
+    assert rules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        g = hvd.new_group([1, 2])
+        if g.rank() >= 0:
+            hvd.allreduce(x, "grad", group=g)
+    """) == []
+
+
+def test_uniform_unknown_branches_do_not_diverge():
+    # Every rank makes the same (unknowable) choice: both arms'
+    # collectives surface, but identically on all ranks -> clean.
+    assert rules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        if flag:
+            hvd.allreduce(x, "a")
+        else:
+            hvd.allreduce(x, "b")
+    """) == []
+
+
+def test_rank_dependent_name_diverges_interprocedurally():
+    found = rules_of("""
+        import horovod_tpu as hvd
+
+        def reduce_mine(x):
+            hvd.allreduce(x, name="grad.%d" % hvd.rank())
+
+        hvd.init()
+        reduce_mine(1)
+    """)
+    assert "verify-divergent-schedule" in found
+
+
+def test_rank_taint_through_opaque_data_splits_world():
+    """Rank-dependence surviving an opaque lookup: `table[hvd.rank()]`
+    is undecidable but rank-derived, so the symbolic world splits and
+    a branch-only collective is a proven divergence."""
+    assert "verify-divergent-schedule" in rules_of("""
+        import horovod_tpu as hvd
+
+        def probe(x):
+            hvd.allreduce(x, "probe")
+
+        hvd.init()
+        if table[hvd.rank()] > 0:
+            probe(1)
+        hvd.allreduce(2, "grad")
+    """)
+
+
+def test_tuple_unpack_does_not_smear_rank_taint():
+    """`r, n = hvd.rank(), hvd.size()` taints r but NOT n — a
+    world-size condition stays uniform."""
+    assert rules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        r, n = hvd.rank(), hvd.size()
+        if n > 1:
+            hvd.allreduce(x, "t")
+    """) == []
+
+
+def test_helper_toplevel_collective_anchors_at_import(tmp_path):
+    """A divergence at an imported module's TOP LEVEL anchors at the
+    entry file's import line, where a suppression can reach it."""
+    (tmp_path / "sidefx.py").write_text(textwrap.dedent("""
+        import horovod_tpu as hvd
+        if hvd.rank() == 0:
+            hvd.allreduce(1, "import.time")
+    """))
+    entry = tmp_path / "train.py"
+    entry.write_text(textwrap.dedent("""
+        import horovod_tpu as hvd
+        import sidefx
+        hvd.init()
+        hvd.allreduce(2, "grad")
+    """))
+    from horovod_tpu.lint import verify_paths
+    findings, _ = verify_paths([str(entry)])
+    assert [f.rule for f in findings] == ["verify-divergent-schedule"]
+    entry_lines = entry.read_text().splitlines()
+    assert findings[0].line <= len(entry_lines)
+    assert "import sidefx" in entry_lines[findings[0].line - 1]
+
+
+def test_distinct_optimizer_prefixes_do_not_collide():
+    """Two optimizers with DISTINCT explicit name_prefix= values
+    negotiate disjoint names at runtime — no mixed-modes report; with
+    the default prefix they genuinely alias and the report stands."""
+    assert rules_of("""
+        import horovod_tpu.jax as hvd_jax
+        import horovod_tpu as hvd
+        hvd.init()
+        opt_a = hvd_jax.DistributedOptimizer(
+            inner, sharded_update=True, name_prefix="a")
+        opt_b = hvd_jax.DistributedOptimizer(inner, name_prefix="b")
+        p = hvd_jax.broadcast_parameters(p, root_rank=0)
+        opt_a.update(g, s, p)
+        opt_b.update(g, s, p)
+    """) == []
+    assert "verify-mixed-modes" in rules_of("""
+        import horovod_tpu.jax as hvd_jax
+        import horovod_tpu as hvd
+        hvd.init()
+        opt_a = hvd_jax.DistributedOptimizer(inner, sharded_update=True)
+        opt_b = hvd_jax.DistributedOptimizer(inner)
+        p = hvd_jax.broadcast_parameters(p, root_rank=0)
+        opt_a.update(g, s, p)
+        opt_b.update(g, s, p)
+    """)
+
+
+def test_boolop_returns_operand_not_bool():
+    """`args.name or "grad.w"` evaluates to an operand (Python
+    semantics), never the literal True — two such defaults must not
+    collide under one name."""
+    assert rules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        hvd.allreduce(x, name=args.name or "grad.w")
+        hvd.allgather(y, name=args.tag or "metrics")
+    """) == []
+
+
+def test_group_rank_taint_through_opaque_data():
+    """g.rank() carries the rank taint like hvd.rank(): opaque lookups
+    fed by a group position still split the symbolic world."""
+    assert "verify-kind-mismatch" not in rules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        g = hvd.new_group([0, 1, 2, 3])
+        if table[g.rank()]:
+            hvd.allreduce(x, "a")
+        else:
+            hvd.allgather(x, "b")
+    """)  # split world: per-rank choice, divergence owns the report
+    assert "verify-divergent-schedule" in rules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        g = hvd.new_group([0, 1, 2, 3])
+        if table[g.rank()] > 0:
+            hvd.allreduce(x, "extra")
+        hvd.allreduce(x, "grad")
+    """)
+
+
+def test_new_group_keyword_spelling():
+    """new_group(ranks=[0, 1]) keeps the literal member list — the
+    non-member check must not be disabled by an argument spelling."""
+    assert "verify-non-member-group-call" in rules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        g = hvd.new_group(ranks=[0, 1])
+        hvd.allreduce(x, "grad", group=g)
+    """)
+
+
+def test_collective_inside_name_expression_counted_once():
+    sched = extract_schedules("golden_case.py", source=textwrap.dedent("""
+        import horovod_tpu as hvd
+
+        def mkname():
+            hvd.allreduce(1, "probe")
+            return "grad.w"
+
+        hvd.init()
+        hvd.allreduce(x, name=mkname())
+    """), world=2)
+    names = [e.name for e in sched.per_rank[0] if e.collective]
+    assert names == ["probe", "grad.w"]
+
+
+def test_divergence_not_masked_by_unrelated_mode_finding():
+    """A rank-divergent collective must be reported even when the
+    event it happens to align against carries its own (unrelated)
+    mixed-modes finding."""
+    found = rules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        if hvd.rank() == 0:
+            hvd.allreduce(x, "extra")
+        if flag:
+            hvd.allreduce(x, "m", compression="int8")
+        else:
+            hvd.allreduce(x, "m", compression="none")
+    """)
+    assert "verify-mixed-modes" in found
+    assert "verify-divergent-schedule" in found
+
+
+def test_reduce_scatter_in_schedule():
+    """reduce_scatter is a negotiated collective (ZeRO's core op) and
+    must appear in schedules: a rank-guarded one is a divergence."""
+    per_rank = schedules_of("""
+        import horovod_tpu as hvd
+        from horovod_tpu.common import ops
+        hvd.init()
+        ops.reduce_scatter(x, "rs.grad")
+    """)
+    assert per_rank[0] == [("reducescatter", "rs.grad")]
+    assert "verify-divergent-schedule" in rules_of("""
+        import horovod_tpu as hvd
+        from horovod_tpu.common import ops
+        hvd.init()
+        if hvd.rank() == 0:
+            ops.reduce_scatter(x, "rs.only0")
+        hvd.allreduce(x, "grad")
+    """)
+
+
+def test_try_else_clause_is_executed():
+    """try/except/else: the else clause runs on the normal path — the
+    path the executor models — so a divergent collective there is
+    found."""
+    assert "verify-divergent-schedule" in rules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        try:
+            x = load()
+        except ValueError:
+            x = None
+        else:
+            if hvd.rank() == 0:
+                hvd.allreduce(x, "only0")
+        hvd.allreduce(x, "grad")
+    """)
+
+
+def test_second_unsynced_restore_is_found():
+    """Every restore site is audited, not just the first: a later
+    restore without a sync is the classic elastic re-init bug."""
+    assert "verify-missing-restore-broadcast" in rules_of("""
+        import horovod_tpu as hvd
+        from horovod_tpu import elastic
+        hvd.init()
+        state = elastic.ElasticState(step=0)
+        ck = hvd.elastic.DurableCheckpointer("/ckpt")
+        ck.restore_into(state)
+        state.sync()
+        hvd.allreduce(g, "g1")
+        ck.restore_into(state)
+        hvd.allreduce(g, "g2")
+    """)
+
+
+def test_preflight_world_matches_num_proc(tmp_path, capsys):
+    """--lint=verify verifies at the job's -np: a group of [0, 1] is
+    world-covering at -np 2 (launch allowed) but not at -np 4
+    (refused)."""
+    import io
+    from horovod_tpu.run.run import lint_preflight
+    script = tmp_path / "pair.py"
+    script.write_text(textwrap.dedent("""
+        import horovod_tpu as hvd
+        hvd.init()
+        g = hvd.new_group([0, 1])
+        hvd.allreduce(x, "grad", group=g)
+    """))
+    buf = io.StringIO()
+    assert lint_preflight(["python", str(script)], "verify", out=buf,
+                          num_proc=2) is True
+    buf = io.StringIO()
+    assert lint_preflight(["python", str(script)], "verify", out=buf,
+                          num_proc=4) is False
+    assert "verify-non-member-group-call" in buf.getvalue()
+
+
+def test_unknown_membership_group_guard_is_clean():
+    """The guard docs/LINT.md recommends for implicit mesh groups —
+    `if g.rank() >= 0:` — must verify clean even though the
+    membership is unknowable statically."""
+    assert rules_of("""
+        import horovod_tpu as hvd
+        hvd.init(model_parallel=2)
+        g = hvd.model_group()
+        if g.rank() >= 0:
+            hvd.allreduce(x, "mg.grad", group=g)
+        hvd.allreduce(x, "dp.grad")
+    """) == []
+
+
+def test_short_circuited_collective_is_rank_divergent():
+    """A collective behind a rank-decidable short-circuit runs on some
+    ranks only — the boolean operands must evaluate lazily."""
+    assert "verify-divergent-schedule" in rules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        if hvd.rank() != 0 and bool(hvd.allreduce(x, "only_nonzero")):
+            pass
+        hvd.allreduce(x, "grad")
+    """)
+
+
+def test_same_members_different_registrations_diverge():
+    """Two new_group registrations with identical member lists are two
+    distinct runtime groups: one name negotiated under gA by half the
+    ranks and gB by the rest is a mixed-group divergence."""
+    assert "verify-divergent-schedule" in rules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        gA = hvd.new_group([0, 1, 2, 3])
+        gB = hvd.new_group([0, 1, 2, 3])
+        if hvd.rank() < 2:
+            hvd.allreduce(x, "t", group=gA)
+        else:
+            hvd.allreduce(x, "t", group=gB)
+    """)
+
+
+def test_sharded_mixed_via_helper():
+    found = rules_of("""
+        import horovod_tpu.jax as hvd_jax
+        import horovod_tpu as hvd
+
+        def make_opt(inner):
+            if hvd.rank() < 2:
+                return hvd_jax.DistributedOptimizer(
+                    inner, sharded_update=True)
+            return hvd_jax.DistributedOptimizer(inner)
+
+        hvd.init()
+        opt = make_opt(inner)
+        p = hvd_jax.broadcast_parameters(p, root_rank=0)
+        opt.update(g, s, p)
+    """)
+    assert "verify-mixed-modes" in found
+
+
+def test_verify_suppression():
+    assert rules_of("""
+        import horovod_tpu as hvd
+        hvd.init()
+        g = hvd.new_group([0, 1])
+        hvd.allreduce(x, "grad", group=g)  # hvd-lint: disable=verify-non-member-group-call
+    """) == []
+
+
+def test_verify_rules_registered():
+    for rule in ("verify-divergent-schedule", "verify-kind-mismatch",
+                 "verify-non-member-group-call", "verify-mixed-modes",
+                 "verify-missing-restore-broadcast"):
+        assert rule in RULES
+        assert RULES[rule].default_severity == "error"
+
+
+def test_syntax_error_left_to_lexical_pass():
+    assert verify_source("def broken(:\n", path="x.py") == []
+    assert [f.rule for f in lint_source("def broken(:\n")] == \
+        ["parse-error"]
+
+
+# --- CLI / reporters --------------------------------------------------------
+
+def _write(tmp_path, name, source):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return str(path)
+
+
+def test_cli_verify_exit_codes(tmp_path):
+    bad = _write(tmp_path, "bad.py", BAD["verify-non-member-group-call"])
+    good = _write(tmp_path, "good.py",
+                  GOOD["verify-non-member-group-call"])
+    assert lint_main([bad]) == 0          # lexical alone: clean
+    assert lint_main(["--verify", bad]) == 1
+    assert lint_main(["--verify", good]) == 0
+    assert lint_main(["--verify", "--disable",
+                      "verify-non-member-group-call", bad]) == 0
+
+
+def test_cli_verify_json_carries_fingerprint(tmp_path, capsys):
+    bad = _write(tmp_path, "bad.py", BAD["verify-kind-mismatch"])
+    assert lint_main(["--verify", "--format", "json", bad]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    rules = {f["rule"] for f in payload["findings"]}
+    assert "verify-kind-mismatch" in rules
+    for f in payload["findings"]:
+        assert re.match(r"^[0-9a-f]{16}$", f["fingerprint"])
+
+
+def test_cli_sarif_format(tmp_path, capsys):
+    bad = _write(tmp_path, "bad.py", BAD["verify-divergent-schedule"])
+    assert lint_main(["--verify", "--format", "sarif", bad]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["version"] == "2.1.0"
+    run = payload["runs"][0]
+    assert run["tool"]["driver"]["name"] == "hvd-lint"
+    results = run["results"]
+    assert any(r["ruleId"] == "verify-divergent-schedule"
+               for r in results)
+    for r in results:
+        assert "hvdLintFingerprint/v1" in r["partialFingerprints"]
+        region = r["locations"][0]["physicalLocation"]["region"]
+        assert region["startLine"] >= 1
+    # every ruleId is declared in the driver's rule table
+    declared = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {r["ruleId"] for r in results} <= declared
+
+
+def test_fingerprint_survives_line_shift(tmp_path):
+    """The suppression/baseline id must not change when unrelated lines
+    are inserted above the finding."""
+    src = textwrap.dedent(BAD["verify-non-member-group-call"])
+    shifted = "# leading comment\n# another\n\n" + src
+    a = verify_source(src, path=str(tmp_path / "a.py"))
+    b = verify_source(shifted, path=str(tmp_path / "a.py"))
+    assert len(a) == len(b) == 1
+    assert a[0].line != b[0].line  # the line DID shift...
+    fa = fingerprint(a[0], source_lines=src.splitlines())
+    fb = fingerprint(b[0], source_lines=shifted.splitlines())
+    assert fa == fb               # ...the fingerprint did not
+
+
+# --- static-vs-runtime e2e --------------------------------------------------
+
+def test_verifier_flags_the_runtime_divergence_script():
+    """tests/test_divergence.py proves divergence_worker.py (mode
+    cross_stall) hangs-then-errors at RUNTIME via the coordinator's
+    digest cross-check; the verifier must prove the same bug BEFORE
+    launch. The shipped worker carries intentional suppressions (it is
+    the runtime fixture); stripping them restores the finding."""
+    path = os.path.join(REPO_ROOT, "tests", "divergence_worker.py")
+    with open(path) as fh:
+        source = fh.read()
+    unsuppressed = source.replace("# hvd-lint: disable", "# stripped")
+    findings = verify_source(unsuppressed, path=path)
+    rules = [f.rule for f in findings]
+    assert "verify-divergent-schedule" in rules, rules
+    diverge = [f for f in findings
+               if f.rule == "verify-divergent-schedule"][0]
+    # Both sides of the divergence are named, like the runtime error.
+    assert "diverged.0" in diverge.message
+    assert "diverged.1" in diverge.message
+    # ...and the suppressed shipped fixture stays quiet (self-lint).
+    assert [f.rule for f in verify_source(source, path=path)] == []
+
+
+# --- native lock-order audit ------------------------------------------------
+
+CYCLE_CC = """
+#include <mutex>
+class Pool {
+ public:
+  void Fill() {
+    std::lock_guard<std::mutex> lk(mu_a_);
+    std::lock_guard<std::mutex> lk2(mu_b_);
+  }
+  void Drain() {
+    std::lock_guard<std::mutex> lk(mu_b_);
+    std::lock_guard<std::mutex> lk2(mu_a_);
+  }
+ private:
+  std::mutex mu_a_;
+  std::mutex mu_b_;
+};
+"""
+
+CALL_CYCLE_CC = """
+#include <mutex>
+class Router {
+ public:
+  void TakeBoth() {
+    std::lock_guard<std::mutex> lk(first_);
+    AcquireSecondOnly();
+  }
+  void AcquireSecondOnly() {
+    std::lock_guard<std::mutex> lk(second_);
+  }
+  void Reversed() {
+    std::lock_guard<std::mutex> lk(second_);
+    std::lock_guard<std::mutex> lk2(first_);
+  }
+ private:
+  std::mutex first_, second_;
+};
+"""
+
+GUARD_CC = """
+#include <mutex>
+class Table {
+ public:
+  int Get() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return count_;
+  }
+  void Bump() { count_++; }
+  Table() { count_ = 0; }
+ private:
+  std::mutex mu_;
+  int count_ = 0;  // guarded_by(mu_)
+};
+"""
+
+NESTED_OK_CC = """
+#include <mutex>
+class Ok {
+ public:
+  void Consistent() {
+    std::lock_guard<std::mutex> lk(mu_a_);
+    std::lock_guard<std::mutex> lk2(mu_b_);
+  }
+  void AlsoConsistent() {
+    std::lock_guard<std::mutex> lk(mu_a_);
+    std::lock_guard<std::mutex> lk2(mu_b_);
+  }
+ private:
+  std::mutex mu_a_, mu_b_;
+};
+"""
+
+
+def _lockorder_on(tmp_path, name, source):
+    path = tmp_path / name
+    path.write_text(source)
+    findings, stats = lockorder.analyze_files([str(path)])
+    return findings, stats
+
+
+def test_lockorder_flags_synthetic_cycle(tmp_path):
+    findings, stats = _lockorder_on(tmp_path, "cycle.cc", CYCLE_CC)
+    assert [f.rule for f in findings] == ["lock-order-cycle"]
+    # Both acquisition sites are named (the "both call sites" format).
+    assert "Pool::mu_a_ -> Pool::mu_b_" in findings[0].message
+    assert "Pool::mu_b_ -> Pool::mu_a_" in findings[0].message
+    assert stats["edges"] == 2
+
+
+def test_lockorder_flags_cycle_through_call(tmp_path):
+    findings, _ = _lockorder_on(tmp_path, "call.cc", CALL_CYCLE_CC)
+    assert [f.rule for f in findings] == ["lock-order-cycle"]
+    assert "calls AcquireSecondOnly" in findings[0].message
+
+
+def test_lockorder_flags_guarded_field_violation(tmp_path):
+    findings, stats = _lockorder_on(tmp_path, "guard.cc", GUARD_CC)
+    assert [f.rule for f in findings] == ["guarded-field-unlocked"]
+    assert "Table::count_" in findings[0].message
+    assert stats["guarded_fields"] == 1
+    # the constructor's unlocked init is exempt: exactly ONE finding
+    assert len(findings) == 1
+
+
+def test_lockorder_consistent_order_is_clean(tmp_path):
+    findings, stats = _lockorder_on(tmp_path, "ok.cc", NESTED_OK_CC)
+    assert findings == []
+    assert stats["edges"] == 1
+
+
+def test_lockorder_native_tree_is_clean():
+    """`make check-lockorder` over the real native core: clean, with a
+    meaningful amount audited (acquisitions scanned, annotated fields
+    covered)."""
+    native = os.path.join(REPO_ROOT, "horovod_tpu", "native")
+    files = list(lockorder.iter_sources([native]))
+    assert len(files) > 30
+    findings, stats = lockorder.analyze_files(files)
+    assert findings == [], "\n".join(
+        "%s:%d %s" % (f.path, f.line, f.message) for f in findings)
+    assert stats["functions"] > 300
+    assert stats["guarded_fields"] >= 7
+
+
+def test_lockorder_cli(tmp_path, capsys):
+    path = tmp_path / "cycle.cc"
+    path.write_text(CYCLE_CC)
+    assert lockorder.main([str(path)]) == 1
+    out = capsys.readouterr().out
+    assert "lock-order-cycle" in out
+    ok = tmp_path / "ok.cc"
+    ok.write_text(NESTED_OK_CC)
+    assert lockorder.main([str(ok)]) == 0

@@ -53,7 +53,9 @@ def main():
         loss = tf.reduce_mean((x_ph @ w - y_ph) ** 2)
         opt = hvd.DistributedOptimizer(
             v1.train.GradientDescentOptimizer(0.2))
-        train_op = opt.minimize(loss)
+        # the hook-mismatch bail above returns early on the failing
+        # rank only — an accepted hang hazard on a test error path
+        train_op = opt.minimize(loss)  # hvd-lint: disable=verify-divergent-schedule
         hook = hvd.BroadcastGlobalVariablesHook(root_rank=0)
         with v1.train.MonitoredTrainingSession(hooks=[hook]) as sess:
             first = None
