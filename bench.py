@@ -285,6 +285,11 @@ _ZOO = [
     ("resnet50pbn", ["--batch-size", "256"]),
     ("resnet50gn", ["--batch-size", "256"]),
     ("resnet50nf", ["--batch-size", "256"]),
+    # Round 10: the traffic-lean graph-level BN (custom-VJP x_hat/mask
+    # recompute, ops/batch_norm.py — the island-tax lesson turned into
+    # shipped code) and the AGC-trainable norm-free depth row.
+    ("resnet50lean", ["--batch-size", "256"]),
+    ("resnet101nf", ["--batch-size", "128"]),
     ("resnet101", ["--batch-size", "128"]),
     ("vgg16", ["--batch-size", "64"]),
     ("inception3", ["--batch-size", "128", "--image-size", "299"]),
@@ -1196,6 +1201,226 @@ def autotune_main(args):
     return 0
 
 
+def bn_traffic_step_stats(norm, batch=32, image_size=64, dtype="bfloat16",
+                          bn_remat=False, num_classes=1000):
+    """Compiles the REAL resnet50 train step (make_train_step over a
+    1-device mesh — the same step the throughput bench times) for the
+    given norm variant and returns XLA's own accounting of it:
+    ``{"bytes_accessed", "flops", "temp_bytes"}``.
+
+    Abstract lowering only (eval_shape params, ShapeDtypeStruct batch):
+    no training compute, no chip — reproducible under
+    ``JAX_PLATFORMS=cpu``, which is the whole point of the metric
+    (PERF.md round 10). Shared with the tier-1 bytes-regression guard
+    (tests/test_bn_traffic.py)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models.resnet import ResNet, BottleneckBlock
+    from horovod_tpu.parallel import data_parallel_mesh, make_train_step
+    from horovod_tpu.parallel.train import cross_entropy_loss
+
+    model = ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock,
+                   norm=norm, num_classes=num_classes,
+                   dtype=getattr(jnp, dtype), bn_remat=bn_remat)
+    rng = jax.random.PRNGKey(0)
+    shapes = jax.eval_shape(
+        lambda: model.init(rng, jnp.zeros((1, image_size, image_size, 3)),
+                           train=False))
+    params = jax.tree_util.tree_map(
+        lambda sd: jax.ShapeDtypeStruct(sd.shape, sd.dtype),
+        shapes["params"])
+    # Running-stat VALUES are irrelevant to the lowering; zeros of the
+    # right shape avoid paying a real model init.
+    batch_stats = jax.tree_util.tree_map(
+        lambda sd: jnp.zeros(sd.shape, sd.dtype),
+        shapes.get("batch_stats", {}))
+    mutable = ["batch_stats"] if batch_stats else []
+
+    def loss_fn(p, b):
+        state = {"params": p}
+        if batch_stats:
+            state["batch_stats"] = batch_stats
+            logits, _ = model.apply(state, b["x"], train=True,
+                                    mutable=mutable)
+        else:
+            logits = model.apply(state, b["x"], train=True)
+        return cross_entropy_loss(logits, b["y"])
+
+    mesh = data_parallel_mesh(devices=jax.devices("cpu")[:1])
+    opt = optax.sgd(0.01, momentum=0.9)
+    step = make_train_step(loss_fn, opt, mesh, donate=False)
+    opt_state = jax.eval_shape(opt.init, params)
+    x = jax.ShapeDtypeStruct((batch, image_size, image_size, 3),
+                             jnp.float32)
+    y = jax.ShapeDtypeStruct((batch,), jnp.int32)
+    compiled = step.lower(params, opt_state, {"x": x, "y": y}).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    mem = compiled.memory_analysis()
+    return {
+        "bytes_accessed": float(cost["bytes accessed"]),
+        "flops": float(cost.get("flops", 0.0)),
+        "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
+    }
+
+
+def _nf_agc_convergence(steps=30, lr=0.5, clipping=0.02):
+    """The AGC-makes-norm-free-trainable check on the synthetic task
+    (CPU): a small ResNet trained three ways on the same fixed
+    synthetic classification batch — BatchNorm baseline, norm-free with
+    AGC, norm-free without. The convergence gate: the AGC run must
+    reach the BN baseline's end state (final loss within an absolute
+    ``tolerance`` of BN's — both runs effectively solve the task) with
+    a real decrease; the no-AGC run rides along to show what the clip
+    buys (measured: stuck near its initial loss at this lr while AGC
+    converges — calibrated on CPU, see BENCH_r10)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models.resnet import ResNet, BottleneckBlock
+    from horovod_tpu.parallel import data_parallel_mesh, make_train_step
+    from horovod_tpu.parallel.train import cross_entropy_loss
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(32, 16, 16, 3).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 10, size=32).astype(np.int32))
+    mesh = data_parallel_mesh(devices=None)
+
+    def run(norm, agc):
+        model = ResNet(stage_sizes=[2], block_cls=BottleneckBlock,
+                       num_classes=10, num_filters=8,
+                       dtype=jnp.float32, norm=norm)
+        variables = model.init(jax.random.PRNGKey(0), x[:1], train=False)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        mutable = ["batch_stats"] if batch_stats else []
+
+        def loss_fn(p, b):
+            state = {"params": p}
+            if batch_stats:
+                state["batch_stats"] = batch_stats
+                logits, _ = model.apply(state, b["x"], train=True,
+                                        mutable=mutable)
+            else:
+                logits = model.apply(state, b["x"], train=True)
+            return cross_entropy_loss(logits, b["y"])
+
+        opt = optax.sgd(lr, momentum=0.9)
+        step = make_train_step(loss_fn, opt, mesh, donate=False, agc=agc)
+        pp, os_, batch = step.place(params, opt.init(params),
+                                    {"x": x, "y": y})
+        losses = []
+        for _ in range(steps):
+            pp, os_, loss = step(pp, os_, batch)
+            losses.append(float(loss))
+        return losses
+
+    bn = run("batch", None)
+    nf_agc = run("none", clipping)
+    nf_plain = run("none", None)
+    tolerance = 0.15  # absolute final-loss gap; both runs solve the task
+    final_ok = np.isfinite(nf_agc[-1]) and \
+        nf_agc[-1] <= bn[-1] + tolerance
+    decreased = np.isfinite(nf_agc[-1]) and nf_agc[-1] < nf_agc[0] * 0.3
+    return {
+        "steps": steps, "lr": lr, "agc_clipping": clipping,
+        "tolerance_abs_final_loss": tolerance,
+        "bn_losses": [round(v, 4) for v in bn],
+        "nf_agc_losses": [round(v, 4) for v in nf_agc],
+        "nf_no_agc_final_loss": round(nf_plain[-1], 4)
+        if np.isfinite(nf_plain[-1]) else None,
+        "bn_final_loss": round(bn[-1], 4),
+        "nf_agc_final_loss": round(nf_agc[-1], 4)
+        if np.isfinite(nf_agc[-1]) else None,
+        "loss_match": bool(final_ok and decreased),
+    }
+
+
+def bn_traffic_main(args):
+    """bench.py --bn-traffic (PERF.md round 10): the graph-level BN
+    A/B, fully reproducible off-chip. Per-step ``cost_analysis()``
+    bytes-accessed for the resnet50 train step under stock flax BN vs
+    the traffic-lean custom-VJP BN (`norm="lean"`), with the norm-free
+    step as the conv-only floor.
+
+    Headline (`value`): the BN-TAX reduction — the share of
+    (step - norm-free-floor) bytes the lean path eliminates. The
+    whole-step reduction and the zero-BN ceiling ride in the row:
+    BN-attributable bytes are ~24% of this step's total on the CPU
+    cost model, so the whole-step number is bounded by that ceiling no
+    matter how lean the BN is — the tax metric is the honest A/B for
+    the BN data path itself. Acceptance: tax reduction >= 20%, AGC
+    norm-free convergence gate green."""
+    batch, s = args.bn_traffic_batch, args.bn_traffic_image_size
+    rows = {}
+    for norm in ("batch", "lean", "none"):
+        rows[norm] = bn_traffic_step_stats(norm, batch, s)
+        print("bn-traffic %-5s: %.4e bytes, temp %.3e" %
+              (norm, rows[norm]["bytes_accessed"],
+               rows[norm]["temp_bytes"]), file=sys.stderr)
+    rows["lean_remat"] = bn_traffic_step_stats("lean", batch, s,
+                                               bn_remat=True)
+
+    stock = rows["batch"]["bytes_accessed"]
+    lean = rows["lean"]["bytes_accessed"]
+    floor = rows["none"]["bytes_accessed"]
+    tax_stock = stock - floor
+    tax_lean = lean - floor
+    tax_reduction = 1.0 - tax_lean / tax_stock
+    step_reduction = 1.0 - lean / stock
+    ceiling = 1.0 - floor / stock
+
+    conv = _nf_agc_convergence()
+    if not conv["loss_match"]:
+        raise RuntimeError(
+            "norm-free + AGC convergence gate failed: %s" % conv)
+    if tax_reduction < 0.20:
+        raise RuntimeError(
+            "lean BN removed only %.1f%% of the BN-attributable bytes "
+            "(acceptance >= 20%%): stock tax %.3e, lean tax %.3e"
+            % (100 * tax_reduction, tax_stock, tax_lean))
+
+    emit({
+        "metric": "bn_traffic_tax_reduction",
+        "value": round(tax_reduction, 4),
+        "unit": "frac_bn_attributable_bytes_removed_resnet50_cpu",
+        "config": {"model": "resnet50", "batch": batch,
+                   "image_size": s, "dtype": "bfloat16",
+                   "platform": "cpu_cost_analysis"},
+        "stock_bytes_accessed": stock,
+        "lean_bytes_accessed": lean,
+        "normfree_floor_bytes_accessed": floor,
+        "step_bytes_reduction": round(step_reduction, 4),
+        "zero_bn_step_ceiling": round(ceiling, 4),
+        "bn_tax_bytes": {"stock": tax_stock, "lean": tax_lean},
+        "temp_bytes": {k: v["temp_bytes"] for k, v in rows.items()},
+        # temp_bytes is 0 on toolchains whose memory_analysis lacks the
+        # field — the ratio is diagnostics, never worth crashing the
+        # headline metric over.
+        "temp_bytes_reduction_lean_vs_stock": round(
+            1.0 - rows["lean"]["temp_bytes"] /
+            rows["batch"]["temp_bytes"], 4)
+        if rows["batch"]["temp_bytes"] else None,
+        "lean_remat_bytes_accessed": rows["lean_remat"]["bytes_accessed"],
+        "agc_convergence": conv,
+        "vs_baseline": None,
+        "baseline": "same-run stock flax-BN resnet50 train step "
+                    "(cost_analysis bytes; norm='none' is the conv-only "
+                    "floor). The whole-step reduction is bounded by the "
+                    "zero-BN ceiling (~%.0f%% here): BN-attributable "
+                    "bytes are that share of the step on the CPU cost "
+                    "model, so the acceptance gate applies to the BN "
+                    "tax the lean path actually owns. Acceptance: tax "
+                    "reduction >= 20%%, AGC norm-free convergence green"
+                    % (100 * ceiling),
+    })
+    return 0
+
+
 def _prior_round_value(metric):
     """Newest prior-round row with the same metric name, scanned from
     the BENCH_r*.json / BENCH_ZOO_r*.json artifacts at the repo root
@@ -1546,7 +1771,8 @@ def main():
     ap.add_argument("--num-iters", type=int, default=10)
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet50", "resnet50gn", "resnet50nf",
-                             "resnet50pbn", "resnet101", "resnet152",
+                             "resnet50lean", "resnet50pbn", "resnet101",
+                             "resnet101nf", "resnet152",
                              "vgg16", "inception3", "inception3pbn",
                              "transformer", "word2vec"],
                     help="vgg16/inception3 are the other models in the "
@@ -1592,6 +1818,32 @@ def main():
                          "log_softmax loss — required for very long "
                          "sequences (dense f32 logits at L=8192 "
                          "exceed a v5e's HBM)")
+    ap.add_argument("--sync-bn", action="store_true",
+                    help="cross-replica (sync) BN for the resnet "
+                         "variants: batch statistics psum over the "
+                         "data-parallel mesh axis inside the train "
+                         "step (ops/batch_norm.py; the standard choice "
+                         "at small per-chip batch)")
+    ap.add_argument("--virtual-batch-size", type=int, default=0,
+                    help="ghost BN for the resnet lean/pallas "
+                         "variants: per-group virtual batch "
+                         "(ops/batch_norm.py; the large-per-chip-batch "
+                         "regularizer). 0 = off")
+    ap.add_argument("--bn-traffic", action="store_true",
+                    help="graph-level BN A/B, CPU-reproducible (PERF.md "
+                         "round 10): per-step cost_analysis() bytes "
+                         "accessed for the resnet50 train step under "
+                         "stock flax BN vs the traffic-lean custom-VJP "
+                         "BN, with the norm-free conv-only floor, the "
+                         "BN-tax reduction as the headline, and the "
+                         "AGC norm-free convergence gate; prints one "
+                         "JSON line (works under JAX_PLATFORMS=cpu)")
+    ap.add_argument("--bn-traffic-batch", type=int, default=32,
+                    help="--bn-traffic batch size (CPU-compilable "
+                         "stand-in for the chip's batch-256 shape; the "
+                         "A/B ratio, not the absolute bytes, is the "
+                         "metric)")
+    ap.add_argument("--bn-traffic-image-size", type=int, default=64)
     ap.add_argument("--all-models", action="store_true",
                     help="run the whole model-zoo sweep (one subprocess "
                          "per model) and print a single combined JSON "
@@ -1667,6 +1919,8 @@ def main():
 
     if args.scaling_worker is not None:
         return scaling_worker(args)
+    if args.bn_traffic:
+        return bn_traffic_main(args)
     if args.compression is not None:
         return compression_main(args)
     if args.sharded_update:
@@ -1779,14 +2033,33 @@ def main():
         model_cls = {"resnet50": models.ResNet50,
                      "resnet50gn": models.ResNet50GN,
                      "resnet50nf": models.ResNet50NF,
+                     "resnet50lean": models.ResNet50Lean,
                      "resnet50pbn": models.ResNet50PBN,
                      "resnet101": models.ResNet101,
+                     "resnet101nf": models.ResNet101NF,
                      "resnet152": models.ResNet152,
                      "vgg16": models.VGG16,
                      "inception3": models.InceptionV3,
                      "inception3pbn": partial(models.InceptionV3,
                                               norm="pallas")}[args.model]
-        model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
+        extra = {}
+        if args.sync_bn or args.virtual_batch_size:
+            if not args.model.startswith("resnet") or \
+                    args.model.endswith(("nf", "gn")):
+                raise SystemExit(
+                    "--sync-bn/--virtual-batch-size apply to the "
+                    "BN-carrying resnet variants (GroupNorm has no "
+                    "cross-sample statistics to sync)")
+            if args.sync_bn:
+                # The train step's mesh axis (parallel/train.py): the
+                # stats psum rides the same shard_map the gradients do.
+                extra["bn_axis_name"] = "hvd"
+            if args.virtual_batch_size:
+                if args.model not in ("resnet50lean", "resnet50pbn"):
+                    raise SystemExit("--virtual-batch-size needs the "
+                                     "lean or pallas BN variants")
+                extra["bn_virtual_batch_size"] = args.virtual_batch_size
+        model = model_cls(num_classes=1000, dtype=jnp.bfloat16, **extra)
 
         s = args.image_size
         variables = model.init(rng, jnp.zeros((1, s, s, 3)), train=False)
@@ -1807,9 +2080,24 @@ def main():
                                      rngs={"dropout": drop_rng})
             return cross_entropy_loss(logits, batch["y"])
 
+        # Norm-free variants train with adaptive gradient clipping
+        # (ops/agc.py): the knob that makes the measured-fastest route
+        # an actual training config, not just a roofline probe. Cost
+        # rides in the measured step like any real run. zero1 cannot
+        # carry AGC (flat shards destroy the unit structure) — fail
+        # loudly rather than silently measure an untrainable config.
+        agc = None
+        if args.model.endswith("nf"):
+            if args.zero1:
+                raise SystemExit(
+                    "--zero1 with a norm-free model would drop AGC "
+                    "(sharded updates see 1/N flat shards, not "
+                    "per-filter units) — the measured step would not "
+                    "be a trainable config; run nf rows replicated")
+            agc = 0.01
         opt = optax.sgd(0.01, momentum=0.9)
         step = make_train_step(loss_fn, opt, mesh, donate=True,
-                               zero1=args.zero1)
+                               zero1=args.zero1, agc=agc)
 
         global_batch = args.batch_size * n
         x = jax.random.normal(rng, (global_batch, s, s, 3), jnp.float32)

@@ -438,7 +438,7 @@ def broadcast_optimizer_state(opt_state, root_rank=0,
 def DistributedOptimizer(optimizer, compression=None,
                          average=True, name_prefix="grad",
                          axis_name=AXIS_NAME, sharded_update=None,
-                         group=None):
+                         group=None, agc=None):
     """Wraps an optax GradientTransformation so every update first averages
     gradients across ranks (reference: _DistributedOptimizer,
     tensorflow/__init__.py:231-258).
@@ -468,12 +468,30 @@ def DistributedOptimizer(optimizer, compression=None,
     ``hvd.init(model_parallel=k)`` it DEFAULTS to this rank's batch
     group, so a mesh job's gradients average over the ranks sharing its
     model shard without any call-site change (docs/GROUPS.md).
+
+    ``agc`` enables adaptive gradient clipping at the given clipping
+    factor (e.g. 0.01 — ``ops/agc.py``, arxiv 2102.06171): each
+    parameter's reduced gradient is unit-wise clipped against the
+    parameter's own norm BEFORE the inner optimizer. This is what makes
+    the norm-free zoo variants (``resnet50nf``/``resnet101nf`` — the
+    measured-fastest conv route, PERF.md) trainable; it requires
+    ``update(grads, state, params)`` and is rejected under
+    ``sharded_update`` (1/N flat shards destroy the unit structure).
     """
     import optax
 
     if sharded_update is None:
         sharded_update = _ops.sharded_update_default()
     if sharded_update:
+        if agc is not None:
+            raise ValueError(
+                "agc= does not compose with sharded_update: the sharded "
+                "path updates 1/N flat shards, which destroys the "
+                "per-unit (output-row) norm structure AGC clips against "
+                "— every rank would clip a different slice of each "
+                "filter. Use replicated updates with AGC, or chain "
+                "optax.adaptive_grad_clip equivalents before a "
+                "replicated optimizer")
         from horovod_tpu.groups import assert_sharded_update_world_scope
         assert_sharded_update_world_scope(group)
         return _sharded_distributed_optimizer(optimizer, compression,
@@ -491,6 +509,16 @@ def DistributedOptimizer(optimizer, compression=None,
                                       name_prefix=name_prefix,
                                       compression=compression,
                                       axis_name=axis_name, group=grp)
+        if agc is not None:
+            # Clip AFTER the reduction: the threshold applies to the
+            # true global gradient, and every rank clips identically.
+            from horovod_tpu.ops.agc import agc_clip
+            if params is None:
+                raise ValueError(
+                    "agc= needs params: call update(grads, state, "
+                    "params) — the clip threshold is relative to each "
+                    "parameter's unit-wise norm")
+            updates = agc_clip(updates, params, clipping=agc)
         return optimizer.update(updates, state, params)
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -715,6 +743,38 @@ def init_distributed(local_device_ids=None):
         coordinator_address="%s:%d" % (host, port),
         num_processes=size, process_id=_hvd.rank(),
         local_device_ids=local_device_ids)
+
+
+def sync_batch_norm_stats(stat_sum, stat_sumsq, count, group=None,
+                          name="sync_bn", axis_name=AXIS_NAME):
+    """Distributed-BN stats reduction (docs/GROUPS.md composition): sums
+    per-replica (sum, sum-of-squares) partial statistics across ranks —
+    ``group``-scoped on the host plane (e.g. ``hvd.batch_group()`` under
+    a 2-D mesh so statistics stay within the batch group), psum when a
+    mapped axis is in scope — and returns ``(mean, var, global_count)``.
+
+    The standalone jax-wrapper surface for CUSTOM norm layers bringing
+    their own one-pass statistics. The shipped modules
+    (``ops.batch_norm.LeanBatchNorm(sync_group=...)`` /
+    ``PallasBatchNorm(axis_name=...)``) do this same reduction inside
+    their custom VJPs (``_lean_sync`` — the backward needs its own
+    group-scoped pass, which a forward-only helper cannot provide).
+    ``count`` is the PER-REPLICA element count behind the partial sums
+    (a static int)."""
+    from horovod_tpu import groups as _grp
+
+    stacked = jnp.stack([jnp.asarray(stat_sum, jnp.float32),
+                         jnp.asarray(stat_sumsq, jnp.float32)])
+    if _is_traced(stacked) and _axis_in_scope(axis_name):
+        total = jax.lax.psum(stacked, axis_name)
+        n = jax.lax.psum(1, axis_name)
+    else:
+        total = allreduce(stacked, average=False, name=name, group=group)
+        n = _grp.group_size(group)
+    global_count = count * n
+    mean = total[0] / global_count
+    var = jnp.maximum(total[1] / global_count - mean * mean, 0.0)
+    return mean, var, global_count
 
 
 def metric_average(value, name=None):

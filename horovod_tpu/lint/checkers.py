@@ -256,6 +256,111 @@ def check_missing_initial_broadcast(model):
         % site.func)
 
 
+# torch BN module constructors whose instances carry running-stat
+# BUFFERS (state_dict()-visible, parameters()-invisible).
+_TORCH_BN_CTORS = {"BatchNorm1d", "BatchNorm2d", "BatchNorm3d",
+                   "SyncBatchNorm"}
+# Broadcast-argument call names that cover torch BN buffers.
+_TORCH_BUFFER_SOURCES = {"state_dict", "named_buffers", "buffers"}
+
+
+@register("missing-bn-stats-broadcast", WARNING,
+          "mutable BN state trained without broadcasting/syncing the "
+          "running statistics")
+def check_missing_bn_stats_broadcast(model):
+    """The mutable-BN-state extension of ``missing-initial-broadcast``:
+    a model carrying BatchNorm RUNNING STATISTICS (a flax
+    ``batch_stats`` collection, or torch BN buffers) trained under a
+    gradient-averaging wrapper updates those stats PER RANK from
+    per-rank batches — they are never averaged by the gradient
+    allreduce, so ranks silently diverge and evaluation results depend
+    on which rank you ask. Unlike weights (where the initial broadcast
+    plus synchronized updates keep ranks identical), BN stats need
+    either an explicit broadcast/sync of the stats collection or
+    cross-replica (sync) BN. A plain ``broadcast_parameters(params)``
+    does NOT cover them: flax keeps them in a separate collection, and
+    torch's ``model.parameters()`` excludes buffers —
+    ``state_dict()`` includes them."""
+    import ast as _ast
+
+    markers = [s for s in model.call_sites if s.func in TRAIN_MARKERS]
+    if not markers:
+        return
+    flax_bn = any(isinstance(n, _ast.Constant) and n.value == "batch_stats"
+                  for n in _ast.walk(model.tree))
+    torch_bn = False
+    for n in _ast.walk(model.tree):
+        if isinstance(n, _ast.Call):
+            _, attr = walker._call_base_attr(n.func)
+            if attr in _TORCH_BN_CTORS:
+                torch_bn = True
+            # Sync BN satisfies: statistics are reduced across replicas
+            # inside the step, so every rank holds identical stats by
+            # construction (axis_name=/sync_group= on a *Norm module,
+            # or a model's bn_axis_name=/bn_sync_group=).
+            norm_ctor = attr is not None and "Norm" in attr
+            for kw in n.keywords:
+                sync_arg = (norm_ctor and
+                            kw.arg in ("axis_name", "sync_group")) or \
+                    kw.arg in ("bn_axis_name", "bn_sync_group")
+                if sync_arg and not (isinstance(kw.value, _ast.Constant)
+                                     and kw.value.value is None):
+                    return
+    if not flax_bn and not torch_bn:
+        return
+
+    # Variables known to hold the FULL flax variables dict (something
+    # subscripted with "batch_stats" elsewhere): broadcasting one of
+    # those covers the stats.
+    vars_with_stats = set()
+    for n in _ast.walk(model.tree):
+        if isinstance(n, _ast.Subscript) and \
+                isinstance(n.value, _ast.Name) and \
+                isinstance(n.slice, _ast.Constant) and \
+                n.slice.value == "batch_stats":
+            vars_with_stats.add(n.value.id)
+
+    def covers_stats(arg):
+        if isinstance(arg, _ast.Name) and arg.id in vars_with_stats:
+            return True
+        for sub in _ast.walk(arg):
+            if isinstance(sub, _ast.Constant) and \
+                    sub.value == "batch_stats":
+                return True
+            if isinstance(sub, _ast.Call):
+                _, attr = walker._call_base_attr(sub.func)
+                if attr in _TORCH_BUFFER_SOURCES:
+                    return True
+        return False
+
+    for site in model.call_sites:
+        if site.func not in INITIAL_BROADCASTS:
+            continue
+        if site.func in ("BroadcastGlobalVariablesHook",
+                         "BroadcastGlobalVariablesCallback",
+                         "broadcast_global_variables"):
+            return  # TF globals include the moving-average variables
+        for arg in list(site.args) + list(site.kwargs.values()):
+            if covers_stats(arg):
+                return
+
+    kind = "flax `batch_stats` collection" if flax_bn else \
+        "torch BatchNorm buffers (running_mean/running_var)"
+    yield make_finding(
+        model, markers[0].node, "missing-bn-stats-broadcast",
+        "`%s` trains a model carrying mutable BN state (%s) but nothing "
+        "broadcasts or syncs those running statistics: each rank "
+        "updates them from its OWN batches, so they silently diverge — "
+        "training looks healthy (gradients are averaged) and eval "
+        "results differ per rank. Broadcast the stats collection "
+        "alongside the params (flax: broadcast_parameters(variables["
+        "\"batch_stats\"]); torch: broadcast_parameters(model."
+        "state_dict()) — parameters() excludes buffers), periodically "
+        "re-sync before eval, or use sync BN (axis_name=/sync_group=), "
+        "which keeps every rank's statistics identical by construction"
+        % (markers[0].func, kind))
+
+
 @register("unordered-name-iteration", ERROR,
           "collective name derived from unordered set/dict iteration")
 def check_unordered_iteration(model):

@@ -19,7 +19,8 @@ float32 parameters, static shapes, no data-dependent Python control flow.
 """
 
 from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet50GN,  # noqa: F401
-                     ResNet50NF, ResNet50PBN, ResNet101, ResNet152)
+                     ResNet50Lean, ResNet50NF, ResNet50PBN, ResNet101,
+                     ResNet101NF, ResNet152)
 from .mnist import MnistCNN  # noqa: F401
 from .word2vec import SkipGram  # noqa: F401
 from .transformer import Transformer, TransformerConfig  # noqa: F401

@@ -31,13 +31,22 @@ class ResNetBlock(nn.Module):
     norm: ModuleDef
     act: Callable
     strides: Tuple[int, int] = (1, 1)
+    # Fused norm+activation factory (norm="lean"): the norm module
+    # applies the ReLU itself so its backward recomputes the mask from
+    # the pre-activation sign instead of storing it. None = norm then
+    # act separately (every other norm path).
+    norm_act: Optional[ModuleDef] = None
+
+    def _norm_act(self, y):
+        if self.norm_act is not None:
+            return self.norm_act()(y)
+        return self.act(self.norm()(y))
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (3, 3), self.strides)(x)
-        y = self.norm()(y)
-        y = self.act(y)
+        y = self._norm_act(y)
         y = self.conv(self.filters, (3, 3))(y)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
@@ -54,16 +63,20 @@ class BottleneckBlock(nn.Module):
     norm: ModuleDef
     act: Callable
     strides: Tuple[int, int] = (1, 1)
+    norm_act: Optional[ModuleDef] = None  # see ResNetBlock
+
+    def _norm_act(self, y):
+        if self.norm_act is not None:
+            return self.norm_act()(y)
+        return self.act(self.norm()(y))
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (1, 1))(x)
-        y = self.norm()(y)
-        y = self.act(y)
+        y = self._norm_act(y)
         y = self.conv(self.filters, (3, 3), self.strides)(y)
-        y = self.norm()(y)
-        y = self.act(y)
+        y = self._norm_act(y)
         y = self.conv(self.filters * 4, (1, 1))(y)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
@@ -80,7 +93,13 @@ class ResNet(nn.Module):
     roofline experiment: BN's cross-batch statistics force f32
     convert+reduce passes over every activation (the measured HBM
     bottleneck), while GN's within-sample stats stay in the compute
-    dtype with f32 reduce accumulation only."""
+    dtype with f32 reduce accumulation only.
+
+    `norm="lean"` is the round-10 traffic-lean graph-level BN
+    (ops/batch_norm.LeanBatchNorm): one-pass variadic-reduce stats, a
+    custom VJP that recomputes x_hat (and, for the norm+ReLU pairs, the
+    ReLU mask) instead of storing them, never leaving XLA's fusion
+    graph — the shape the round-4 island-tax measurement demanded."""
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
     num_classes: int = 1000
@@ -88,15 +107,28 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
     norm: str = "batch"
     # Cross-replica (sync) BN: psum batch statistics over this mesh
-    # axis (both the flax and the Pallas norm paths support it). The
+    # axis (the flax, Pallas, and lean norm paths all support it). The
     # standard choice at small per-chip batch, where per-device BN
     # statistics get noisy.
     bn_axis_name: Optional[str] = None
+    # Host-plane sync-BN scope (norm="lean"/"pallas" via the lean path):
+    # a hvd.ProcessGroup (e.g. hvd.batch_group() under a 2-D mesh) or
+    # the string "world" — statistics ride the host collectives
+    # group-scoped (docs/GROUPS.md).
+    bn_sync_group: Any = None
+    # Ghost BN (norm="lean"/"pallas"): virtual batch each normalization
+    # group sees; None = the whole per-replica batch.
+    bn_virtual_batch_size: Optional[int] = None
+    # BN-scoped remat (norm="lean"): recompute the normalize-pass
+    # outputs in the backward instead of saving them
+    # (ops.batch_norm.bn_remat_policy applied per residual block).
+    bn_remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
                        param_dtype=jnp.float32)
+        norm_act = None
         if self.norm == "none":
             # Normalizer-free roofline probe: measures the conv-only
             # ceiling (NF-ResNet-style models train like this with
@@ -115,7 +147,21 @@ class ResNet(nn.Module):
             norm = partial(PallasBatchNorm, use_running_average=not train,
                            momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                            param_dtype=jnp.float32,
-                           axis_name=self.bn_axis_name)
+                           axis_name=self.bn_axis_name,
+                           virtual_batch_size=self.bn_virtual_batch_size)
+        elif self.norm == "lean":
+            # Traffic-lean graph-level BN (round 10, ops/batch_norm.py).
+            from horovod_tpu.ops.batch_norm import LeanBatchNorm
+            norm = partial(LeanBatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                           param_dtype=jnp.float32,
+                           axis_name=self.bn_axis_name,
+                           sync_group=self.bn_sync_group,
+                           virtual_batch_size=self.bn_virtual_batch_size)
+            # The norm+ReLU pairs fuse (backward mask recomputed from
+            # the pre-activation sign); block-final norms and the
+            # post-residual-add ReLUs stay separate.
+            norm_act = partial(norm, fuse_relu=True)
         else:
             norm = partial(nn.BatchNorm, use_running_average=not train,
                            momentum=0.9, epsilon=1e-5, dtype=self.dtype,
@@ -123,17 +169,25 @@ class ResNet(nn.Module):
                            axis_name=self.bn_axis_name)
         act = nn.relu
 
+        block_cls = self.block_cls
+        if self.bn_remat:
+            from horovod_tpu.ops.batch_norm import bn_remat_policy
+            block_cls = nn.remat(block_cls, policy=bn_remat_policy())
+
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                  name="conv_init")(x)
-        x = norm(name="bn_init")(x)
-        x = act(x)
+        if norm_act is not None:
+            x = norm_act(name="bn_init")(x)
+        else:
+            x = act(norm(name="bn_init")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for i, block_size in enumerate(self.stage_sizes):
             for j in range(block_size):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = self.block_cls(self.num_filters * 2 ** i, conv=conv,
-                                   norm=norm, act=act, strides=strides)(x)
+                x = block_cls(self.num_filters * 2 ** i, conv=conv,
+                              norm=norm, act=act, strides=strides,
+                              norm_act=norm_act)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype,
                      param_dtype=jnp.float32)(x)
@@ -153,3 +207,7 @@ ResNet50PBN = partial(ResNet, stage_sizes=[3, 4, 6, 3],
                       block_cls=BottleneckBlock, norm="pallas")
 ResNet50NF = partial(ResNet, stage_sizes=[3, 4, 6, 3],
                      block_cls=BottleneckBlock, norm="none")
+ResNet50Lean = partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                       block_cls=BottleneckBlock, norm="lean")
+ResNet101NF = partial(ResNet, stage_sizes=[3, 4, 23, 3],
+                      block_cls=BottleneckBlock, norm="none")

@@ -51,7 +51,7 @@ def _structure_cached_step(build):
 
 def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
                     compression=None, donate=True, zero1=False,
-                    accum_steps=1):
+                    accum_steps=1, agc=None):
     """Builds a jitted data-parallel train step over `mesh`.
 
     Args:
@@ -80,6 +80,12 @@ def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
         whole tensors. ``place()`` builds the sharded optimizer state
         itself (pass ``opt_state=None`` or the plain init — it is
         replaced).
+      agc: adaptive-gradient-clipping factor (e.g. 0.01; None = off).
+        Applied by the wrapped DistributedOptimizer after the gradient
+        psum — the norm-free zoo variants' trainability knob
+        (ops/agc.py, arxiv 2102.06171). Rejected with zero1: the
+        sharded update sees 1/N flat shards, which destroys the
+        per-unit norm structure AGC clips against.
       accum_steps: gradient accumulation — the flagship analogue of
         the torch binding's ``backward_passes_per_step`` (reference
         torch/__init__.py). The per-shard batch is split into
@@ -106,12 +112,19 @@ def make_train_step(loss_fn, optimizer, mesh, axis_name="hvd",
     zero1_mode = _wire.resolve_wire_arg(
         compression, hvd_jax.Compression.none) \
         if zero1 else _wire.Compression.none
+    if agc is not None and zero1:
+        raise ValueError(
+            "agc= does not compose with zero1: the sharded update "
+            "applies the optimizer to 1/N flat shards, which destroys "
+            "the per-unit (output-row) norm structure AGC clips "
+            "against — every rank would clip a different slice of "
+            "each filter")
     # Library helper, not a training script: the caller owns the initial
     # parameter sync (place() replicates params over the mesh, and host
     # checkpoint restore broadcasts before entering the step).
     # hvd-lint: disable=missing-initial-broadcast
     dist_opt = hvd_jax.DistributedOptimizer(
-        optimizer, compression=compression, axis_name=axis_name)
+        optimizer, compression=compression, axis_name=axis_name, agc=agc)
     n_shards = int(mesh.shape[axis_name])
 
     def _flat_pad(x):

@@ -474,7 +474,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     torch/__init__.py:108-143); `step()` drains the handles first."""
 
     def __init__(self, params, named_parameters, compression,
-                 backward_passes_per_step=1, group=None):
+                 backward_passes_per_step=1, group=None, agc=None):
         # params is the wrapped optimizer's param_groups: each group dict
         # already carries its hyperparameters, so the parent optimizer's
         # defaults never overwrite them (same trick as the reference,
@@ -482,6 +482,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self._backward_passes_per_step = backward_passes_per_step
+        # Adaptive gradient clipping factor (ops/agc.py, arxiv
+        # 2102.06171): unit-wise clip of each reduced gradient against
+        # its parameter's norm, applied in step() AFTER synchronize()
+        # so the threshold sees the true global gradient and every rank
+        # clips identically. The norm-free models' trainability knob.
+        self._agc = agc
         # Gradient-reduction scope (docs/GROUPS.md): None = resolve this
         # rank's CURRENT batch group at each reduce — resolving at
         # construction would capture a group id that goes stale across
@@ -574,6 +580,32 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         (reference: torch/__init__.py:164-182)."""
         return self._SkipSync(self)
 
+    def _agc_clip_grads(self):
+        """Unit-wise adaptive gradient clipping (AGC) in place on every
+        p.grad: g *= min(1, agc * max(||w_unit||, eps) / ||g_unit||),
+        units = output rows (dim 0 of torch's (out, in, ...) layout;
+        whole tensor for <=1-D). Mirrors ops/agc.py for the jax plane."""
+        eps = 1e-3
+        with torch.no_grad():
+            for pg in self.param_groups:
+                for p in pg["params"]:
+                    if p.grad is None:
+                        continue
+                    if p.dim() <= 1:
+                        dims, keep = None, False
+                    else:
+                        dims, keep = tuple(range(1, p.dim())), True
+                    if dims is None:
+                        p_norm = p.norm()
+                        g_norm = p.grad.norm()
+                    else:
+                        p_norm = p.norm(dim=dims, keepdim=keep)
+                        g_norm = p.grad.norm(dim=dims, keepdim=keep)
+                    max_norm = self._agc * p_norm.clamp(min=eps)
+                    scale = (max_norm / g_norm.clamp(min=1e-16)).clamp(
+                        max=1.0)
+                    p.grad.mul_(scale)
+
     def step(self, closure=None):
         if self._should_synchronize:
             if self._synchronized:
@@ -583,6 +615,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     "pass (synchronize() already ran)")
             self.synchronize()
         self._synchronized = False
+        if self._agc:
+            self._agc_clip_grads()
         return super(self.__class__, self).step(closure)
 
     def zero_grad(self, *args, **kwargs):
@@ -813,7 +847,7 @@ class _ShardedOptimizer(torch.optim.Optimizer):
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step=1,
-                         sharded_update=None, group=None):
+                         sharded_update=None, group=None, agc=None):
     """Wraps `optimizer` into a gradient-averaging distributed optimizer
     (reference: torch/__init__.py DistributedOptimizer factory — dynamic
     subclass so isinstance(opt, type(optimizer)) keeps working).
@@ -827,12 +861,27 @@ def DistributedOptimizer(optimizer, named_parameters=None,
 
     ``group`` scopes the gradient averaging to a process group
     (docs/GROUPS.md); it defaults to this rank's batch group under
-    ``hvd.init(model_parallel=k)``."""
+    ``hvd.init(model_parallel=k)``.
+
+    ``agc`` enables adaptive gradient clipping at the given factor
+    (e.g. 0.01 — unit-wise clip against each parameter's own norm,
+    ops/agc.py, arxiv 2102.06171), applied in ``step()`` after the
+    gradient synchronize — the knob that makes norm-free models
+    trainable. Rejected with ``sharded_update`` (1/N flat shards
+    destroy the unit structure)."""
     if sharded_update is None:
         sharded_update = _ops.sharded_update_default()
-    base = (_ShardedOptimizer if sharded_update
-            else _DistributedOptimizer)
+    if sharded_update:
+        if agc is not None:
+            raise ValueError(
+                "agc= does not compose with sharded_update: the "
+                "sharded path updates 1/N flat shards, destroying the "
+                "per-unit norm structure AGC clips against")
+        cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+                   dict(_ShardedOptimizer.__dict__))
+        return cls(optimizer.param_groups, named_parameters, compression,
+                   backward_passes_per_step, group)
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
-               dict(base.__dict__))
+               dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
-               backward_passes_per_step, group)
+               backward_passes_per_step, group, agc)

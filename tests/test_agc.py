@@ -1,0 +1,126 @@
+"""Adaptive gradient clipping (ops/agc.py) — the norm-free route's
+trainability knob: unit-norm rules, the optax transformation, and the
+DistributedOptimizer wiring on the jax and torch planes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.agc import adaptive_grad_clip, agc_clip, unitwise_norm
+
+
+def _ref_clip(g, w, clipping=0.01, eps=1e-3):
+    if g.ndim <= 1:
+        gn = np.sqrt((g ** 2).sum())
+        pn = np.sqrt((w ** 2).sum())
+        mx = clipping * max(pn, eps)
+        return g * (mx / max(gn, 1e-16)) if gn > mx else g
+    axes = tuple(range(g.ndim - 1))
+    gn = np.sqrt((g ** 2).sum(axis=axes, keepdims=True))
+    pn = np.sqrt((w ** 2).sum(axis=axes, keepdims=True))
+    mx = clipping * np.maximum(pn, eps)
+    return np.where(gn > mx, g * (mx / np.maximum(gn, 1e-16)), g)
+
+
+@pytest.mark.parametrize("shape", [(16,), (8, 16), (3, 3, 8, 16), ()])
+def test_agc_clip_matches_reference(shape):
+    rng = np.random.RandomState(0)
+    w = np.asarray(rng.randn(*shape), np.float32) * 0.1
+    g = np.asarray(rng.randn(*shape), np.float32) * 10.0
+    out = np.asarray(agc_clip({"p": jnp.asarray(g)},
+                              {"p": jnp.asarray(w)}, clipping=0.01)["p"])
+    np.testing.assert_allclose(out, _ref_clip(g, w), rtol=1e-5, atol=1e-7)
+
+
+def test_agc_clipped_unit_norms_bounded():
+    rng = np.random.RandomState(1)
+    w = jnp.asarray(rng.randn(3, 3, 4, 8).astype(np.float32) * 0.1)
+    g = jnp.asarray(rng.randn(3, 3, 4, 8).astype(np.float32) * 100.0)
+    c = agc_clip({"w": g}, {"w": w}, clipping=0.01)["w"]
+    cn = np.asarray(unitwise_norm(c)).ravel()
+    mx = 0.01 * np.maximum(np.asarray(unitwise_norm(w)).ravel(), 1e-3)
+    assert (cn <= mx * (1 + 1e-5)).all()
+
+
+def test_agc_leaves_small_gradients_untouched():
+    w = jnp.ones((4, 8))
+    g = jnp.full((4, 8), 1e-6)
+    out = agc_clip({"w": g}, {"w": w}, clipping=0.01)["w"]
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(g))
+
+
+def test_adaptive_grad_clip_optax_transformation():
+    import optax
+
+    tx = optax.chain(adaptive_grad_clip(0.01), optax.sgd(1.0))
+    params = {"w": jnp.ones((4, 8)) * 0.5}
+    state = tx.init(params)
+    big = {"w": jnp.full((4, 8), 50.0)}
+    updates, _ = tx.update(big, state, params)
+    col_norms = np.sqrt((np.asarray(updates["w"]) ** 2).sum(0))
+    expect = 0.01 * np.sqrt((np.asarray(params["w"]) ** 2).sum(0))
+    np.testing.assert_allclose(col_norms, expect, rtol=1e-5)
+    with pytest.raises(ValueError):
+        tx.update(big, state)  # params required
+
+
+def test_distributed_optimizer_agc_wiring():
+    import optax
+
+    import horovod_tpu as hvd
+    import horovod_tpu.jax as hvd_jax
+
+    hvd.init()
+    tx = hvd_jax.DistributedOptimizer(optax.sgd(1.0), agc=0.01)
+    rng = np.random.RandomState(2)
+    params = {"w": jnp.asarray(rng.randn(4, 8).astype(np.float32) * 0.1)}
+    grads = {"w": jnp.asarray(rng.randn(4, 8).astype(np.float32) * 10.0)}
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    ref = _ref_clip(np.asarray(grads["w"]), np.asarray(params["w"]))
+    np.testing.assert_allclose(np.asarray(updates["w"]), -ref, rtol=1e-5)
+    with pytest.raises(ValueError):
+        tx.update(grads, state)  # params required with agc
+
+
+def test_agc_rejected_under_sharding():
+    import optax
+
+    import horovod_tpu.jax as hvd_jax
+    from horovod_tpu.parallel import data_parallel_mesh, make_train_step
+
+    with pytest.raises(ValueError):
+        hvd_jax.DistributedOptimizer(optax.sgd(0.1), sharded_update=True,
+                                     agc=0.01)
+    mesh = data_parallel_mesh(devices=jax.devices("cpu")[:1])
+    with pytest.raises(ValueError):
+        make_train_step(lambda p, b: 0.0, optax.sgd(0.1), mesh,
+                        zero1=True, agc=0.01)
+
+
+def test_torch_agc_clips_like_reference():
+    torch = pytest.importorskip("torch")
+    import horovod_tpu.torch as hvd_t
+
+    m = torch.nn.Linear(8, 4, bias=False)
+    with torch.no_grad():
+        m.weight.mul_(0.01)
+    opt = hvd_t.DistributedOptimizer(
+        torch.optim.SGD(m.parameters(), lr=1.0),
+        named_parameters=m.named_parameters(), agc=0.01)
+    before = m.weight.detach().clone()
+    x = torch.randn(4, 8)
+    loss = (m(x) ** 2).sum() * 1e4  # huge gradients
+    loss.backward()
+    opt.step()
+    delta = (before - m.weight.detach()).numpy()
+    # torch layout (out, in): units are rows; each update row's norm is
+    # bounded by clipping * max(row norm, eps) (lr=1).
+    row_norms = np.sqrt((delta ** 2).sum(1))
+    bound = 0.01 * np.maximum(
+        np.sqrt((before.numpy() ** 2).sum(1)), 1e-3)
+    assert (row_norms <= bound * (1 + 1e-4)).all(), (row_norms, bound)
+    with pytest.raises(ValueError):
+        hvd_t.DistributedOptimizer(torch.optim.SGD(m.parameters(), lr=1.0),
+                                   sharded_update=True, agc=0.01)

@@ -1,0 +1,78 @@
+"""Bytes-accessed regression guard for the traffic-lean BN (tier-1).
+
+Golden JSON of the resnet50 train step's ``cost_analysis()`` bytes
+under stock flax BN vs lean BN vs the norm-free floor, at a
+CPU-compilable shape. The sensitive invariant is the BN-TAX reduction
+(step bytes minus the norm-free floor): a future change that silently
+re-materializes an activation pass — a saved x_hat, a stored ReLU mask,
+a layout-copying view through the custom-VJP boundary (each measured
+during round 10, see PERF.md) — adds a full per-site activation pass,
+which moves the tax by ~30% while moving whole-step bytes by only ~1%.
+
+Regenerate the golden after an INTENTIONAL change with the command in
+its `regenerate` field.
+"""
+
+import json
+import os
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO_ROOT, "tests", "golden",
+                      "bn_traffic_resnet50.json")
+
+# Absolute-bytes drift allowed for jax/XLA version changes before the
+# golden must be regenerated; the tax assertion below is the tight one.
+ABS_TOLERANCE = 0.20
+# Allowed tax-reduction slack: a single re-materialized activation pass
+# at the golden shape moves the tax reduction by ~0.3, far outside.
+TAX_TOLERANCE = 0.08
+
+
+@pytest.fixture(scope="module")
+def measured():
+    import sys
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+
+    cfg = json.load(open(GOLDEN))["config"]
+    return {norm: bench.bn_traffic_step_stats(
+        norm, batch=cfg["batch"], image_size=cfg["image_size"],
+        dtype=cfg["dtype"])
+        for norm in ("batch", "lean", "none")}
+
+
+def test_lean_bn_tax_reduction_holds(measured):
+    golden = json.load(open(GOLDEN))
+    stock = measured["batch"]["bytes_accessed"]
+    lean = measured["lean"]["bytes_accessed"]
+    floor = measured["none"]["bytes_accessed"]
+    assert lean < stock, (lean, stock)
+    tax_reduction = 1.0 - (lean - floor) / (stock - floor)
+    assert tax_reduction >= golden["bn_tax_reduction"] - TAX_TOLERANCE, (
+        "lean BN's bytes-accessed advantage over stock flax BN "
+        "regressed: tax reduction %.4f vs golden %.4f (+/-%.2f). A "
+        "change re-materialized an activation pass the lean path "
+        "exists to eliminate (stored x_hat / stored ReLU mask / "
+        "layout-copying view). If intentional, regenerate %s with the "
+        "command in its `regenerate` field."
+        % (tax_reduction, golden["bn_tax_reduction"], TAX_TOLERANCE,
+           GOLDEN))
+
+
+def test_absolute_bytes_near_golden(measured):
+    """Coarse drift alarm: jax/XLA upgrades legitimately move absolute
+    bytes; past +/-20% the golden no longer describes this toolchain
+    and must be regenerated so the tax assertion stays meaningful."""
+    golden = json.load(open(GOLDEN))
+    for norm, key in (("batch", "stock_bytes_accessed"),
+                      ("lean", "lean_bytes_accessed"),
+                      ("none", "normfree_floor_bytes_accessed")):
+        got = measured[norm]["bytes_accessed"]
+        ref = golden[key]
+        assert abs(got - ref) <= ABS_TOLERANCE * ref, (
+            "%s train-step bytes drifted beyond %d%% of the golden "
+            "(%.4g vs %.4g): regenerate %s (see its `regenerate` "
+            "field) so the BN-tax guard keeps a meaningful baseline"
+            % (norm, 100 * ABS_TOLERANCE, got, ref, GOLDEN))

@@ -32,6 +32,15 @@ BAD_CORPUS = {
         import horovod_tpu.jax as hvd_jax
         opt = hvd_jax.DistributedOptimizer(opt)
     """,
+    "missing-bn-stats-broadcast": """
+        import horovod_tpu.jax as hvd_jax
+        opt = hvd_jax.DistributedOptimizer(opt)
+        params = hvd_jax.broadcast_parameters(variables["params"],
+                                              root_rank=0)
+        logits, upd = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"])
+    """,
     "unordered-name-iteration": """
         import horovod_tpu as hvd
         for key in {"w", "b"}:
@@ -90,6 +99,17 @@ GOOD_CORPUS = {
         import horovod_tpu.jax as hvd_jax
         opt = hvd_jax.DistributedOptimizer(opt)
         params = hvd_jax.broadcast_parameters(params, root_rank=0)
+    """,
+    "missing-bn-stats-broadcast": """
+        import horovod_tpu.jax as hvd_jax
+        opt = hvd_jax.DistributedOptimizer(opt)
+        params = hvd_jax.broadcast_parameters(variables["params"],
+                                              root_rank=0)
+        stats = hvd_jax.broadcast_parameters(variables["batch_stats"],
+                                             root_rank=0)
+        logits, upd = model.apply(
+            {"params": params, "batch_stats": stats},
+            x, train=True, mutable=["batch_stats"])
     """,
     "unordered-name-iteration": """
         import horovod_tpu as hvd
@@ -193,6 +213,56 @@ def test_sharded_state_read_variants():
         s = opt.init(params)
         w = s["world"]
     """) == []
+
+
+def test_bn_stats_broadcast_variants():
+    # torch: BN buffers live in state_dict(), not parameters() — the
+    # parameters() broadcast leaves running stats per-rank.
+    assert "missing-bn-stats-broadcast" in rules_of("""
+        import torch.nn as nn
+        import horovod_tpu.torch as hvd
+        model = nn.Sequential(nn.Conv2d(3, 8, 3), nn.BatchNorm2d(8))
+        opt = hvd.DistributedOptimizer(
+            sgd, named_parameters=model.named_parameters())
+        hvd.broadcast_parameters(model.parameters(), root_rank=0)
+    """)
+    assert rules_of("""
+        import torch.nn as nn
+        import horovod_tpu.torch as hvd
+        model = nn.Sequential(nn.Conv2d(3, 8, 3), nn.BatchNorm2d(8))
+        opt = hvd.DistributedOptimizer(
+            sgd, named_parameters=model.named_parameters())
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    """) == []
+    # Broadcasting the WHOLE flax variables dict covers the stats.
+    assert rules_of("""
+        import horovod_tpu.jax as hvd_jax
+        opt = hvd_jax.DistributedOptimizer(opt)
+        variables = hvd_jax.broadcast_parameters(variables, root_rank=0)
+        logits, upd = model.apply(
+            {"params": variables["params"],
+             "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"])
+    """) == []
+    # Sync BN keeps every rank's statistics identical by construction.
+    assert rules_of("""
+        import horovod_tpu.jax as hvd_jax
+        from horovod_tpu.ops.batch_norm import LeanBatchNorm
+        opt = hvd_jax.DistributedOptimizer(opt)
+        params = hvd_jax.broadcast_parameters(variables["params"],
+                                              root_rank=0)
+        norm = LeanBatchNorm(axis_name="hvd")
+        logits, upd = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"])
+    """) == []
+    # No mutable BN state in the file: the rule stays silent (the base
+    # missing-initial-broadcast rule owns the no-broadcast case).
+    assert "missing-bn-stats-broadcast" not in rules_of("""
+        import horovod_tpu.jax as hvd_jax
+        opt = hvd_jax.DistributedOptimizer(opt)
+        params = hvd_jax.broadcast_parameters(params, root_rank=0)
+    """)
 
 
 def test_compression_on_embedding_lookup_is_warning():
