@@ -24,6 +24,7 @@ import json
 import os
 import re
 import socket
+import statistics
 import subprocess
 from functools import partial
 import sys
@@ -188,11 +189,12 @@ def _reserve_ports(n):
     return socks, ports
 
 
-def _spawn_local_workers(n, script, extra_env=None):
+def _spawn_local_workers(n, script, extra_env=None, rank_env=None):
     """Reserves ports and spawns n local control-plane worker
     subprocesses (numpy+ctypes only) of tests/`script` with the shared
     rank/rendezvous env; returns (procs, socks) — the caller owns
-    communicate/kill and closing the sockets."""
+    communicate/kill and closing the sockets. `rank_env[r]` adds
+    per-rank overrides (e.g. a forced (local, cross) topology)."""
     socks, ports = _reserve_ports(n)
     addrs = ",".join("127.0.0.1:%d" % p for p in ports)
     procs = []
@@ -227,6 +229,8 @@ def _spawn_local_workers(n, script, extra_env=None):
                     env.pop(k, None)
                 else:
                     env[k] = v
+        if rank_env and r in rank_env:
+            env.update(rank_env[r])
         procs.append(subprocess.Popen(
             [sys.executable, os.path.join(REPO, "tests", script)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -748,6 +752,188 @@ def compression_main(args):
                       "(BENCH_r05 predates the compression stage); "
                       "acceptance: bf16 >= 1.9x, int8 convergence "
                       "loss_match true")
+    emit(out)
+    return 0
+
+
+def _run_shm_bench(n, iters, mode, shm, extra_env=None, rank_env=None,
+                   timeout=900):
+    """Launches n local workers allreducing several payload sizes under
+    compression `mode` with the shared-memory plane forced on or off;
+    returns per-rank dicts of per-size wall time and transport
+    counters."""
+    env = {"HVD_TPU_BENCH_ITERS": str(iters),
+           "HVD_TPU_COMPRESSION": mode,
+           "HVD_TPU_SHM": "1" if shm else "0",
+           # Deterministic transport + knobs: the A/B measures the
+           # transport, not the tuner's exploration.
+           "HVD_TPU_AUTOTUNE": "0"}
+    if extra_env:
+        env.update(extra_env)
+    procs, socks = _spawn_local_workers(n, "shm_bench_worker.py", env,
+                                        rank_env)
+    outputs = []
+    rows = {}
+    try:
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=timeout)
+            outputs.append(out)
+            if p.returncode != 0:
+                raise RuntimeError("shm bench rank %d (mode %s, shm %s) "
+                                   "failed:\n%s" % (r, mode, shm, out))
+            m = re.search(r"SHM_BENCH (\{.*\})", out)
+            if m:
+                d = json.loads(m.group(1))
+                rows[d["rank"]] = d
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for s in socks:
+            s.close()
+    if 0 not in rows:
+        raise RuntimeError("no SHM_BENCH line from rank 0:\n%s"
+                           % (outputs[0] if outputs else "<no output>"))
+    return rows
+
+
+def shm_main(args):
+    """bench.py --shm: A/B the shared-memory intra-host data plane
+    (docs/TRANSPORT.md) against TCP loopback. Same-host 2- and 4-rank
+    allreduce wall time across payload sizes and none/bf16/int8 wire
+    codecs (values verified every iteration; tests/test_shm.py pins the
+    bitwise shm-vs-TCP parity), plus a hierarchical-composite A/B on the
+    emulated cross-host link (forced 2x2 grid + the bandwidth throttle —
+    shm legs are intra-host by construction and exempt from the
+    emulated NIC). Acceptance (ISSUE 15): shm strictly faster than TCP
+    loopback at >= 1MB payloads on this container; small payloads may be
+    ~parity and are reported honestly."""
+    import ctypes
+    iters = max(10, args.num_iters)
+    sizes = [4096, 65536, 1048576, 4194304]
+    repeats = 3  # alternate A/B runs; medians tame this 2-core box's noise
+
+    # --- per-hop latency (the acceptance headline) ---------------------
+    # One ring hop = a full-duplex neighbor exchange (header + CRC, the
+    # production pump shape), measured in-process by the native
+    # microbench so the control-plane negotiation — which dominates
+    # end-to-end op time on this 2-core container — does not drown the
+    # transport signal. The TCP baseline is a genuine 127.0.0.1 TCP
+    # connection (ConfigureSocket discipline), not an AF_UNIX pair.
+    lib = ctypes.CDLL(os.path.join(REPO, "horovod_tpu", "native",
+                                   "libhorovod_tpu.so"))
+    lib.horovod_tpu_hop_bench.restype = ctypes.c_double
+    lib.horovod_tpu_hop_bench.argtypes = [ctypes.c_int, ctypes.c_int64,
+                                          ctypes.c_int]
+    hop = {}
+    for nbytes in sizes:
+        ts, ss = [], []
+        for _ in range(5):
+            t = lib.horovod_tpu_hop_bench(0, nbytes, 50)
+            s = lib.horovod_tpu_hop_bench(1, nbytes, 50)
+            if t <= 0 or s <= 0:
+                raise RuntimeError("hop bench failed at %d bytes" % nbytes)
+            ts.append(t)
+            ss.append(s)
+        t_med, s_med = statistics.median(ts), statistics.median(ss)
+        hop[str(nbytes)] = {
+            "us_per_hop_tcp": round(t_med, 1),
+            "us_per_hop_shm": round(s_med, 1),
+            "tcp_over_shm": round(t_med / s_med, 3),
+        }
+        print("per-hop %d B: tcp %.1f us, shm %.1f us (%.3fx)"
+              % (nbytes, t_med, s_med, t_med / s_med), file=sys.stderr)
+
+    def ab_medians(n, mode, extra_env=None, rank_env=None):
+        accum = {"tcp": {}, "shm": {}}
+        last = {}
+        for _ in range(repeats):
+            for key, shm_on in (("tcp", False), ("shm", True)):
+                rows = _run_shm_bench(n, iters, mode, shm=shm_on,
+                                      extra_env=extra_env,
+                                      rank_env=rank_env)
+                last[key] = rows[0]
+                for s, v in rows[0]["us_per_op"].items():
+                    accum[key].setdefault(s, []).append(v)
+        # Engagement proof, both directions of the A/B. The byte counter
+        # is the signal — the segments gauge can already read 0 when a
+        # faster-finishing peer's exit tore the job down before this
+        # rank's final metrics read.
+        if last["shm"]["shm_bytes_sent"] <= 0:
+            raise RuntimeError("shm run did not engage the shm plane: %r"
+                               % last["shm"])
+        if last["tcp"]["shm_bytes_sent"] != 0:
+            raise RuntimeError("tcp run moved shm bytes: %r" % last["tcp"])
+        med = {key: {s: round(statistics.median(vs), 1)
+                     for s, vs in accum[key].items()}
+               for key in accum}
+        med["tcp_over_shm"] = {s: round(med["tcp"][s] / med["shm"][s], 3)
+                               for s in med["tcp"]}
+        med["shm_bytes_sent"] = last["shm"]["shm_bytes_sent"]
+        return med
+
+    out = {
+        "metric": "shm_intra_host_speedup",
+        "unit": "x_us_per_hop_tcp_over_shm_4MB",
+        "iters": iters,
+        "repeats": repeats,
+        "sizes_bytes": sizes,
+        "per_hop": hop,
+        "per_ranks": {},
+    }
+    for n in (2, 4):
+        per_mode = {}
+        for mode in ("none", "bf16", "int8"):
+            med = ab_medians(n, mode)
+            per_mode[mode] = {
+                "us_per_op_tcp": med["tcp"],
+                "us_per_op_shm": med["shm"],
+                "tcp_over_shm": med["tcp_over_shm"],
+                # 2 ranks: an allreduce is exactly 2 neighbor exchanges.
+                "per_hop_us_shm_smallest": round(
+                    med["shm"][str(sizes[0])] / 2.0, 1) if n == 2 else None,
+            }
+            print("shm A/B n=%d mode=%s: tcp/shm per size %s"
+                  % (n, mode, med["tcp_over_shm"]), file=sys.stderr)
+        out["per_ranks"][str(n)] = per_mode
+    out["value"] = hop["4194304"]["tcp_over_shm"]
+
+    # Hierarchical composite on the emulated cross-host link: forced 2x2
+    # grid, 1000 MB/s throttle on socket sends, hierarchical allreduce
+    # pinned on — the intra-host legs are the shm consumers.
+    rank_env = {r: {"HVD_TPU_LOCAL_RANK": str(r % 2),
+                    "HVD_TPU_LOCAL_SIZE": "2",
+                    "HVD_TPU_CROSS_RANK": str(r // 2),
+                    "HVD_TPU_CROSS_SIZE": "2"} for r in range(4)}
+    hier_env = {"HVD_TPU_HIERARCHICAL_ALLREDUCE": "1",
+                "HVD_TPU_RING_BANDWIDTH_MBPS": "1000",
+                "HVD_TPU_BENCH_SIZES": "4194304"}
+    h = ab_medians(4, "none", extra_env=hier_env, rank_env=rank_env)
+    out["hierarchical_emulated_link"] = {
+        "ranks": 4, "grid": "2x2", "link_mbps": 1000,
+        "payload_bytes": 4194304,
+        "us_per_op_tcp": h["tcp"]["4194304"],
+        "us_per_op_shm": h["shm"]["4194304"],
+        "tcp_over_shm": h["tcp_over_shm"]["4194304"],
+        "shm_bytes_sent_rank0": h["shm_bytes_sent"],
+    }
+
+    # Acceptance: ring hops strictly faster at >= 1MB (the end-to-end
+    # allreduce step times above are reported honestly but are
+    # negotiation-dominated on this container — the per-hop measurement
+    # is the transport A/B).
+    for s in ("1048576", "4194304"):
+        r = hop[s]["tcp_over_shm"]
+        if r <= 1.0:
+            raise RuntimeError(
+                "shm hop not faster than TCP loopback at %s bytes "
+                "(tcp/shm = %.3f <= 1.0)" % (s, r))
+    out["vs_baseline"] = out["value"]
+    out["baseline"] = ("same-run TCP-loopback per-hop latency "
+                       "(BENCH_r10 predates the shm plane); acceptance: "
+                       "per-hop tcp/shm > 1.0 at >= 1MB payloads "
+                       "(small payloads may be ~parity), bitwise "
+                       "shm-vs-TCP parity pinned by tests/test_shm.py")
     emit(out)
     return 0
 
@@ -1855,6 +2041,13 @@ def main():
                          "step time with compression off vs this mode "
                          "(2 local ranks, CPU-only), plus the int8 vs "
                          "fp32 convergence run; prints one JSON line")
+    ap.add_argument("--shm", action="store_true",
+                    help="A/B the shared-memory intra-host data plane "
+                         "(docs/TRANSPORT.md): same-host allreduce wall "
+                         "time shm vs TCP loopback at 2 and 4 ranks "
+                         "across none/bf16/int8, plus a hierarchical-"
+                         "composite A/B on the emulated cross-host "
+                         "link; prints one JSON line (BENCH_r11)")
     ap.add_argument("--sharded-update", action="store_true",
                     help="A/B the ZeRO-style sharded weight update "
                          "(docs/ZERO.md): step time, optimizer-state "
@@ -1923,6 +2116,8 @@ def main():
         return bn_traffic_main(args)
     if args.compression is not None:
         return compression_main(args)
+    if args.shm:
+        return shm_main(args)
     if args.sharded_update:
         return sharded_update_main(args)
     if args.model_parallel:

@@ -289,8 +289,10 @@ static Status RingReduceScatterOn(TcpContext& ctx, Ring ring, char* buf,
                                   const std::vector<int64_t>& offsets,
                                   DataType dtype, CompressionMode cmp,
                                   int64_t pipe_bytes, uint32_t group = 0) {
-  int n = group ? ctx.GroupSize(group) : ctx.RingSize(ring);
-  int rank = group ? ctx.GroupRank(group) : ctx.RingRank(ring);
+  // Group-aware coordinates: group != 0 with LOCAL/CROSS rides the
+  // group's sub-rings (the hierarchical-composite-for-subgroups legs).
+  int n = ctx.RingSizeOn(ring, group);
+  int rank = ctx.RingRankOn(ring, group);
   std::size_t elem = DataTypeSize(dtype);
   int64_t seg = SegmentElems(pipe_bytes, elem, cmp);
   int64_t nseg = SegmentCount(counts, seg);
@@ -451,8 +453,8 @@ static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
                                    const std::vector<int64_t>& offsets,
                                    DataType dtype, CompressionMode cmp,
                                    int64_t pipe_bytes, uint32_t group = 0) {
-  int n = group ? ctx.GroupSize(group) : ctx.RingSize(ring);
-  int rank = group ? ctx.GroupRank(group) : ctx.RingRank(ring);
+  int n = ctx.RingSizeOn(ring, group);
+  int rank = ctx.RingRankOn(ring, group);
   std::size_t elem = DataTypeSize(dtype);
   if (cmp != CompressionMode::NONE) {
     float* f = reinterpret_cast<float*>(buf);
@@ -546,7 +548,7 @@ static Status RingAllgatherPhaseOn(TcpContext& ctx, Ring ring, char* buf,
 Status RingAllreduceOn(TcpContext& ctx, Ring ring, void* buffer, int64_t count,
                        DataType dtype, CompressionMode cmp,
                        int64_t pipe_bytes, uint32_t group) {
-  int n = group ? ctx.GroupSize(group) : ctx.RingSize(ring);
+  int n = ctx.RingSizeOn(ring, group);
   if (n == 1 || count == 0) return Status::OK();
   std::vector<int64_t> counts, offsets;
   PartitionChunks(count, n, &counts, &offsets);
@@ -674,27 +676,45 @@ Status CpuRingAllreduce::Execute(std::vector<TensorTableEntry>& entries,
 bool CpuHierarchicalAllreduce::Enabled(
     const std::vector<TensorTableEntry>& entries,
     const Response& response) const {
-  // Group collectives ride the group's flat (pipelined) ring: a subgroup
-  // has no guaranteed (local, cross) grid, so the two-level composite
-  // only applies to the world group.
-  return entries[0].device == HOST_DEVICE_ID &&
-         response.group_id() == 0 &&
-         ctx_.hierarchical_possible() &&
-         global_state_->parameter_manager.HierarchicalAllreduce();
+  // World group: the classic gate. Subgroups additionally qualify when
+  // their member set forms a uniform (local, cross) grid — the decision
+  // is a pure function of (members, world grid, synchronized knob), so
+  // it can never diverge across ranks (docs/TRANSPORT.md).
+  if (entries[0].device != HOST_DEVICE_ID ||
+      !ctx_.hierarchical_possible() ||
+      !global_state_->parameter_manager.HierarchicalAllreduce()) {
+    return false;
+  }
+  if (response.group_id() == 0) return true;
+  return ctx_.GroupHierarchicalPossible(
+      global_state_->group_table.Members(response.group_id()));
 }
 
 Status CpuHierarchicalAllreduce::ReduceBuffer(void* buffer, int64_t count,
                                               DataType dtype,
                                               CompressionMode cmp,
-                                              uint32_t /*group*/) {
+                                              uint32_t group) {
   // Two-level composite (reference: nccl_operations.cc:150-346):
   //   1. local-ring reduce-scatter — local rank lr ends up owning chunk
   //      (lr+1) % ls, reduced over the local group;
   //   2. cross-ring allreduce of the owned chunk (one participant per
   //      local_rank, riding the inter-host links only);
   //   3. local-ring allgather of the now globally-reduced chunks.
-  int ls = ctx_.local_size();
-  int lr = ctx_.local_rank();
+  // Group-scoped: the same three stages over the group's sub-rings
+  // (local position / per-host member count replace local_rank /
+  // local_size; the intra-host legs ride shm when negotiated).
+  int ls, lr;
+  if (group != 0) {
+    std::vector<int> members = global_state_->group_table.Members(group);
+    if (!ctx_.EnsureGroupSubRings(group, members)) {
+      return RingLost(ctx_, "group sub-ring rendezvous failed");
+    }
+    ls = ctx_.RingSizeOn(Ring::LOCAL, group);
+    lr = ctx_.RingRankOn(Ring::LOCAL, group);
+  } else {
+    ls = ctx_.local_size();
+    lr = ctx_.local_rank();
+  }
   if (count == 0) return Status::OK();
   std::size_t elem = DataTypeSize(dtype);
   int64_t pipe = global_state_->parameter_manager.PipelineChunkBytes();
@@ -704,16 +724,16 @@ Status CpuHierarchicalAllreduce::ReduceBuffer(void* buffer, int64_t count,
   char* buf = static_cast<char*>(buffer);
 
   Status s = RingReduceScatterOn(ctx_, Ring::LOCAL, buf, counts, offsets,
-                                 dtype, cmp, pipe);
+                                 dtype, cmp, pipe, group);
   if (!s.ok()) return s;
 
   int owned = (lr + 1) % ls;
   s = RingAllreduceOn(ctx_, Ring::CROSS, buf + offsets[owned] * elem,
-                      counts[owned], dtype, cmp, pipe);
+                      counts[owned], dtype, cmp, pipe, group);
   if (!s.ok()) return s;
 
   return RingAllgatherPhaseOn(ctx_, Ring::LOCAL, buf, counts, offsets, dtype,
-                              cmp, pipe);
+                              cmp, pipe, group);
 }
 
 bool CpuRingReduceScatter::Enabled(
@@ -846,9 +866,9 @@ static void ReduceScattered(char* buf, const std::vector<GroupSeg>& segs,
 static Status GroupedRingReduceScatter(
     TcpContext& ctx, Ring ring, char* buf,
     const std::vector<std::vector<GroupSeg>>& ring_groups, DataType dtype,
-    CompressionMode cmp, int64_t pipe_bytes) {
-  int n = ctx.RingSize(ring);
-  int rank = ctx.RingRank(ring);
+    CompressionMode cmp, int64_t pipe_bytes, uint32_t group = 0) {
+  int n = ctx.RingSizeOn(ring, group);
+  int rank = ctx.RingRankOn(ring, group);
   std::size_t elem = DataTypeSize(dtype);
   std::vector<int64_t> group_elems(n, 0);
   for (int j = 0; j < n; ++j) {
@@ -902,11 +922,12 @@ static Status GroupedRingReduceScatter(
         CompressBuffer(
             reinterpret_cast<const float*>(pack.data()) + soff, sn, cmp,
             send_c.data());
-        ok = ctx.RingExchangeOn(ring, send_c.data(), CompressedSize(sn, cmp),
-                                rc, CompressedSize(rn, cmp));
+        ok = ctx.ExchangeOn(ring, group, send_c.data(),
+                            CompressedSize(sn, cmp), rc,
+                            CompressedSize(rn, cmp));
       } else {
-        ok = ctx.RingExchangeOn(ring, pack.data() + soff * elem, sn * elem,
-                                rc, rn * elem);
+        ok = ctx.ExchangeOn(ring, group, pack.data() + soff * elem,
+                            sn * elem, rc, rn * elem);
       }
       if (!ok) {
         worker.Drain();
@@ -942,12 +963,17 @@ static Status GroupedRingReduceScatter(
 bool CpuHierarchicalReduceScatter::Enabled(
     const std::vector<TensorTableEntry>& entries,
     const Response& response) const {
-  // World-group only, like the hierarchical allreduce: subgroups ride
-  // their flat pipelined ring.
-  return entries[0].device == HOST_DEVICE_ID &&
-         response.group_id() == 0 &&
-         ctx_.hierarchical_possible() &&
-         global_state_->parameter_manager.HierarchicalReduceScatter();
+  // World group, or a subgroup whose member set forms a uniform
+  // (local, cross) grid (docs/TRANSPORT.md) — the decision is a pure
+  // function of (members, world grid, synchronized knob) on every rank.
+  if (entries[0].device != HOST_DEVICE_ID ||
+      !ctx_.hierarchical_possible() ||
+      !global_state_->parameter_manager.HierarchicalReduceScatter()) {
+    return false;
+  }
+  if (response.group_id() == 0) return true;
+  return ctx_.GroupHierarchicalPossible(
+      global_state_->group_table.Members(response.group_id()));
 }
 
 Status CpuHierarchicalReduceScatter::Execute(
@@ -963,10 +989,30 @@ Status CpuHierarchicalReduceScatter::Execute(
   //      chunk, fully reduced);
   //   3. shard distribution: copy the owned chunk into the shard-sized
   //      output and postscale.
-  int n = ctx_.size();
-  int rank = ctx_.rank();
-  int ls = ctx_.local_size(), lr = ctx_.local_rank();
-  int cs = ctx_.cross_size();
+  // Group-scoped (docs/TRANSPORT.md): chunks partition over the GROUP,
+  // "rank" is the group position, the stages ride the group's
+  // local/cross sub-rings, and the grid lookup maps (local slot, host)
+  // to group positions via the uniform-grid table.
+  const uint32_t group = response.group_id();
+  TcpContext::GroupGrid grid;
+  if (group != 0) {
+    std::vector<int> members = global_state_->group_table.Members(group);
+    if (!ctx_.EnsureGroupSubRings(group, members)) {
+      return RingLost(ctx_, "group sub-ring rendezvous failed");
+    }
+    grid = ctx_.GroupGridOf(members);
+  }
+  int n = group ? static_cast<int>(grid.pos_grid.size()) : ctx_.size();
+  int rank = group
+                 ? global_state_->group_table.IndexOf(group, ctx_.rank())
+                 : ctx_.rank();
+  int ls = group ? grid.local_size : ctx_.local_size();
+  int lr = group ? grid.local_pos : ctx_.local_rank();
+  int cs = group ? grid.cross_size : ctx_.cross_size();
+  auto rank_at = [&](int j, int c) {
+    return group ? grid.pos_grid[static_cast<std::size_t>(c) * ls + j]
+                 : ctx_.RankAt(j, c);
+  };
   auto& timeline = global_state_->timeline;
   CompressionMode cmp = EffectiveCompression(
       static_cast<CompressionMode>(response.compression()),
@@ -1000,12 +1046,13 @@ Status CpuHierarchicalReduceScatter::Execute(
     for (int mpos = 0; mpos < ls; ++mpos) {
       int j = (mpos + ls - 1) % ls;
       for (int c = 0; c < cs; ++c) {
-        int g = ctx_.RankAt(j, c);
+        int g = rank_at(j, c);
         ring_groups[mpos].push_back({offsets[g], counts[g]});
       }
     }
     Status s = GroupedRingReduceScatter(ctx_, Ring::LOCAL, work.data(),
-                                        ring_groups, e.dtype, cmp, pipe);
+                                        ring_groups, e.dtype, cmp, pipe,
+                                        group);
     if (!s.ok()) {
       timeline.ActivityEndAll(response.tensor_names());
       return s;
@@ -1016,12 +1063,12 @@ Status CpuHierarchicalReduceScatter::Execute(
     // the chunk of RankAt(lr, c)).
     std::vector<int64_t> ring_counts(cs), ring_offsets(cs);
     for (int mpos = 0; mpos < cs; ++mpos) {
-      int g = ctx_.RankAt(lr, (mpos + cs - 1) % cs);
+      int g = rank_at(lr, (mpos + cs - 1) % cs);
       ring_counts[mpos] = counts[g];
       ring_offsets[mpos] = offsets[g];
     }
     s = RingReduceScatterOn(ctx_, Ring::CROSS, work.data(), ring_counts,
-                            ring_offsets, e.dtype, cmp, pipe);
+                            ring_offsets, e.dtype, cmp, pipe, group);
     if (!s.ok()) {
       timeline.ActivityEndAll(response.tensor_names());
       return s;

@@ -28,6 +28,7 @@ bool ParseChan(const std::string& v, int* out) {
   if (v == "ring") { *out = static_cast<int>(Channel::RING); return true; }
   if (v == "local") { *out = static_cast<int>(Channel::LOCAL_RING); return true; }
   if (v == "cross") { *out = static_cast<int>(Channel::CROSS_RING); return true; }
+  if (v == "shm") { *out = static_cast<int>(Channel::SHM); return true; }
   return false;
 }
 
@@ -110,13 +111,22 @@ void FaultInjector::Configure(const char* spec, int rank) {
   active_.store(!rules_.empty(), std::memory_order_relaxed);
 }
 
-FaultDecision FaultInjector::OnFrame(Channel chan, bool send) {
+FaultDecision FaultInjector::OnFrame(Channel chan, bool send, bool shm) {
   FaultDecision d;
   if (!active()) return d;
   std::lock_guard<std::mutex> lk(mutex_);
   for (auto& rule : rules_) {
     if (rule.rank >= 0 && rule.rank != rank_) continue;
-    if (rule.chan >= 0 && rule.chan != static_cast<int>(chan)) continue;
+    // chan=shm filters by TRANSPORT (a data-plane leg riding a shared-
+    // memory ring, whatever its logical channel); chan=ring/local/cross
+    // keep matching by LOGICAL channel regardless of transport, so
+    // pre-shm specs and their frame counters are unchanged when the
+    // shm plane engages (docs/CHAOS.md).
+    if (rule.chan == static_cast<int>(Channel::SHM)) {
+      if (!shm) continue;
+    } else if (rule.chan >= 0 && rule.chan != static_cast<int>(chan)) {
+      continue;
+    }
     if (rule.dir >= 0 && rule.dir != (send ? 0 : 1)) continue;
     int64_t idx = rule.seen++;
     if (rule.count == 0) continue;  // exhausted

@@ -12,7 +12,13 @@
 //   clause := 'seed=N' | rule
 //   rule   := field (',' field)*
 //   field  := 'rank=N'          fire only on this process rank
-//           | 'chan=control|ring|local|cross|any'
+//           | 'chan=control|ring|local|cross|shm|any'
+//                               (shm filters by TRANSPORT: data-plane
+//                               frames riding a shared-memory ring,
+//                               whatever their logical channel; the
+//                               ring/local/cross filters keep matching
+//                               by logical channel regardless of the
+//                               transport underneath)
 //           | 'dir=send|recv|any'
 //           | 'frame=N'         fire at the Nth matching frame (0-based,
 //                               counted per rule over matching frames)
@@ -90,8 +96,10 @@ class FaultInjector {
   // Consulted once per frame by the transport. Returns the action to
   // apply (delay/stall sleeps are applied by the CALLER so it can pick
   // the right moment relative to its I/O). NONE when inactive or no
-  // rule matches.
-  FaultDecision OnFrame(Channel chan, bool send);
+  // rule matches. `shm` marks a frame riding the shared-memory plane
+  // (the chan=shm filter's match key; logical-channel filters ignore
+  // it).
+  FaultDecision OnFrame(Channel chan, bool send, bool shm = false);
 
   // Test hook: number of times any rule has fired since Configure.
   uint64_t fires() const { return fires_.load(std::memory_order_relaxed); }

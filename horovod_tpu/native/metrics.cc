@@ -76,6 +76,8 @@ const char* SummaryFieldName(int field) {
     case SUM_AUTOTUNE_REARMS: return "autotune_rearms_total";
     case SUM_GROUPS: return "groups";
     case SUM_GROUP_TENSORS: return "group_tensors_total";
+    case SUM_SHM_SEGMENTS: return "shm_segments_active";
+    case SUM_SHM_BYTES_SENT: return "net_shm_bytes_sent_total";
   }
   return "unknown";
 }
@@ -180,6 +182,9 @@ std::vector<double> Metrics::Summary() const {
       static_cast<double>(autotune_rearms_total.load());
   v[SUM_GROUPS] = static_cast<double>(groups.load());
   v[SUM_GROUP_TENSORS] = static_cast<double>(group_tensors_total.load());
+  v[SUM_SHM_SEGMENTS] = static_cast<double>(shm_segments_active.load());
+  v[SUM_SHM_BYTES_SENT] =
+      static_cast<double>(net_shm_bytes_sent_total.load());
   return v;
 }
 
@@ -309,6 +314,10 @@ std::string Metrics::SnapshotJson() const {
            net_ring_bytes_sent_total.load(), &first);
   AppendKV(&out, "net_ring_bytes_recv_total",
            net_ring_bytes_recv_total.load(), &first);
+  AppendKV(&out, "net_shm_bytes_sent_total",
+           net_shm_bytes_sent_total.load(), &first);
+  AppendKV(&out, "net_shm_bytes_recv_total",
+           net_shm_bytes_recv_total.load(), &first);
   AppendKV(&out, "ckpt_writes_total", ckpt_writes_total.load(), &first);
   AppendKV(&out, "ckpt_write_failures_total",
            ckpt_write_failures_total.load(), &first);
@@ -354,6 +363,8 @@ std::string Metrics::SnapshotJson() const {
   AppendKV(&out, "pipeline_chunk_bytes",
            static_cast<double>(pipeline_chunk_bytes.load()), &first);
   AppendKV(&out, "groups", static_cast<double>(groups.load()), &first);
+  AppendKV(&out, "shm_segments_active",
+           static_cast<double>(shm_segments_active.load()), &first);
   out.append("},\"per_group\":{");
   // Group-labeled negotiation counters (docs/GROUPS.md): one entry per
   // tracked group id with at least one negotiated tensor. The Python

@@ -117,11 +117,18 @@ enum SummaryField : int {
   // `tun` column renders them ('-' for a pre-autotune worker's summary).
   SUM_AUTOTUNE_ACTIVE,
   SUM_AUTOTUNE_REARMS,
-  // Process groups (docs/GROUPS.md). Appended last: registered groups
-  // on this rank and group-scoped tensors it executed; the hvd-top
-  // `grp` column renders them ('-' for a pre-groups worker's summary).
+  // Process groups (docs/GROUPS.md). Appended after the autotune
+  // fields: registered groups on this rank and group-scoped tensors it
+  // executed; the hvd-top `grp` column renders them ('-' for a
+  // pre-groups worker's summary).
   SUM_GROUPS,
   SUM_GROUP_TENSORS,
+  // Shared-memory data plane (docs/TRANSPORT.md). Appended last: live
+  // attached segments on this rank and payload bytes its ring legs
+  // moved through shared memory instead of loopback TCP; the hvd-top
+  // `shm` column renders them ('-' for a pre-shm worker's summary).
+  SUM_SHM_SEGMENTS,
+  SUM_SHM_BYTES_SENT,
   SUM_FIELD_COUNT
 };
 const char* SummaryFieldName(int field);
@@ -175,10 +182,20 @@ class Metrics {
   std::atomic<uint64_t> allreduce_bf16_total{0};
   std::atomic<uint64_t> allreduce_int8_total{0};
   // Data-ring wire accounting (frame headers included): the quantity
-  // the compression stage shrinks, measured at the socket layer —
-  // bench.py --compression reads the A/B from these.
+  // the compression stage shrinks, measured at the transport layer —
+  // bench.py --compression reads the A/B from these. Counts data-plane
+  // bytes WHATEVER the transport (loopback TCP or an intra-host shm
+  // ring), so a compression ratio A/B is transport-independent; the
+  // net_shm_* counters below split out the shm share.
   std::atomic<uint64_t> net_ring_bytes_sent_total{0};
   std::atomic<uint64_t> net_ring_bytes_recv_total{0};
+
+  // --- shared-memory data plane (tcp_context.cc / docs/TRANSPORT.md) ---
+  // Payload+header bytes ring legs moved through shared-memory segments
+  // (also counted in net_ring_bytes_* above — these isolate the shm
+  // share so bench.py --shm can prove the plane engaged).
+  std::atomic<uint64_t> net_shm_bytes_sent_total{0};
+  std::atomic<uint64_t> net_shm_bytes_recv_total{0};
 
   // --- durable checkpoints (elastic/durable.py via the C API) ---
   std::atomic<uint64_t> ckpt_writes_total{0};          // published snapshots
@@ -257,6 +274,11 @@ class Metrics {
   // Registered process groups (group_table.h; reset per generation —
   // re-init clears the table and Python re-creates the mesh groups).
   std::atomic<int64_t> groups{0};
+  // Live attached shared-memory segments (writer + reader side both
+  // count; maintained by ShmSegmentTable, shm_context.cc). A fresh
+  // value is stored on every attach/close, so it tracks re-inits
+  // naturally.
+  std::atomic<int64_t> shm_segments_active{0};
 
   // --- histograms ---
   MetricHistogram cycle_seconds;        // background work-cycle duration

@@ -20,6 +20,7 @@
 #include "fault.h"
 #include "logging.h"
 #include "metrics.h"
+#include "shm_context.h"
 
 namespace hvdtpu {
 
@@ -155,12 +156,23 @@ Conn& Conn::operator=(Conn&& o) noexcept {
     Close();
     fd_ = o.fd_;
     channel_ = o.channel_;
+    shm_ = o.shm_;
     o.fd_ = -1;
+    o.shm_ = nullptr;
   }
   return *this;
 }
 
+void Conn::AttachShm(ShmRing* ring) {
+  if (shm_ != nullptr) delete shm_;
+  shm_ = ring;
+}
+
 void Conn::Close() {
+  if (shm_ != nullptr) {
+    delete shm_;  // ShmRing::~ShmRing closes + wakes the peer
+    shm_ = nullptr;
+  }
   if (fd_ >= 0) {
     ::close(fd_);
     fd_ = -1;
@@ -559,7 +571,8 @@ static int ConnectOnce(const struct addrinfo* ai, int attempt_ms) {
 
 Conn ConnectPeer(const std::string& host, int port, int my_rank,
                  Channel channel, int timeout_ms, uint32_t generation,
-                 uint64_t opseq, bool reconnect, bool group_ring) {
+                 uint64_t opseq, bool reconnect, bool group_ring,
+                 bool shm_cap) {
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
   while (true) {
@@ -594,7 +607,8 @@ Conn ConnectPeer(const std::string& host, int port, int my_rank,
       EncodeHandshake(hs, my_rank, channel,
                       static_cast<uint8_t>(
                           (reconnect ? kHandshakeReconnect : 0) |
-                          (group_ring ? kHandshakeGroupRing : 0)),
+                          (group_ring ? kHandshakeGroupRing : 0) |
+                          (shm_cap ? kHandshakeShmCap : 0)),
                       generation, opseq);
       if (c.SendAll(hs, sizeof(hs))) {
         if (!reconnect) return c;

@@ -26,11 +26,19 @@
 
 namespace hvdtpu {
 
+class ShmRing;
+
 enum class Channel : uint8_t {
   CONTROL = 0,     // worker -> coordinator star
   RING = 1,        // prev -> next data ring (global)
   LOCAL_RING = 2,  // ring within one host's local group
   CROSS_RING = 3,  // ring across hosts at one local_rank
+  // Not a handshake channel: the TRANSPORT tag the fault injector and
+  // error messages use for data-plane legs riding a shared-memory ring
+  // (docs/TRANSPORT.md). Fault rules with chan=ring/local/cross keep
+  // matching those legs by their LOGICAL channel; chan=shm additionally
+  // filters to shm-transported frames only.
+  SHM = 4,
 };
 
 // Why the last frame-layer call on a Conn failed — the transport error
@@ -84,11 +92,22 @@ class Conn {
   ~Conn();
   Conn(const Conn&) = delete;
   Conn& operator=(const Conn&) = delete;
-  Conn(Conn&& o) noexcept : fd_(o.fd_), channel_(o.channel_) { o.fd_ = -1; }
+  Conn(Conn&& o) noexcept : fd_(o.fd_), channel_(o.channel_), shm_(o.shm_) {
+    o.fd_ = -1;
+    o.shm_ = nullptr;
+  }
   Conn& operator=(Conn&& o) noexcept;
 
   bool valid() const { return fd_ >= 0; }
   void Close();
+
+  // Shared-memory data plane (docs/TRANSPORT.md): a successfully
+  // negotiated conn carries an SPSC ring — the sender writes it, the
+  // receiver drains it — and the TCP socket stays open only as the
+  // liveness signal (EOF/keepalive = peer death). Ownership transfers
+  // to the Conn; Close() tears both down.
+  void AttachShm(ShmRing* ring);
+  ShmRing* shm() const { return shm_; }
 
   // Raw exact-length I/O; false on error/EOF/deadline (last_error set).
   bool SendAll(const void* buf, std::size_t len);
@@ -118,6 +137,7 @@ class Conn {
   int fd_ = -1;
   Channel channel_ = Channel::CONTROL;
   NetError last_error_ = NetError::NONE;
+  ShmRing* shm_ = nullptr;  // owned; see AttachShm
 };
 
 // v2 handshake: every connection opens with
@@ -134,6 +154,14 @@ constexpr uint8_t kHandshakeReconnect = 0x1;
 // cursor. Built lazily by the background thread at a group op's first
 // execution (tcp_context.cc EnsureGroupRing).
 constexpr uint8_t kHandshakeGroupRing = 0x2;
+// Shared-memory capability (docs/TRANSPORT.md): the connector supports
+// the intra-host shm data plane (HVD_TPU_SHM enabled). An acceptor that
+// sees the bit on a data-plane connection expects ONE setup frame right
+// after the handshake (segment name + host key, or an empty name when
+// the connector decided against shm for this pair) and answers with an
+// ack frame; either side lacking support or failing the attach lands
+// the pair on plain TCP — transparently, by construction.
+constexpr uint8_t kHandshakeShmCap = 0x4;
 constexpr std::size_t kHandshakeBytes = 22;
 
 struct PeerHandshake {
@@ -178,10 +206,12 @@ class Listener {
 // acceptor's 1-byte verdict (1 = resume; anything else = rejected).
 // `group_ring` marks a group-ring connect (kHandshakeGroupRing; opseq
 // then carries the group id). Returns an invalid Conn on failure.
+// `shm_cap` advertises the shared-memory capability (kHandshakeShmCap)
+// on data-plane connects.
 Conn ConnectPeer(const std::string& host, int port, int my_rank,
                  Channel channel, int timeout_ms, uint32_t generation = 0,
                  uint64_t opseq = 0, bool reconnect = false,
-                 bool group_ring = false);
+                 bool group_ring = false, bool shm_cap = false);
 
 // Splits "host:port" / "h1:p1,h2:p2,..." forms.
 bool ParseHostPort(const std::string& s, std::string* host, int* port);

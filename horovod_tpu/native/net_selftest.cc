@@ -5,7 +5,10 @@
 // scratch (socketpair / loopback listener), so these run tier-1-safe on
 // any CPU-only host.
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
+#include <poll.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -18,6 +21,7 @@
 #include "fault.h"
 #include "logging.h"
 #include "net.h"
+#include "shm_context.h"
 
 namespace hvdtpu {
 namespace {
@@ -221,6 +225,343 @@ bool FaultSpecDeterministic() {
   return s1 != s3;  // and differ across seeds (64 frames: ~certain)
 }
 
+// ---- shared-memory transport scenarios (shm_context.{h,cc}) ----
+
+static std::string UniqueShmName(const char* tag) {
+  return std::string("/hvdtpu-selftest-") + tag + "-" +
+         std::to_string(::getpid());
+}
+
+// A frame (header + payload) round-trips the SPSC ring bitwise,
+// including a wrap-around (payload larger than the remaining tail of
+// the ring), and the writer/reader counters agree.
+bool ShmRoundtrip() {
+  std::string name = UniqueShmName("rt");
+  auto w = ShmRing::Create(name, 4096);
+  if (w == nullptr) return false;
+  auto r = ShmRing::Attach(name);
+  if (r == nullptr) return false;
+  w->MarkExchanged();
+  std::string payload;
+  for (int i = 0; i < 6000; ++i) payload.push_back(static_cast<char>(i));
+  uint32_t crc = Crc32c(payload.data(), payload.size());
+  // Pump concurrently: the payload exceeds the ring capacity, so the
+  // writer must block on space while the reader drains — exactly the
+  // double-buffered flow of a real hop.
+  std::string got(payload.size(), '\0');
+  std::thread reader([&] { r->ReadAll(&got[0], got.size(), 5000); });
+  bool wrote = w->WriteAll(payload.data(), payload.size(), 5000);
+  reader.join();
+  if (!wrote || got != payload) return false;
+  if (Crc32c(got.data(), got.size()) != crc) return false;
+  // Orderly hangup: the reader drains leftovers then sees EOF.
+  char c = 'x';
+  if (w->WriteSome(&c, 1) != 1) return false;
+  w->Close();
+  char back;
+  if (r->ReadSome(&back, 1) != 1 || back != 'x') return false;
+  return r->ReadSome(&back, 1) == -1;  // closed AND drained = EOF
+}
+
+// A byte flipped INSIDE the mapped segment after the CRC was computed is
+// a detected mismatch at verification time — the shm plane keeps the
+// frame-CRC discipline (corruption surfaces as an error, never data).
+bool ShmCorruptDetected() {
+  std::string name = UniqueShmName("crc");
+  auto w = ShmRing::Create(name, 1 << 16);
+  if (w == nullptr) return false;
+  auto r = ShmRing::Attach(name);
+  if (r == nullptr) return false;
+  w->MarkExchanged();
+  std::string payload(4096, 'G');
+  uint64_t len = payload.size();
+  uint32_t tag = 0x20;
+  uint32_t crc = FrameHeaderCrc(tag, len);
+  crc = Crc32c(payload.data(), payload.size(), crc);
+  payload[1000] ^= 0x1;  // the "wire" flip, after the checksum
+  char hdr[kFrameHeaderBytes];
+  BuildFrameHeader(hdr, tag, len, crc);
+  if (!w->WriteAll(hdr, sizeof(hdr), 1000)) return false;
+  if (!w->WriteAll(payload.data(), payload.size(), 1000)) return false;
+  char rhdr[kFrameHeaderBytes];
+  if (!r->ReadAll(rhdr, sizeof(rhdr), 1000)) return false;
+  uint32_t rtag, rcrc;
+  uint64_t rlen;
+  ParseFrameHeader(rhdr, &rtag, &rlen, &rcrc);
+  std::string got(static_cast<std::size_t>(rlen), '\0');
+  if (!r->ReadAll(&got[0], got.size(), 1000)) return false;
+  uint32_t acc = FrameHeaderCrc(rtag, rlen);
+  acc = Crc32c(got.data(), got.size(), acc);
+  return acc != rcrc;  // MUST mismatch — detected, not silently wrong
+}
+
+// Attach-side fallback negotiation: a nonexistent name, and a segment
+// whose header does not parse, both refuse cleanly (nullptr — the
+// caller's "ride TCP instead" path), and a good segment still attaches
+// afterwards.
+bool ShmFallbackNegotiation() {
+  if (ShmRing::Attach(UniqueShmName("nonexistent")) != nullptr) return false;
+  // A raw shm object with garbage where the header should be.
+  std::string bogus = UniqueShmName("bogus");
+  int fd = ::shm_open(bogus.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return false;
+  if (::ftruncate(fd, 8192) != 0) {
+    ::close(fd);
+    ::shm_unlink(bogus.c_str());
+    return false;
+  }
+  ::close(fd);
+  bool refused = ShmRing::Attach(bogus) == nullptr;
+  ::shm_unlink(bogus.c_str());
+  if (!refused) return false;
+  // And the happy path still works after the refusals.
+  std::string good = UniqueShmName("good");
+  auto w = ShmRing::Create(good, 4096);
+  if (w == nullptr) return false;
+  auto r = ShmRing::Attach(good);
+  return r != nullptr && r->capacity() == 4096;
+}
+
+// Closing the writer wakes a parked reader promptly (no deadline-long
+// hang), and a reader parked on an empty ring respects its timeout.
+bool ShmClosedWakesPeer() {
+  std::string name = UniqueShmName("close");
+  auto w = ShmRing::Create(name, 4096);
+  if (w == nullptr) return false;
+  auto r = ShmRing::Attach(name);
+  if (r == nullptr) return false;
+  w->MarkExchanged();
+  auto t0 = std::chrono::steady_clock::now();
+  std::thread closer([&] {
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    w->Close();
+  });
+  char buf;
+  bool read_failed = !r->ReadAll(&buf, 1, 10000);
+  closer.join();
+  double elapsed =
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+          .count();
+  return read_failed && elapsed < 5.0;
+}
+
+// ---- per-hop transport microbench (bench.py --shm) ----
+//
+// One ring hop = a full-duplex neighbor exchange: each side sends
+// `nbytes` while receiving `nbytes` (exactly PairExchange's payload
+// pump), including the 16-byte frame header and the receive-side
+// incremental CRC. Two threads on this host play the two ranks; each
+// direction gets its own transport pair (an SPSC shm ring, or one side
+// of a socketpair) — the in-process setup isolates the TRANSPORT cost
+// from the negotiation/control plane that dominates end-to-end op time
+// on small hosts.
+
+struct HopEnd {
+  // shm transport
+  ShmRing* out_ring = nullptr;
+  ShmRing* in_ring = nullptr;
+  // tcp transport
+  int out_fd = -1;
+  int in_fd = -1;
+};
+
+static bool HopExchange(HopEnd& e, const char* sbuf, char* rbuf,
+                        std::size_t nbytes) {
+  char shdr[kFrameHeaderBytes];
+  uint32_t scrc = FrameCrc(0x20, nbytes, sbuf, nbytes);
+  BuildFrameHeader(shdr, 0x20, nbytes, scrc);
+  std::size_t hsent = 0, hrecv = 0, sent = 0, received = 0;
+  char rhdr[kFrameHeaderBytes];
+  uint32_t crc_acc = 0;
+  bool crc_seeded = false;
+  while (hsent < sizeof(shdr) || hrecv < sizeof(rhdr) ||
+         sent < nbytes || received < nbytes) {
+    bool progress = false;
+    if (e.out_ring != nullptr) {
+      if (hsent < sizeof(shdr)) {
+        int64_t w = e.out_ring->WriteSome(shdr + hsent,
+                                          sizeof(shdr) - hsent);
+        if (w < 0) return false;
+        if (w > 0) { hsent += w; progress = true; }
+      } else if (sent < nbytes) {
+        int64_t w = e.out_ring->WriteSome(sbuf + sent, nbytes - sent);
+        if (w < 0) return false;
+        if (w > 0) { sent += w; progress = true; }
+      }
+      if (hrecv < sizeof(rhdr)) {
+        int64_t r = e.in_ring->ReadSome(rhdr + hrecv,
+                                        sizeof(rhdr) - hrecv);
+        if (r < 0) return false;
+        if (r > 0) { hrecv += r; progress = true; }
+      } else if (received < nbytes) {
+        if (!crc_seeded) {
+          uint32_t rtag, rcrc;
+          uint64_t rlen;
+          ParseFrameHeader(rhdr, &rtag, &rlen, &rcrc);
+          crc_acc = NetCrcEnabled() ? FrameHeaderCrc(rtag, rlen) : 0;
+          crc_seeded = true;
+        }
+        int64_t r = e.in_ring->ReadSome(rbuf + received,
+                                        nbytes - received);
+        if (r < 0) return false;
+        if (r > 0) {
+          if (NetCrcEnabled()) {
+            crc_acc = Crc32c(rbuf + received, static_cast<std::size_t>(r),
+                             crc_acc);
+          }
+          received += r;
+          progress = true;
+        }
+      }
+      if (!progress) {
+        if (received < nbytes || hrecv < sizeof(rhdr)) {
+          e.in_ring->WaitReadable(2);
+        } else {
+          e.out_ring->WaitWritable(2);
+        }
+      }
+      continue;
+    }
+    // TCP: nonblocking duplex pump with poll, the production shape.
+    struct pollfd pfds[2];
+    int n = 0;
+    if (hsent < sizeof(shdr) || sent < nbytes) {
+      pfds[n++] = {e.out_fd, POLLOUT, 0};
+    }
+    if (hrecv < sizeof(rhdr) || received < nbytes) {
+      pfds[n++] = {e.in_fd, POLLIN, 0};
+    }
+    if (::poll(pfds, n, 1000) < 0) return false;
+    if (hsent < sizeof(shdr)) {
+      ssize_t w = ::send(e.out_fd, shdr + hsent, sizeof(shdr) - hsent,
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (w > 0) hsent += w;
+    } else if (sent < nbytes) {
+      ssize_t w = ::send(e.out_fd, sbuf + sent, nbytes - sent,
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK) return false;
+      if (w > 0) sent += w;
+    }
+    if (hrecv < sizeof(rhdr)) {
+      ssize_t r = ::recv(e.in_fd, rhdr + hrecv, sizeof(rhdr) - hrecv,
+                         MSG_DONTWAIT);
+      if (r == 0) return false;
+      if (r > 0) hrecv += r;
+    } else if (received < nbytes) {
+      if (!crc_seeded) {
+        uint32_t rtag, rcrc;
+        uint64_t rlen;
+        ParseFrameHeader(rhdr, &rtag, &rlen, &rcrc);
+        crc_acc = NetCrcEnabled() ? FrameHeaderCrc(rtag, rlen) : 0;
+        crc_seeded = true;
+      }
+      ssize_t r = ::recv(e.in_fd, rbuf + received, nbytes - received,
+                         MSG_DONTWAIT);
+      if (r == 0) return false;
+      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK) return false;
+      if (r > 0) {
+        if (NetCrcEnabled()) {
+          crc_acc = Crc32c(rbuf + received, static_cast<std::size_t>(r),
+                           crc_acc);
+        }
+        received += r;
+      }
+    }
+  }
+  // Verify like the production pump (keeps the CRC pass in the timing).
+  uint32_t rtag, rcrc;
+  uint64_t rlen;
+  ParseFrameHeader(rhdr, &rtag, &rlen, &rcrc);
+  return !NetCrcEnabled() || crc_acc == rcrc;
+}
+
+static double HopBench(bool use_shm, std::size_t nbytes, int iters) {
+  HopEnd a, b;
+  std::unique_ptr<ShmRing> rings[4];
+  int fds_ab[2] = {-1, -1}, fds_ba[2] = {-1, -1};
+  if (use_shm) {
+    std::string base = UniqueShmName("hop");
+    rings[0] = ShmRing::Create(base + "-ab", ShmSegmentBytes());
+    rings[1] = ShmRing::Attach(base + "-ab");
+    rings[2] = ShmRing::Create(base + "-ba", ShmSegmentBytes());
+    rings[3] = ShmRing::Attach(base + "-ba");
+    for (auto& r : rings) {
+      if (r == nullptr) return -1.0;
+    }
+    rings[0]->MarkExchanged();
+    rings[2]->MarkExchanged();
+    a.out_ring = rings[0].get();
+    b.in_ring = rings[1].get();
+    b.out_ring = rings[2].get();
+    a.in_ring = rings[3].get();
+  } else {
+    // The baseline is genuine TCP LOOPBACK (what the production data
+    // plane rides intra-host without shm), not an AF_UNIX socketpair —
+    // Unix sockets skip the TCP stack and would flatter the baseline.
+    auto tcp_pair = [](int out[2]) {
+      Listener l;
+      if (!l.Start(0)) return false;
+      int cfd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (cfd < 0) return false;
+      sockaddr_in addr;
+      std::memset(&addr, 0, sizeof(addr));
+      addr.sin_family = AF_INET;
+      addr.sin_addr.s_addr = htonl(0x7F000001);
+      addr.sin_port = htons(static_cast<uint16_t>(l.port()));
+      if (::connect(cfd, reinterpret_cast<sockaddr*>(&addr),
+                    sizeof(addr)) != 0) {
+        ::close(cfd);
+        return false;
+      }
+      int sfd = ::accept(l.fd(), nullptr, nullptr);
+      if (sfd < 0) {
+        ::close(cfd);
+        return false;
+      }
+      ConfigureSocket(cfd);
+      ConfigureSocket(sfd);
+      out[0] = cfd;
+      out[1] = sfd;
+      return true;
+    };
+    if (!tcp_pair(fds_ab) || !tcp_pair(fds_ba)) return -1.0;
+    a.out_fd = fds_ab[0];
+    b.in_fd = fds_ab[1];
+    b.out_fd = fds_ba[0];
+    a.in_fd = fds_ba[1];
+  }
+  std::string sa(nbytes, 'a'), sb(nbytes, 'b');
+  std::string ra(nbytes, 0), rb(nbytes, 0);
+  std::atomic<bool> ok{true};
+  double us = -1.0;
+  {
+    std::thread peer([&] {
+      for (int i = 0; i < iters + 1 && ok.load(); ++i) {
+        if (!HopExchange(b, sb.data(), &rb[0], nbytes)) ok.store(false);
+      }
+    });
+    // Warmup hop, then the timed run.
+    if (!HopExchange(a, sa.data(), &ra[0], nbytes)) ok.store(false);
+    auto t0 = std::chrono::steady_clock::now();
+    for (int i = 0; i < iters && ok.load(); ++i) {
+      if (!HopExchange(a, sa.data(), &ra[0], nbytes)) ok.store(false);
+    }
+    us = std::chrono::duration<double, std::micro>(
+             std::chrono::steady_clock::now() - t0)
+             .count() /
+         iters;
+    peer.join();
+  }
+  if (fds_ab[0] >= 0) {
+    ::close(fds_ab[0]);
+    ::close(fds_ab[1]);
+    ::close(fds_ba[0]);
+    ::close(fds_ba[1]);
+  }
+  if (!ok.load() || ra != sb) return -1.0;
+  return us;
+}
+
 }  // namespace
 }  // namespace hvdtpu
 
@@ -238,6 +579,16 @@ uint32_t horovod_tpu_crc32c_extend(uint32_t crc, const void* data,
   return hvdtpu::Crc32c(data, static_cast<std::size_t>(len), crc);
 }
 
+// Per-hop transport microbench (bench.py --shm): microseconds for one
+// full-duplex `nbytes` neighbor exchange (header + incremental CRC, the
+// production pump shape) between two in-process threads over shared
+// memory (use_shm=1) or a socketpair (0). Returns -1.0 on failure.
+double horovod_tpu_hop_bench(int use_shm, int64_t nbytes, int iters) {
+  return hvdtpu::HopBench(use_shm != 0,
+                          static_cast<std::size_t>(nbytes),
+                          iters < 1 ? 1 : iters);
+}
+
 // Runs the named transport selftest; 1 = pass, 0 = fail, -1 = unknown
 // name. Scenarios: crc_roundtrip, crc_corrupt_detected, recv_deadline,
 // max_frame, handshake_timeout, stale_generation, fault_spec.
@@ -251,6 +602,10 @@ int horovod_tpu_net_selftest(const char* name) {
   if (n == "handshake_timeout") return HandshakeTimeout() ? 1 : 0;
   if (n == "stale_generation") return StaleGenerationRejected() ? 1 : 0;
   if (n == "fault_spec") return FaultSpecDeterministic() ? 1 : 0;
+  if (n == "shm_roundtrip") return ShmRoundtrip() ? 1 : 0;
+  if (n == "shm_corrupt_detected") return ShmCorruptDetected() ? 1 : 0;
+  if (n == "shm_fallback") return ShmFallbackNegotiation() ? 1 : 0;
+  if (n == "shm_closed_wakes_peer") return ShmClosedWakesPeer() ? 1 : 0;
   return -1;
 }
 

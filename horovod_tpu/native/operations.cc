@@ -267,6 +267,11 @@ bool RunLoopOnce(HorovodGlobalState& state,
   metrics.pipeline_chunk_bytes.store(
       state.parameter_manager.PipelineChunkBytes(),
       std::memory_order_relaxed);
+  // Apply the (cycle-synchronized) shm_transport knob at the cycle
+  // boundary: every rank runs this line between the same two response
+  // lists, so both ends of any negotiated segment flip together and an
+  // exchange can never pair an shm writer with a TCP reader.
+  state.tcp_context.SetShmUse(state.parameter_manager.ShmTransport());
   uint64_t rearms = state.parameter_manager.rearms_total();
   uint64_t seen = metrics.autotune_rearms_total.load(
       std::memory_order_relaxed);
@@ -338,6 +343,18 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
   int64_t pipeline_chunk =
       EnvInt64(HVD_TPU_PIPELINE_CHUNK_BYTES, 1 << 20, &fixed);
   state.parameter_manager.SetPipelineChunkBytes(pipeline_chunk, fixed);
+  // Shared-memory transport knob (docs/TRANSPORT.md): HVD_TPU_SHM=0/1
+  // pins it off/on; unset (or "auto") defaults on and leaves it to the
+  // autotuner on shm-capable topologies.
+  {
+    const char* shm_env = std::getenv("HVD_TPU_SHM");
+    if (shm_env != nullptr && (shm_env[0] == '0' || shm_env[0] == '1') &&
+        shm_env[1] == '\0') {
+      state.parameter_manager.SetShmTransport(shm_env[0] == '1', true);
+    } else {
+      state.parameter_manager.SetShmTransport(true, false);
+    }
+  }
 
   state.controller->stall_inspector().SetStallWarningTimeSeconds(
       static_cast<int>(EnvInt64(HVD_TPU_STALL_CHECK_TIME, 60)));
@@ -390,7 +407,11 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
   state.parameter_manager.ObserveWorkload(
       ParseCompressionMode(std::getenv(HVD_TPU_COMPRESSION_ENV)) !=
           CompressionMode::NONE,
-      EnvBool(HVD_TPU_SHARDED_UPDATE_ENV, false));
+      EnvBool(HVD_TPU_SHARDED_UPDATE_ENV, false),
+      /*groups_active=*/false,
+      // shm capability is a pure function of (HVD_TPU_SHM, the full
+      // address list) — identical on every rank, like the env seeds.
+      state.tcp_context.shm_topology_possible());
   // Always-on closed loop (docs/AUTOTUNE.md): tuning defaults ON and
   // re-arms on every generation (this code path runs per elastic
   // re-init) plus on observed workload shifts. HVD_TPU_AUTOTUNE=0 — or

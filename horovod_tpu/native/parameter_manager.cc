@@ -31,6 +31,7 @@ static constexpr double kChunkLoKbCompressed = 16.0,
 static constexpr uint64_t kProfileCompression = 1;
 static constexpr uint64_t kProfileReduceScatter = 2;
 static constexpr uint64_t kProfileGroups = 4;
+static constexpr uint64_t kProfileShm = 8;
 
 ParameterManager::ParameterManager() = default;
 ParameterManager::~ParameterManager() = default;
@@ -63,12 +64,14 @@ void ParameterManager::Initialize(int32_t rank,
   profile_compression_ = false;
   profile_reduce_scatter_ = false;
   profile_groups_ = false;
+  profile_shm_ = false;
   if (rank == 0 && !autotune_log_file.empty()) {
     log_.open(autotune_log_file, std::ios::out | std::ios::trunc);
     if (log_.is_open()) {
       log_ << "fusion_mb,cycle_time_ms,pipeline_chunk_kb,cache_enabled,"
               "hierarchical_allreduce,hierarchical_allgather,"
-              "hierarchical_reduce_scatter,score_bytes_per_us,event\n";
+              "hierarchical_reduce_scatter,shm_transport,"
+              "score_bytes_per_us,event\n";
     }
   }
   BuildSearchSpace();
@@ -94,11 +97,19 @@ void ParameterManager::BuildSearchSpace() {
       (hier_rs_fixed_ || !profile_reduce_scatter_)
           ? std::vector<bool>{hierarchical_reduce_scatter_}
           : std::vector<bool>{false, true};
+  // The shm dimension only opens on an shm-capable topology (profile
+  // bit): on a flat single-rank-per-host job every sample would score
+  // an identical configuration.
+  std::vector<bool> shm_opts =
+      (shm_fixed_ || !profile_shm_) ? std::vector<bool>{shm_transport_}
+                                    : std::vector<bool>{true, false};
   for (bool c : cache_opts) {
     for (bool ar : har_opts) {
       for (bool ag : hag_opts) {
         for (bool rs : hrs_opts) {
-          categorical_combos_.push_back({c, ar, ag, rs});
+          for (bool sm : shm_opts) {
+            categorical_combos_.push_back({c, ar, ag, rs, sm});
+          }
         }
       }
     }
@@ -228,6 +239,17 @@ void ParameterManager::SetHierarchicalReduceScatter(bool enabled, bool fixed) {
   hier_rs_fixed_ = hier_rs_fixed_ || fixed;
 }
 
+bool ParameterManager::ShmTransport() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return shm_transport_;
+}
+
+void ParameterManager::SetShmTransport(bool enabled, bool fixed) {
+  std::lock_guard<std::mutex> lk(mu_);
+  shm_transport_ = enabled;
+  shm_fixed_ = shm_fixed_ || fixed;
+}
+
 int64_t ParameterManager::PipelineChunkBytes() const {
   std::lock_guard<std::mutex> lk(mu_);
   if (pipeline_chunk_kb_ <= 0.0) return 0;
@@ -242,7 +264,8 @@ void ParameterManager::SetPipelineChunkBytes(int64_t bytes, bool fixed) {
 
 void ParameterManager::ObserveWorkload(bool compression_active,
                                        bool reduce_scatter_active,
-                                       bool groups_active) {
+                                       bool groups_active,
+                                       bool shm_capable) {
   std::lock_guard<std::mutex> lk(mu_);
   // Sticky: once a capability is seen the search space stays shaped for
   // it (a job that did one sharded step will do more; a job that did
@@ -250,13 +273,16 @@ void ParameterManager::ObserveWorkload(bool compression_active,
   bool comp_changed = compression_active && !profile_compression_;
   bool rs_changed = reduce_scatter_active && !profile_reduce_scatter_;
   bool grp_changed = groups_active && !profile_groups_;
-  if (!comp_changed && !rs_changed && !grp_changed) return;
+  bool shm_changed = shm_capable && !profile_shm_;
+  if (!comp_changed && !rs_changed && !grp_changed && !shm_changed) return;
   profile_compression_ = profile_compression_ || compression_active;
   profile_reduce_scatter_ = profile_reduce_scatter_ || reduce_scatter_active;
   profile_groups_ = profile_groups_ || groups_active;
+  profile_shm_ = profile_shm_ || shm_capable;
   TriggerRearm(rs_changed ? "profile-reduce-scatter"
                           : (comp_changed ? "profile-compression"
-                                          : "profile-groups"));
+                                          : (grp_changed ? "profile-groups"
+                                                         : "profile-shm")));
 }
 
 bool ParameterManager::TriggerRearm(const char* reason) {
@@ -290,7 +316,8 @@ uint64_t ParameterManager::WireEpochForBroadcast() {
   }
   uint64_t profile = (profile_compression_ ? kProfileCompression : 0) |
                      (profile_reduce_scatter_ ? kProfileReduceScatter : 0) |
-                     (profile_groups_ ? kProfileGroups : 0);
+                     (profile_groups_ ? kProfileGroups : 0) |
+                     (profile_shm_ ? kProfileShm : 0);
   return (static_cast<uint64_t>(rearm_epoch_) << 8) | profile;
 }
 
@@ -303,6 +330,7 @@ void ParameterManager::NoteWireEpoch(uint64_t wire) {
   profile_compression_ = (wire & kProfileCompression) != 0;
   profile_reduce_scatter_ = (wire & kProfileReduceScatter) != 0;
   profile_groups_ = (wire & kProfileGroups) != 0;
+  profile_shm_ = (wire & kProfileShm) != 0;
   // Deterministic mirror of the coordinator's Arm(): fresh optimizers
   // with fixed seeds propose the same first sample, so every rank holds
   // identical knob values from this cycle on.
@@ -329,6 +357,7 @@ void ParameterManager::ReadyTune() {
   if (!hier_rs_fixed_ && profile_reduce_scatter_) {
     hierarchical_reduce_scatter_ = combo[3];
   }
+  if (!shm_fixed_ && profile_shm_) shm_transport_ = combo[4];
   auto next = optimizers_[combo_index_]->NextSample();
   if (!fusion_fixed_) fusion_mb_ = next[0];
   if (!cycle_fixed_) cycle_time_ms_ = next[1];
@@ -340,7 +369,7 @@ void ParameterManager::LogSample(double score, const char* event) {
   log_ << fusion_mb_ << "," << cycle_time_ms_ << "," << pipeline_chunk_kb_
        << "," << cache_enabled_ << "," << hierarchical_allreduce_ << ","
        << hierarchical_allgather_ << "," << hierarchical_reduce_scatter_
-       << "," << score << "," << event << "\n";
+       << "," << shm_transport_ << "," << score << "," << event << "\n";
   log_.flush();
 }
 
@@ -419,6 +448,7 @@ bool ParameterManager::Tune(double score) {
     best_hier_ar_ = hierarchical_allreduce_;
     best_hier_ag_ = hierarchical_allgather_;
     best_hier_rs_ = hierarchical_reduce_scatter_;
+    best_shm_ = shm_transport_;
   }
   optimizers_[combo_index_]->AddSample(
       {fusion_mb_, cycle_time_ms_, pipeline_chunk_kb_}, score);
@@ -442,6 +472,7 @@ bool ParameterManager::Tune(double score) {
     if (!hier_rs_fixed_ && profile_reduce_scatter_) {
       hierarchical_reduce_scatter_ = best_hier_rs_;
     }
+    if (!shm_fixed_ && profile_shm_) shm_transport_ = best_shm_;
     // The drift baseline is captured by the FIRST converged window
     // (see Update), under the knobs just adopted.
     baseline_pending_ = true;
@@ -457,6 +488,7 @@ bool ParameterManager::Tune(double score) {
               << " pipeline_kb=" << pipeline_chunk_kb_
               << " cache=" << cache_enabled_
               << " hier_rs=" << hierarchical_reduce_scatter_
+              << " shm=" << shm_transport_
               << " score=" << best_score_ << " bytes/us";
     return true;
   }
@@ -473,6 +505,7 @@ ParameterManager::Params ParameterManager::GetParamsLocked() const {
   p.hierarchical_allreduce = hierarchical_allreduce_ ? 1 : 0;
   p.hierarchical_allgather = hierarchical_allgather_ ? 1 : 0;
   p.hierarchical_reduce_scatter = hierarchical_reduce_scatter_ ? 1 : 0;
+  p.shm_transport = shm_transport_ ? 1 : 0;
   p.active = active_ ? 1 : 0;
   return p;
 }
@@ -491,6 +524,13 @@ void ParameterManager::SetParams(const Params& p) {
   hierarchical_allreduce_ = p.hierarchical_allreduce != 0;
   hierarchical_allgather_ = p.hierarchical_allgather != 0;
   hierarchical_reduce_scatter_ = p.hierarchical_reduce_scatter != 0;
+  // Workers honor their own env pin: a rank launched with HVD_TPU_SHM=0
+  // never negotiated segments, and adopting "on" from the coordinator
+  // must not make its PEERS (who did negotiate with other ranks) expect
+  // a transport this rank can't speak — fixed knobs are pinned on every
+  // rank identically when env is job-wide, which SetShmTransport's
+  // fixed flag enforces here.
+  if (!shm_fixed_) shm_transport_ = p.shm_transport != 0;
   active_ = p.active != 0;
 }
 
@@ -505,12 +545,13 @@ std::string ParameterManager::Json() const {
       "\"params\":{\"fusion_mb\":%.17g,\"cycle_time_ms\":%.17g,"
       "\"pipeline_chunk_kb\":%.17g,\"cache_enabled\":%s,"
       "\"hierarchical_allreduce\":%s,\"hierarchical_allgather\":%s,"
-      "\"hierarchical_reduce_scatter\":%s},"
+      "\"hierarchical_reduce_scatter\":%s,\"shm_transport\":%s},"
       "\"fixed\":{\"fusion\":%s,\"cycle\":%s,\"pipeline_chunk\":%s,"
       "\"cache\":%s,\"hierarchical_allreduce\":%s,"
-      "\"hierarchical_allgather\":%s,\"hierarchical_reduce_scatter\":%s},"
+      "\"hierarchical_allgather\":%s,\"hierarchical_reduce_scatter\":%s,"
+      "\"shm_transport\":%s},"
       "\"profile\":{\"compression\":%s,\"reduce_scatter\":%s,"
-      "\"groups\":%s},"
+      "\"groups\":%s,\"shm\":%s},"
       "\"baseline\":{\"bytes_per_cycle\":%.6g,\"tensors_per_cycle\":%.6g}}",
       active_ ? "true" : "false", rearm_epoch_,
       static_cast<unsigned long long>(rearms_total_), sample_count_,
@@ -519,13 +560,15 @@ std::string ParameterManager::Json() const {
       hierarchical_allreduce_ ? "true" : "false",
       hierarchical_allgather_ ? "true" : "false",
       hierarchical_reduce_scatter_ ? "true" : "false",
+      shm_transport_ ? "true" : "false",
       fusion_fixed_ ? "true" : "false", cycle_fixed_ ? "true" : "false",
       pipeline_fixed_ ? "true" : "false", cache_fixed_ ? "true" : "false",
       hier_ar_fixed_ ? "true" : "false", hier_ag_fixed_ ? "true" : "false",
-      hier_rs_fixed_ ? "true" : "false",
+      hier_rs_fixed_ ? "true" : "false", shm_fixed_ ? "true" : "false",
       profile_compression_ ? "true" : "false",
       profile_reduce_scatter_ ? "true" : "false",
-      profile_groups_ ? "true" : "false", baseline_bytes_per_cycle_,
+      profile_groups_ ? "true" : "false",
+      profile_shm_ ? "true" : "false", baseline_bytes_per_cycle_,
       baseline_tensors_per_cycle_);
   return buf;
 }

@@ -68,6 +68,14 @@ class ParameterManager {
   void SetHierarchicalAllgather(bool enabled, bool fixed = false);
   bool HierarchicalReduceScatter() const;
   void SetHierarchicalReduceScatter(bool enabled, bool fixed = false);
+  // Shared-memory transport for intra-host ring legs (docs/TRANSPORT.md):
+  // categorical auto/on/off — HVD_TPU_SHM=0/1 pins it, unset leaves it
+  // to the tuner (default on). Applied cycle-synchronized via
+  // TcpContext::SetShmUse; the dimension only opens in the search space
+  // when the topology is shm-capable (profile bit, like
+  // reduce-scatter's).
+  bool ShmTransport() const;
+  void SetShmTransport(bool enabled, bool fixed = false);
   // Pipelined-ring segment size in bytes (0 = slicing disabled). The
   // data-plane ops read this per execution; the tuner searches it in KB.
   int64_t PipelineChunkBytes() const;
@@ -80,7 +88,8 @@ class ParameterManager {
   // group-aware (a first subgroup collective changes the traffic mix
   // the knobs were scored under — the tuner must re-score under it).
   void ObserveWorkload(bool compression_active, bool reduce_scatter_active,
-                       bool groups_active = false);
+                       bool groups_active = false,
+                       bool shm_capable = false);
 
   // Called once per cycle on the coordinator with the tensors/bytes the
   // cycle executed. Advances sampling while tuning; tracks workload
@@ -114,6 +123,7 @@ class ParameterManager {
     uint8_t hierarchical_allreduce;
     uint8_t hierarchical_allgather;
     uint8_t hierarchical_reduce_scatter;
+    uint8_t shm_transport;
     uint8_t active;
   };
   Params GetParams() const;
@@ -142,6 +152,7 @@ class ParameterManager {
   bool hierarchical_allreduce_ = false;
   bool hierarchical_allgather_ = false;
   bool hierarchical_reduce_scatter_ = false;
+  bool shm_transport_ = true;
 
   // Fixed-by-env flags exclude a knob from tuning.
   bool fusion_fixed_ = false;
@@ -151,11 +162,13 @@ class ParameterManager {
   bool hier_ar_fixed_ = false;
   bool hier_ag_fixed_ = false;
   bool hier_rs_fixed_ = false;
+  bool shm_fixed_ = false;
 
   // Workload profile (search-space shaping + re-arm trigger).
   bool profile_compression_ = false;
   bool profile_reduce_scatter_ = false;
   bool profile_groups_ = false;
+  bool profile_shm_ = false;
 
   bool active_ = false;
   int32_t rank_ = -1;
@@ -179,10 +192,12 @@ class ParameterManager {
   bool best_hier_ar_ = false;
   bool best_hier_ag_ = false;
   bool best_hier_rs_ = false;
+  bool best_shm_ = true;
 
   // Categorical sweep state: index into combos; each combo gets its own
-  // BO over the continuous knobs (cache, hier_ar, hier_ag, hier_rs).
-  std::vector<std::array<bool, 4>> categorical_combos_;
+  // BO over the continuous knobs (cache, hier_ar, hier_ag, hier_rs,
+  // shm_transport).
+  std::vector<std::array<bool, 5>> categorical_combos_;
   std::size_t combo_index_ = 0;
   int samples_in_combo_ = 0;
   int samples_per_combo_ = 10;

@@ -24,12 +24,14 @@
 
 #include <atomic>
 #include <cstdint>
+#include <memory>
 #include <string>
 #include <thread>
 #include <unordered_map>
 #include <vector>
 
 #include "net.h"
+#include "shm_context.h"
 
 namespace hvdtpu {
 
@@ -127,15 +129,68 @@ class TcpContext {
                      std::size_t recv_len);
   bool GroupBroadcast(uint32_t group_id, void* buf, std::size_t len,
                       int root_pos);
-  // Dispatch helper for the ring ops: group == 0 -> the enum ring.
+  // --- group sub-rings (hierarchical composites for subgroups) ---
+  // When a group's member set forms a uniform (local, cross) grid of
+  // the world topology — every participating host contributes the same
+  // number of members — its hierarchical composites ride dedicated
+  // per-group local/cross rings (the local legs over shm when
+  // negotiated) instead of the flat group ring. docs/TRANSPORT.md.
+  struct GroupGrid {
+    bool uniform = false;
+    int local_size = 0;          // members per host
+    int cross_size = 0;          // hosts the group spans
+    int local_pos = -1;          // my index among my host's members
+    int cross_pos = -1;          // my host's index among the group's hosts
+    // pos_grid[c * local_size + j] = GROUP position (index into the
+    // member list) of the j-th member (by world local_rank) on the
+    // group's c-th host (hosts ordered by world cross_rank).
+    std::vector<int> pos_grid;
+  };
+  // Pure function of (members, world grid): identical on every rank, so
+  // op Enabled() decisions made from it can never diverge.
+  GroupGrid GroupGridOf(const std::vector<int>& members) const;
+  // Uniform grid with >1 member per host and >1 host: the precondition
+  // for a subgroup's two-level composites.
+  bool GroupHierarchicalPossible(const std::vector<int>& members) const;
+  // Lazily builds the group's local+cross rings (background thread
+  // only; connect-before-accept exactly like the flat group ring) and
+  // negotiates shm on the new legs.
+  bool EnsureGroupSubRings(uint32_t group_id, const std::vector<int>& members);
+
+  // Group-aware ring coordinates: group == 0 -> the enum rings;
+  // group != 0 with GLOBAL -> the group's flat ring; group != 0 with
+  // LOCAL/CROSS -> the group's sub-rings (EnsureGroupSubRings first).
+  int RingRankOn(Ring ring, uint32_t group) const;
+  int RingSizeOn(Ring ring, uint32_t group) const;
+  // Dispatch helper for the ring ops, same coordinate rule.
   bool ExchangeOn(Ring ring, uint32_t group, const void* send_buf,
                   std::size_t send_len, void* recv_buf,
                   std::size_t recv_len) {
-    return group == 0 ? RingExchangeOn(ring, send_buf, send_len, recv_buf,
-                                       recv_len)
-                      : GroupExchange(group, send_buf, send_len, recv_buf,
-                                      recv_len);
+    if (group == 0) {
+      return RingExchangeOn(ring, send_buf, send_len, recv_buf, recv_len);
+    }
+    if (ring == Ring::GLOBAL) {
+      return GroupExchange(group, send_buf, send_len, recv_buf, recv_len);
+    }
+    return GroupSubExchange(group, ring, send_buf, send_len, recv_buf,
+                            recv_len);
   }
+  bool GroupSubExchange(uint32_t group_id, Ring ring, const void* send_buf,
+                        std::size_t send_len, void* recv_buf,
+                        std::size_t recv_len);
+
+  // --- shared-memory data plane (docs/TRANSPORT.md) ---
+  // Whether the launcher-visible topology has at least one intra-host
+  // pair AND HVD_TPU_SHM is enabled — computed from the full address
+  // list, so it is identical on every rank (the autotuner's capability
+  // seed). Actual per-pair use additionally requires a successfully
+  // negotiated segment on both ends.
+  bool shm_topology_possible() const { return shm_topology_possible_; }
+  // The autotuned shm_transport knob's cycle-synchronized application
+  // point (operations.cc RunLoopOnce): when off, negotiated segments
+  // stay attached but every leg rides TCP. Background thread only.
+  void SetShmUse(bool use) { shm_use_ = use; }
+  bool shm_use() const { return shm_use_; }
 
   // --- control-plane protocol accounting ---
   // Bytes/messages THIS rank moved on the control star (16-byte frame
@@ -157,16 +212,63 @@ class TcpContext {
  private:
   bool ExchangeTopology();
   bool ConnectSubRings(int timeout_ms);
+
+  // --- shm negotiation (tcp_context.cc; docs/TRANSPORT.md) ---
+  // A connector that advertised kHandshakeShmCap sends exactly ONE
+  // setup frame per data conn (host key + segment name, or an empty
+  // name = "TCP please"); the acceptor answers with a one-byte ack.
+  // The three phases run in send-all / serve-all / collect-acks order
+  // so no pair can deadlock (setup and ack frames are tiny and fit any
+  // socket buffer).
+  struct ShmPending {
+    Conn* conn;
+    std::unique_ptr<ShmRing> ring;  // null when the connector chose TCP
+  };
+  // Runs the full three-phase negotiation over the init-time data conns
+  // (global + local + cross rings). Soft failures (attach refused, no
+  // /dev/shm) land pairs on TCP; false only on a frame-protocol error.
+  bool NegotiateShmInit();
+  bool ShmSetupSend(Conn* conn, int peer_rank, Channel chan,
+                    std::vector<ShmPending>* pending);
+  bool ShmSetupRecv(Conn* conn, uint8_t peer_flags);
+  bool ShmAckRecv(ShmPending* p);
+  // Negotiation for one freshly built group leg pair (flat or sub).
+  bool NegotiateShmPair(Conn* next, int next_rank, Conn* prev,
+                        uint8_t prev_flags, Channel chan);
+  // Host key WITHOUT the per-rank HVD_TPU_HOST_KEY override — the
+  // connector's symmetric same-host guess for any rank (the override
+  // only affects the authoritative key THIS rank puts in its setup
+  // frame / compares on accept).
+  std::string DefaultHostKey(int rank) const;
+  std::string MyHostKey() const;
+
+  // Shared connect-then-accept body for a group leg pair (flat ring or
+  // a sub-ring): connects to next_rank on `chan`, then accepts from
+  // prev_rank, stashing unrelated group connects for their own builds.
+  bool GroupPairConnect(uint32_t group_id, Channel chan, int next_rank,
+                        int prev_rank, Conn* next, Conn* prev,
+                        uint8_t* prev_flags);
+  // World local_rank of an arbitrary rank (grid scan; -1 when unknown).
+  int LocalRankOfWorld(int rank) const;
   // Shared duplex-pump body for all neighbor exchanges (enum rings and
   // group rings): header swap, CRC-verified full-duplex payload pump,
   // fault hooks, TX pacing, socket-layer byte accounting.
   bool PairExchange(Conn* next, Conn* prev, Channel chan, int ring_size,
                     const void* send_buf, std::size_t send_len,
                     void* recv_buf, std::size_t recv_len);
+  // Duplex payload pump for exchanges where at least one leg rides a
+  // shared-memory ring (tcp_context.cc; docs/TRANSPORT.md).
+  bool PumpShmAware(Conn* next, Conn* prev, Channel chan, ShmRing* sshm,
+                    ShmRing* rshm, const char* sp, std::size_t send_len,
+                    char* rp, std::size_t recv_len, bool recv_crc_on,
+                    uint32_t* crc_acc);
   // Shared cut-through broadcast body (global ring and group rings):
   // `pos`/`n`/`root_pos` are ring positions on the given conn pair.
   bool PairBroadcast(Conn* next, Conn* prev, int pos, int n, void* buf,
                      std::size_t len, int root_pos);
+  // Root-side shm streaming body for PairBroadcast.
+  bool StreamIntoShm(ShmRing* ring, Conn* conn, const char* p,
+                     std::size_t len);
   // Rank 0: receive one frame from every worker concurrently.
   bool MultiRecvFrames(uint32_t expect_tag, std::vector<std::string>* blobs);
   // Rank 0: send per-worker payloads concurrently (all pairs may alias).
@@ -223,6 +325,13 @@ class TcpContext {
 
   // rank_grid_[cross_rank * local_size + local_rank] = global rank.
   std::vector<int> rank_grid_;
+  // Reverse lookup: rank_cross_[rank] = that rank's cross index (host)
+  // when homogeneous; empty otherwise.
+  std::vector<int> rank_cross_;
+  // Host part of each rank's HVD_TPU_ADDRS entry (index == rank).
+  std::vector<std::string> addr_hosts_;
+  bool shm_topology_possible_ = false;
+  bool shm_use_ = true;
 
   Listener listener_;
   // Rank 0: control_conns_[r] for r=1..N-1; workers: control_conns_[0].
@@ -243,19 +352,38 @@ class TcpContext {
   Conn local_prev_;
   Conn cross_next_;       // successor within my local_rank's cross ring
   Conn cross_prev_;
+  // Handshake flags of the accepted (prev) side of each init-time data
+  // conn: NegotiateShmInit needs to know whether the connector
+  // advertised kHandshakeShmCap (a setup frame is then in flight).
+  uint8_t ring_prev_flags_ = 0;
+  uint8_t local_prev_flags_ = 0;
+  uint8_t cross_prev_flags_ = 0;
 
   // Lazily-built per-group rings (background thread only; see
   // EnsureGroupRing). pending_group_fds_ stashes accepted group-ring
-  // connects that belong to a group whose ring this rank has not built
-  // yet, keyed (group_id << 32) | peer_rank.
+  // connects that belong to a (group, channel) pair this rank has not
+  // built yet, keyed (channel << 60) | (group_id << 24) | peer_rank,
+  // carrying the handshake flags for the later shm negotiation.
   struct GroupRing {
     Conn next;
     Conn prev;
     int pos = 0;
     int size = 1;
   };
+  // Per-group local/cross sub-rings for uniform-grid subgroups
+  // (EnsureGroupSubRings).
+  struct GroupSubRings {
+    GroupGrid grid;
+    Conn lnext, lprev;  // intra-host ring among my host's group members
+    Conn cnext, cprev;  // cross-host ring at my local position
+  };
+  struct PendingGroupFd {
+    int fd = -1;
+    uint8_t flags = 0;
+  };
   std::unordered_map<uint32_t, GroupRing> group_rings_;
-  std::unordered_map<uint64_t, int> pending_group_fds_;
+  std::unordered_map<uint32_t, GroupSubRings> group_subrings_;
+  std::unordered_map<uint64_t, PendingGroupFd> pending_group_fds_;
 };
 
 }  // namespace hvdtpu

@@ -84,6 +84,24 @@ def _grp_state(cur, prev, dt, ctx):
     return "%d/%s" % (g, _fmt_rate(rate))
 
 
+def _shm_state(cur, prev, dt, ctx):
+    """Shared-memory data plane (docs/TRANSPORT.md): live attached
+    segments on the worker, suffixed with the shm byte rate when the
+    plane is moving traffic (e.g. '3/1.2M' = 3 segments, 1.2 MB/s
+    through shared memory). '0' = no segments (shm off, single-rank
+    host, or every pair nacked); '-' = the worker's summary predates
+    the shm fields (mixed-version elastic job)."""
+    if "shm_segments_active" not in cur:
+        return "-"
+    segs = int(cur.get("shm_segments_active", 0))
+    if segs <= 0:
+        return "0"
+    rate = _rate(cur, prev, "net_shm_bytes_sent_total", dt)
+    if rate is None or rate <= 0:
+        return "%d" % segs
+    return "%d/%s" % (segs, _fmt_rate(rate))
+
+
 def _cmp_ratio(cur, prev, dt, ctx):
     """Live wire-compression factor (docs/COMPRESSION.md): f32 bytes
     into the codec / bytes put on the wire. '-' when the worker
@@ -143,6 +161,9 @@ _COLUMNS = [
     # Process groups: registered groups (+ group-tensor rate when the
     # mesh is actually moving traffic) — docs/GROUPS.md.
     ("grp", 8, _grp_state),
+    # Shared-memory data plane: attached segments (+ shm byte rate) —
+    # docs/TRANSPORT.md.
+    ("shm", 8, _shm_state),
     ("lag_s", 9, lambda cur, prev, dt, ctx: "%.2f" % ctx["lag_total"]),
 ]
 

@@ -1,0 +1,99 @@
+"""Subgroup-hierarchical worker (4 ranks, forced 2x2 topology;
+tests/test_shm.py harness): a group whose member set forms a uniform
+(local, cross) grid must take the HIERARCHICAL reduce-scatter/allreduce
+path (counter-proved via reduce_scatter_hierarchical_total), with exact
+shard values pinned under all three wire codecs; a ragged group (2
+members on one host, 1 on the other) must stay on the flat group ring
+(the counter must NOT move for it)."""
+
+import json
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import ops
+
+
+SIZES = [1, 785, 4 * 256 + 5]
+
+
+def hier_count():
+    return hvd.metrics()["counters"]["reduce_scatter_hierarchical_total"]
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 4 and hvd.is_homogeneous()
+    # Every rank registers both groups with identical lists in identical
+    # order (the process-group contract, docs/GROUPS.md).
+    grid_group = hvd.new_group([0, 1, 2, 3])   # uniform 2x2 grid
+    ragged_group = hvd.new_group([0, 1, 3])    # 2 members host 0, 1 host 1
+
+    # Uniform-grid group: hierarchical path, exact shards, all codecs.
+    before = hier_count()
+    gr, gn = grid_group.rank(), grid_group.size()
+    for mode in ["none", "bf16", "int8"]:
+        for size in SIZES:
+            if mode == "int8":
+                x = np.full(size, float(gr + 1), np.float32)
+                want = np.full(size, sum(range(1, gn + 1)), np.float32)
+            else:
+                i = np.arange(size, dtype=np.float32)
+                x = np.asarray((i % 11) + gr + 1, np.float32)
+                want = np.asarray(gn * (i % 11) + sum(range(1, gn + 1)),
+                                  np.float32)
+            shard = ops.reduce_scatter(x, "ghier.rs.%s.%d" % (mode, size),
+                                       compression=mode, group=grid_group)
+            counts, offsets = ops.shard_partition(size, gn)
+            if not np.array_equal(
+                    shard, want[offsets[gr]:offsets[gr] + counts[gr]]):
+                print("GRID RS MISMATCH mode %s size %d rank %d"
+                      % (mode, size, r), flush=True)
+                return 1
+            out = ops.allreduce(x, "ghier.ar.%s.%d" % (mode, size),
+                                compression=mode, group=grid_group)
+            if not np.array_equal(out, want):
+                print("GRID AR MISMATCH mode %s size %d rank %d"
+                      % (mode, size, r), flush=True)
+                return 1
+    grid_hier = hier_count() - before
+    # Gauge snapshot while every peer is provably still alive (the last
+    # collective just completed): a peer that exits first EOFs the
+    # control star and the coordinator's teardown zeroes the gauge.
+    segments_live = hvd.metrics()["gauges"]["shm_segments_active"]
+
+    # Ragged group: flat ring path — the hierarchical counter must not
+    # move while its reduce-scatters execute (members only).
+    before = hier_count()
+    if ragged_group.rank() >= 0:
+        rr, rn = ragged_group.rank(), ragged_group.size()
+        size = 785
+        x = np.full(size, float(rr + 1), np.float32)
+        want = np.full(size, sum(range(1, rn + 1)), np.float32)
+        shard = ops.reduce_scatter(x, "ragged.rs", group=ragged_group)
+        counts, offsets = ops.shard_partition(size, rn)
+        if not np.array_equal(
+                shard, want[offsets[rr]:offsets[rr] + counts[rr]]):
+            print("RAGGED RS MISMATCH rank %d" % r, flush=True)
+            return 1
+    ragged_hier = hier_count() - before
+
+    # World barrier before the final read so the counters cover every
+    # phase on every rank.
+    ops.allreduce(np.ones(1, np.float32), "ghier.barrier")
+    snap = hvd.metrics()
+    print("GHIER_METRICS %s" % json.dumps({
+        "rank": r,
+        "grid_hier": grid_hier,
+        "ragged_hier": ragged_hier,
+        "segments": segments_live,
+        "shm_sent": snap["counters"]["net_shm_bytes_sent_total"],
+    }), flush=True)
+    print("rank %d group-hier worker done" % r, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

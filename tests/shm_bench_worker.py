@@ -1,0 +1,55 @@
+"""Shm-vs-TCP A/B bench worker (bench.py --shm): allreduces payloads of
+several sizes HVD_TPU_BENCH_ITERS times under HVD_TPU_COMPRESSION with
+the shm plane on or off (HVD_TPU_SHM), verifying values every iteration,
+and reports per-size wall time plus the transport counters as one
+`SHM_BENCH {...}` JSON line per rank. Per-hop latency comes from the
+smallest payload (an allreduce at 2 ranks is exactly 2 neighbor
+exchanges, so us_per_op/2 ~ one hop)."""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import ops
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    iters = int(os.environ.get("HVD_TPU_BENCH_ITERS", "20"))
+    mode = os.environ.get("HVD_TPU_COMPRESSION", "none") or "none"
+    sizes = [int(s) for s in os.environ.get(
+        "HVD_TPU_BENCH_SIZES", "4096,65536,1048576,4194304").split(",")]
+    tol = {"none": 1e-5, "bf16": 2e-2, "int8": 4e-2}[mode]
+
+    per_size = {}
+    for nbytes in sizes:
+        elems = nbytes // 4
+        base = (np.arange(elems, dtype=np.float32) % 997) / 31.0
+        want = base * n + sum(range(n))
+        ops.allreduce(base + r, "shmbench.warm.%d" % nbytes)  # warmup
+        t0 = time.perf_counter()
+        for i in range(iters):
+            out = ops.allreduce(base + r, "shmbench.%d.%d" % (nbytes, i))
+            err = np.max(np.abs(out - want)) / np.max(np.abs(want))
+            assert err < tol, (mode, nbytes, i, err)
+        dt = time.perf_counter() - t0
+        per_size[str(nbytes)] = round(dt / iters * 1e6, 1)
+
+    c = hvd.metrics()
+    print("SHM_BENCH %s" % json.dumps({
+        "rank": r, "size": n, "mode": mode, "iters": iters,
+        "us_per_op": per_size,
+        "segments": c["gauges"]["shm_segments_active"],
+        "shm_bytes_sent": c["counters"]["net_shm_bytes_sent_total"],
+        "ring_bytes_sent": c["counters"]["net_ring_bytes_sent_total"],
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""Peer-death-mid-shm-hop worker (tests/test_shm.py): rank 1 SIGKILLs
+itself in the middle of a stream of large allreduces (no orderly close —
+the shm ring's closed flag is never set), and rank 0 must surface a
+prompt recoverable CONNECTION_LOST instead of hanging: the liveness
+probe on the shm leg's TCP socket (EOF) or the transport deadline is
+what catches it."""
+
+import os
+import signal
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import ops
+from horovod_tpu.common.ops import HorovodInternalError
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+    x = np.ones(1 << 20, np.float32)
+    try:
+        for i in range(200):
+            if r == 1 and i == 5:
+                os.kill(os.getpid(), signal.SIGKILL)
+            ops.allreduce(x, "kill.%d" % i)
+    except HorovodInternalError as e:
+        print("CONNLOST %s" % str(e)[:160], flush=True)
+        return 7
+    print("rank %d finished without peer loss" % r, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,25 +122,26 @@ def test_autotune_e2e(run_launcher, tmp_path):
         assert CYCLE_LO <= p["cycle_time_ms"] <= CYCLE_HI, p
 
     # CSV: header + >= 2 post-warmup samples, all rows in bounds. Format
-    # (docs/AUTOTUNE.md): the three continuous knobs, the four
-    # categorical knobs, the score, and the row's event
-    # (sample/converged/rearm reason).
+    # (docs/AUTOTUNE.md): the three continuous knobs, the five
+    # categorical knobs (cache, the three hierarchicals, shm_transport),
+    # the score, and the row's event (sample/converged/rearm reason).
     lines = log.read_text().strip().splitlines()
     assert lines[0].startswith(
         "fusion_mb,cycle_time_ms,pipeline_chunk_kb,cache_enabled"), lines[0]
+    assert "shm_transport" in lines[0], lines[0]
     rows = [line.split(",") for line in lines[1:]]
     assert len(rows) >= 2, lines
-    assert any(row[8] == "converged" for row in rows), lines
+    assert any(row[9] == "converged" for row in rows), lines
     for row in rows:
-        assert len(row) == 9, row
+        assert len(row) == 10, row
         fusion, cycle, chunk = float(row[0]), float(row[1]), float(row[2])
         assert FUSION_LO <= fusion <= FUSION_HI, row
         assert CYCLE_LO <= cycle <= CYCLE_HI, row
         assert CHUNK_LO_KB <= chunk <= CHUNK_HI_KB, row
-        for cat in row[3:7]:
+        for cat in row[3:8]:
             assert cat in ("0", "1"), row
-        assert np.isfinite(float(row[7])), row
-        assert row[8], row
+        assert np.isfinite(float(row[8])), row
+        assert row[9], row
 
 
 @pytest.mark.e2e

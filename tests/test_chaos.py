@@ -118,6 +118,25 @@ MATRIX = [
     ("ring-drop",
      "seed=14;rank=1,chan=ring,dir=send,frame=3,action=drop",
      "fail", ["timeout", "deadline", "connection"]),
+    # Shared-memory plane (docs/TRANSPORT.md): chan=shm filters by
+    # TRANSPORT — on a same-host 2-rank job every data leg rides shm by
+    # default, so these target the new plane directly. The invariant is
+    # byte-identical to the socket legs': a corrupted shm frame is a
+    # prompt cause-naming CRC failure (never wrong gradients), and a
+    # torn-down ring mid-hop is a prompt CONNECTION_LOST (never a hang).
+    ("shm-corrupt-send",
+     "seed=21;rank=1,chan=shm,dir=send,frame=2,action=corrupt",
+     "fail", ["checksum mismatch"]),
+    ("shm-close",
+     "seed=22;rank=1,chan=shm,dir=send,frame=2,action=close",
+     "fail", ["connection closed", "connection lost", "timeout",
+              "deadline"]),
+    ("shm-stall",
+     "seed=23;rank=1,chan=shm,frame=3,action=stall,delay_ms=30000",
+     "fail", ["timeout", "deadline", "connection", "stalled"]),
+    ("shm-delay-prob",
+     "seed=24;rank=1,chan=shm,prob=0.3,action=delay,delay_ms=50",
+     "recover", []),
 ]
 
 

@@ -63,6 +63,11 @@ def test_crc32c_detects_single_bit_flip():
     "handshake_timeout",       # silent client can't wedge the accept loop
     "stale_generation",        # old-generation peer rejected at accept
     "fault_spec",              # injector parse + seeded determinism
+    # Shared-memory data plane (docs/TRANSPORT.md):
+    "shm_roundtrip",           # SPSC ring round-trip incl. wrap + EOF
+    "shm_corrupt_detected",    # in-segment flip -> CRC error, not data
+    "shm_fallback",            # bad name / bad header refuse -> TCP path
+    "shm_closed_wakes_peer",   # Close wakes a futex-parked reader promptly
 ])
 def test_net_selftest(scenario):
     assert _lib().horovod_tpu_net_selftest(scenario.encode()) == 1, scenario
@@ -70,3 +75,25 @@ def test_net_selftest(scenario):
 
 def test_net_selftest_unknown_name():
     assert _lib().horovod_tpu_net_selftest(b"no_such_scenario") == -1
+
+
+def test_no_tracked_native_binaries():
+    """Guard: no build artifact (*.so / *.o / *.d) under horovod_tpu/
+    may ever be git-tracked again — a stale prebuilt .so shadowing fresh
+    sources has produced phantom test failures before (a tracked one
+    would pin that hazard into every checkout). Skips gracefully when
+    git is unavailable (e.g. an exported tarball)."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "ls-files", "horovod_tpu/"], cwd=REPO_ROOT,
+            capture_output=True, text=True, timeout=60)
+    except (OSError, subprocess.TimeoutExpired):
+        pytest.skip("git unavailable")
+    if out.returncode != 0:
+        pytest.skip("not a git checkout")
+    tracked = [f for f in out.stdout.splitlines()
+               if f.endswith((".so", ".o", ".d", ".a", ".dylib"))]
+    assert tracked == [], (
+        "build artifacts are git-tracked (git rm --cached them): %s"
+        % tracked)
