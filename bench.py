@@ -580,6 +580,204 @@ def durable_commit_main(args):
     return 0
 
 
+def _serve_port_block(n):
+    """A base port with n consecutive free ports (probe-and-release;
+    the serve plane needs CONTIGUOUS ports: endpoint = base + wid)."""
+    import random
+    for _ in range(64):
+        base = random.randint(21000, 55000)
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+        return base
+    raise RuntimeError("no free port block found")
+
+
+def serve_main(args):
+    """bench.py --serve (docs/SERVE.md, PERF.md round 12): the serving
+    plane under seeded open-loop load on this container's CPUs.
+
+    Phase 1, the RPS/latency curve: a fixed 2-replica pool (numpy
+    forward, HVD_TPU_SERVE_JIT=0 — the bench measures the SERVING
+    machinery: admission, micro-batching, HTTP, split-back; not XLA)
+    takes open-loop load at stepped offered rates; each row records
+    achieved RPS and p50/p99 latency, with every response verified
+    against the weight set its fingerprint names (ok must equal
+    offered — the curve is invalid if the pool dropped or mislabeled
+    anything).
+
+    Phase 2, the autoscale row: a pool deliberately born TOO SMALL
+    (1 replica, ceiling 2) takes a traffic step; the supervisor's
+    queue-pressure autoscaler must absorb the freed capacity (grow to
+    2) DURING the step, and the step must still finish loss-free —
+    elasticity as a serving property, not just a training one.
+    """
+    import tempfile
+    import threading
+
+    from horovod_tpu.elastic.state import EXIT_DRAINED
+    from horovod_tpu.serve import model as smodel
+    from horovod_tpu.serve.loadgen import run_load
+    from horovod_tpu.serve.supervisor import ServeSupervisor
+    from horovod_tpu.serve.swap import publish_leaves
+
+    tmpdir = tempfile.mkdtemp(prefix="hvd-serve-bench-")
+
+    def pool(np_initial, max_np, port_base, model_name, dim, ckpt,
+             **sup_kwargs):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "HVD_TPU_SERVE_JIT": "0",
+            "HVD_TPU_SERVE_MODEL": model_name,
+            "HVD_TPU_SERVE_DIM": str(dim),
+            "HVD_TPU_SERVE_PORT": str(port_base),
+            "HVD_TPU_CKPT_DIR": ckpt,
+        })
+        sup = ServeSupervisor(
+            [sys.executable, "-m", "horovod_tpu.serve.replica"],
+            {"localhost": max_np}, min_replicas=1, max_replicas=max_np,
+            np_initial=np_initial, port_base=port_base, env=env,
+            **sup_kwargs)
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(
+                rc=sup.driver.run(install_signal_handlers=False)),
+            daemon=True)
+        t.start()
+        deadline = time.time() + 60
+        while True:
+            up = sum(1 for v in sup.replica_views(timeout=1.0)
+                     if v.get("state") == "serving")
+            if up >= np_initial:
+                break
+            if time.time() > deadline:
+                raise RuntimeError("serve pool never became healthy")
+            time.sleep(0.1)
+        return sup, t, box
+
+    def shutdown(sup, t, box):
+        sup.driver.request_drain("all")
+        t.join(timeout=90)
+        return box.get("rc")
+
+    # --- Phase 1: the curve on a fixed 2-replica pool (cheap affine
+    # forward — this phase measures the serving MACHINERY's latency).
+    dim = 16
+    leaves = smodel.init_leaves("affine", dim, seed=1)
+    crc = smodel.fingerprint(leaves)
+    by_crc = {crc: leaves}
+    ckpt1 = os.path.join(tmpdir, "curve")
+    publish_leaves(ckpt1, 10, leaves)
+    rates = [20, 40, 80]
+    curve = []
+    sup, t, box = pool(2, 2, _serve_port_block(2), "affine", dim, ckpt1)
+    try:
+        for i, rate in enumerate(rates):
+            res, wall = run_load(sup.endpoints, rate=rate,
+                                 duration=3.0, dim=dim, seed=12,
+                                 leaves_by_crc=by_crc, workers=8,
+                                 total_deadline=10.0,
+                                 rid_base=i * 100000)
+            row = res.summary(wall)
+            assert not res.mismatches, res.mismatches[:3]
+            curve.append({
+                "offered_rps": rate,
+                "achieved_rps": row["rps_achieved"],
+                "ok": row["ok"], "errors": row["errors"],
+                "p50_ms": row["p50_ms"], "p99_ms": row["p99_ms"],
+            })
+            print("bench: serve curve %d rps -> %.1f achieved, "
+                  "p50 %.1fms p99 %.1fms (%d ok, %d err)"
+                  % (rate, row["rps_achieved"], row["p50_ms"],
+                     row["p99_ms"], row["ok"], row["errors"]),
+                  file=sys.stderr)
+    finally:
+        rc = shutdown(sup, t, box)
+    curve_ok = (rc == EXIT_DRAINED and
+                all(r["errors"] == 0 for r in curve))
+
+    # --- Phase 2: the traffic step against a 1-replica pool that may
+    # grow to 2; the autoscaler runs on its own cadence thread. The
+    # forward is a dim-2048 mlp (~4ms/row in numpy — one replica tops
+    # out around 200-250 rps), so the 280 rps step is a GENUINE
+    # overload only the scale-up can absorb.
+    step_dim, step_rate = 2048, 280
+    step_leaves = smodel.init_leaves("mlp", step_dim, seed=2)
+    step_by_crc = {smodel.fingerprint(step_leaves): step_leaves}
+    ckpt2 = os.path.join(tmpdir, "step")
+    publish_leaves(ckpt2, 10, step_leaves)
+    sup, t, box = pool(1, 2, _serve_port_block(2), "mlp", step_dim,
+                       ckpt2, scale_up_queue=2.0,
+                       autoscale_interval=0.2)
+    stop = threading.Event()
+
+    def autoscale_loop():
+        while not stop.wait(0.2):
+            try:
+                sup.autoscale_once()
+            except Exception:
+                pass
+
+    scaler = threading.Thread(target=autoscale_loop, daemon=True)
+    scaler.start()
+    try:
+        replicas_before = len(sup.driver.live_workers())
+        res, wall = run_load(sup.endpoints, rate=step_rate,
+                             duration=4.0, dim=step_dim, seed=13,
+                             model_name="mlp",
+                             leaves_by_crc=step_by_crc, workers=8,
+                             total_deadline=30.0, rid_base=900000)
+        row = res.summary(wall)
+        replicas_after = len(sup.driver.live_workers())
+        events = list(sup.scale_events)
+    finally:
+        stop.set()
+        rc2 = shutdown(sup, t, box)
+    autoscale_row = {
+        "offered_rps": step_rate,
+        "model": "mlp", "dim": step_dim,
+        "replicas_before": replicas_before,
+        "replicas_after": replicas_after,
+        "scale_events": len(events),
+        "achieved_rps": row["rps_achieved"],
+        "ok": row["ok"], "errors": row["errors"],
+        "p99_ms": row["p99_ms"],
+    }
+    print("bench: serve autoscale step %d rps: %d -> %d replicas "
+          "(%d event(s)), %d ok, %d err"
+          % (step_rate, replicas_before, replicas_after, len(events),
+             row["ok"], row["errors"]), file=sys.stderr)
+    import shutil
+    shutil.rmtree(tmpdir, ignore_errors=True)
+
+    scaled = replicas_after > replicas_before and len(events) >= 1
+    emit({
+        "metric": "serve_open_loop_p99_ms",
+        "value": curve[-1]["p99_ms"],
+        "unit": "ms_p99_at_%drps_2_replicas" % rates[-1],
+        "dim": dim,
+        "curve": curve,
+        "autoscale": autoscale_row,
+        "autoscaled_on_traffic_step": bool(scaled),
+        "drained_clean": bool(curve_ok and rc2 == EXIT_DRAINED),
+        "vs_baseline": None,
+        "baseline": "no prior serving round (BENCH_r12 introduces the "
+                    "plane); acceptance: zero errors/mismatches on the "
+                    "curve, autoscale 1->2 during the traffic step",
+    })
+    return 0 if (curve_ok and scaled and rc2 == EXIT_DRAINED) else 1
+
+
 def _run_compression_bench(n, iters, mb, mode, timeout=900):
     """Launches n local workers allreducing an `mb`-MB f32 payload under
     compression `mode` (control-plane + numpy only, no jax); returns
@@ -2085,6 +2283,11 @@ def main():
                          "the durable checkpoint writer off vs on "
                          "(docs/ELASTIC.md 'Durability'); CPU-only, "
                          "prints one JSON line")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving-plane bench (docs/SERVE.md): open-"
+                         "loop RPS/latency curve on a 2-replica pool "
+                         "plus the autoscale-on-traffic-step row; "
+                         "CPU-only, prints one JSON line (BENCH_r12)")
     ap.add_argument("--scaling", action="store_true",
                     help="regenerate the SCALING.md evidence (weak "
                          "scaling on the virtual CPU mesh + negotiation "
@@ -2128,6 +2331,8 @@ def main():
         return autotune_main(args)
     if args.durable_commit:
         return durable_commit_main(args)
+    if args.serve:
+        return serve_main(args)
     if args.scaling:
         return scaling_main(args)
     if args.all_models:

@@ -17,7 +17,7 @@ import subprocess
 import time
 
 
-def plan_spawns(available, live_per_host, room):
+def plan_spawns(available, live_per_host, room, placement="pack"):
     """Hosts to spawn new workers on, one list entry per worker — the
     pure placement rule shared by the single-job elastic driver's
     growth path and the fleet controller's pool
@@ -25,11 +25,38 @@ def plan_spawns(available, live_per_host, room):
 
     ``available``: {host: slots} — the spawnable inventory (already
     blacklist-filtered). ``live_per_host``: {host: live worker count}.
-    ``room``: how many more workers may be added. Hosts are walked in
-    sorted order so the plan is deterministic across supervisors."""
+    ``room``: how many more workers may be added.
+
+    ``placement`` picks the shape (docs/FLEET.md "Placement"):
+
+    * ``"pack"`` (default, the historical rule) fills hosts densely in
+      sorted order — training gangs want locality (intra-host data
+      plane, shared-memory composites).
+    * ``"spread"`` places each worker on the least-occupied host with a
+      free slot (ties by name) — serve replicas want failure-domain
+      diversity: one host dying must not take the whole pool's
+      capacity with it.
+
+    Either way hosts are walked deterministically, so the plan agrees
+    across supervisors."""
     if room <= 0:
         return []
+    if placement not in ("pack", "spread"):
+        raise ValueError("unknown placement %r (pack|spread)"
+                         % (placement,))
     plan = []
+    if placement == "spread":
+        occupancy = dict(live_per_host)
+        while len(plan) < room:
+            candidates = [(occupancy.get(h, 0), h)
+                          for h, slots in sorted(available.items())
+                          if occupancy.get(h, 0) < slots]
+            if not candidates:
+                break
+            _, host = min(candidates)
+            plan.append(host)
+            occupancy[host] = occupancy.get(host, 0) + 1
+        return plan
     for host, slots in sorted(available.items()):
         free = slots - live_per_host.get(host, 0)
         for _ in range(max(0, free)):

@@ -57,11 +57,12 @@ class ElasticDriver:
                  np_initial=None, ssh_port=None, start_timeout=60,
                  verbose=False, env=None, ckpt_dir=None,
                  restart_from_ckpt=False, drain_grace=None,
-                 health_sink=None):
+                 health_sink=None, placement="pack"):
         if min_np < 1 or max_np < min_np:
             raise ValueError("need 1 <= min_np <= max_np (got %d..%d)"
                              % (min_np, max_np))
         self._command = list(command)
+        self._placement = placement
         self._min_np = min_np
         self._max_np = max_np
         self._np_initial = np_initial
@@ -306,7 +307,8 @@ class ElasticDriver:
             w.hostname for w in self._workers.values())
         return plan_spawns(self._hosts.available_hosts_and_slots(),
                            live_per_host,
-                           self._max_np - len(self._workers))
+                           self._max_np - len(self._workers),
+                           placement=self._placement)
 
     def _kill_all(self):
         for w in self._workers.values():

@@ -66,17 +66,37 @@ class JobSpec:
     shlex-split); `np` the desired world size, `min_np` the gang
     floor; bigger `priority` wins. `ckpt_dir` enables durable commits +
     preemption restore (the controller requires it — a preemptable job
-    without a durable lineage would restart from step 0)."""
+    without a durable lineage would restart from step 0).
+
+    `kind` is ``"train"`` (default) or ``"serve"`` — a serve job's
+    workers are hvd-serve replicas (docs/SERVE.md): no rendezvous (so
+    `start_timeout` defaults SHORT — the driver's growth gate only
+    unsticks by stalling), and `placement` defaults to ``"spread"``
+    (failure-domain diversity) where training defaults to ``"pack"``
+    (locality). Both defaults are per-kind only; either field can be
+    set explicitly."""
 
     def __init__(self, name, command, np, min_np=1, max_np=None,
                  priority=0, arrival=0.0, ckpt_dir=None, env=None,
-                 max_restarts=2, start_timeout=60):
+                 max_restarts=2, start_timeout=None, kind="train",
+                 placement=None):
         if isinstance(command, str):
             command = shlex.split(command)
         if min_np < 1 or np < min_np:
             raise ValueError(
                 "job %r needs 1 <= min_np <= np (got %d..%d)"
                 % (name, min_np, np))
+        if kind not in ("train", "serve"):
+            raise ValueError("job %r: unknown kind %r (train|serve)"
+                             % (name, kind))
+        if placement is None:
+            placement = "spread" if kind == "serve" else "pack"
+        if placement not in ("pack", "spread"):
+            raise ValueError(
+                "job %r: unknown placement %r (pack|spread)"
+                % (name, placement))
+        if start_timeout is None:
+            start_timeout = 2 if kind == "serve" else 60
         self.name = str(name)
         self.command = list(command)
         self.np = int(np)
@@ -88,12 +108,14 @@ class JobSpec:
         self.env = dict(env or {})
         self.max_restarts = int(max_restarts)
         self.start_timeout = start_timeout
+        self.kind = kind
+        self.placement = placement
 
     @classmethod
     def from_dict(cls, d):
         known = ("name", "command", "np", "min_np", "max_np", "priority",
                  "arrival", "ckpt_dir", "env", "max_restarts",
-                 "start_timeout")
+                 "start_timeout", "kind", "placement")
         unknown = set(d) - set(known)
         if unknown:
             raise ValueError("unknown job field(s): %s" % sorted(unknown))
@@ -205,6 +227,7 @@ class FleetController:
                       if job.spec.ckpt_dir else None),
             restart_from_ckpt=bool(job.spec.ckpt_dir),
             drain_grace=self.drain_grace,
+            placement=job.spec.placement,
             # One tenant's crashing host is everyone's problem: mirror
             # the job-local failure/health evidence into the pool so
             # the fleet-wide blacklist (fleet_hosts_blacklisted) is
@@ -226,7 +249,8 @@ class FleetController:
     def _try_admit(self, job, now):
         """Gang admission (or restore): lease >= min_np or nothing."""
         granted = self.pool.lease(job.name, job.spec.np,
-                                  min_slots=job.spec.min_np)
+                                  min_slots=job.spec.min_np,
+                                  placement=job.spec.placement)
         if not granted:
             self.metrics.inc("fleet_admission_retries_total")
             job.next_try = now + job.backoff
@@ -432,7 +456,8 @@ class FleetController:
             if room <= 0 or free <= 0:
                 continue
             extra = self.pool.lease(job.name, min(room, free),
-                                    min_slots=1)
+                                    min_slots=1,
+                                    placement=job.spec.placement)
             if extra:
                 grown = sum(extra.values())
                 job.driver.resize(leased + grown)
@@ -547,6 +572,8 @@ class FleetController:
                     last_durable = None
             jobs[name] = {
                 "state": job.state,
+                "kind": job.spec.kind,
+                "placement": job.spec.placement,
                 "priority": job.spec.priority,
                 "np": job.spec.np,
                 "min_np": job.spec.min_np,

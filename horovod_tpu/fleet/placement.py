@@ -87,22 +87,45 @@ class PlacementPool:
     def free_slots(self):
         return sum(self.free_by_host().values())
 
-    def lease(self, job, want_slots, min_slots=None):
+    def lease(self, job, want_slots, min_slots=None, placement="pack"):
         """Gang grant: lease up to `want_slots` (but at least
         `min_slots`, default = want) across hosts; returns {host:
         slots} or {} when the minimum cannot be met — nothing is leased
-        on failure, so a job never holds a useless partial gang."""
+        on failure, so a job never holds a useless partial gang.
+
+        `placement` shapes the grant the same way
+        :func:`plan_spawns` shapes a spawn plan: ``"pack"`` fills
+        hosts densely in sorted order (training locality); ``"spread"``
+        takes one slot per host round-robin (serve-replica
+        failure-domain diversity)."""
         if min_slots is None:
             min_slots = want_slots
+        if placement not in ("pack", "spread"):
+            raise ValueError("unknown placement %r (pack|spread)"
+                             % (placement,))
         grant = {}
         got = 0
-        for host, free in sorted(self.free_by_host().items()):
-            if got >= want_slots:
-                break
-            take = min(free, want_slots - got)
-            if take > 0:
-                grant[host] = take
-                got += take
+        if placement == "spread":
+            free = sorted(self.free_by_host().items())
+            while got < want_slots:
+                progressed = False
+                for host, cap in free:
+                    if got >= want_slots:
+                        break
+                    if grant.get(host, 0) < cap:
+                        grant[host] = grant.get(host, 0) + 1
+                        got += 1
+                        progressed = True
+                if not progressed:
+                    break
+        else:
+            for host, free in sorted(self.free_by_host().items()):
+                if got >= want_slots:
+                    break
+                take = min(free, want_slots - got)
+                if take > 0:
+                    grant[host] = take
+                    got += take
         if got < max(1, min_slots):
             return {}
         with self._lock:

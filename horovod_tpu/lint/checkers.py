@@ -528,3 +528,97 @@ def _attrs_mismatch(sites):
     averages = {repr(_average_literal(s)) for s in sites
                 if _average_literal(s) is not None}
     return len(averages) > 1
+
+
+def _handler_classes(tree):
+    """ClassDefs deriving (lexically) from an http.server request
+    handler — the repo's serving front-door idiom (serve/server.py,
+    _metrics.py). Nested classes count: the handler-factory pattern
+    (`def _make_handler(ctx): class Handler(BaseHTTPRequestHandler)`)
+    is the idiomatic way to close over replica state."""
+    import ast
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for base in node.bases:
+            dotted = walker._dotted(base)
+            if dotted and dotted.split(".")[-1].endswith(
+                    "HTTPRequestHandler"):
+                yield node
+                break
+
+
+def _reach_collective(model, start, methods, module_funcs, limit=40):
+    """Bounded intra-module reachability: DFS from `start` through
+    plain-name calls (module functions) and self.method calls (same
+    class), returning (collective call node, collective name, chain of
+    function names) for the first collective found, else None."""
+    import ast
+
+    seen = set()
+    stack = [(start, (start.name,))]
+    visited = 0
+    while stack and visited < limit:
+        func_node, chain = stack.pop()
+        if id(func_node) in seen:
+            continue
+        seen.add(id(func_node))
+        visited += 1
+        for node in ast.walk(func_node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = walker.collective_call_name(model, node)
+            if name is not None:
+                return node, name, chain
+            callee = None
+            if isinstance(node.func, ast.Name):
+                callee = module_funcs.get(node.func.id)
+            elif (isinstance(node.func, ast.Attribute)
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id == "self"):
+                callee = methods.get(node.func.attr)
+            if callee is not None and id(callee) not in seen:
+                stack.append((callee, chain + (callee.name,)))
+    return None
+
+
+@register("collective-in-serve-handler", ERROR,
+          "collective reachable from an HTTP request handler")
+def check_collective_in_serve_handler(model):
+    """A serve replica is a SINGLE process outside any rendezvous
+    generation: a collective submitted from a request handler thread
+    waits forever for peers that will never negotiate — the handler
+    thread hangs holding its request, the client times out, and every
+    retry stacks another hung thread (runtime: negotiation stall, but
+    only visible on the SERVING plane where no stall inspector runs).
+    Handlers must stay collective-free: inference state arrives via the
+    weight-swap watcher, never via broadcast (docs/SERVE.md)."""
+    import ast
+
+    module_funcs = {
+        n.name: n for n in model.tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for cls in _handler_classes(model.tree):
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        for name, meth in sorted(methods.items()):
+            if not (name.startswith("do_")
+                    or name in ("handle", "handle_one_request")):
+                continue
+            hit = _reach_collective(model, meth, methods, module_funcs)
+            if hit is None:
+                continue
+            node, coll, chain = hit
+            via = (" (via %s)" % " -> ".join(chain)
+                   if len(chain) > 1 else "")
+            yield make_finding(
+                model, node, "collective-in-serve-handler",
+                "collective `%s` is reachable from request handler "
+                "`%s.%s`%s; a serve replica has no peers in a "
+                "rendezvous generation, so the call never completes — "
+                "the handler thread hangs with the request and every "
+                "client retry stacks another. Move collective work off "
+                "the serving plane (weights arrive via the swap "
+                "watcher)" % (coll, cls.name, name, via))

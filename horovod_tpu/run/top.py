@@ -241,6 +241,11 @@ def fetch_fleet(endpoint, timeout=5):
 # renders '-' instead of shifting the row (docs/FLEET.md).
 _FLEET_COLUMNS = [
     ("state", 10, lambda j: str(j.get("state", "-"))),
+    # Job kind (docs/SERVE.md): train | serve; '-' = the controller
+    # predates the serving plane (mixed-version fleets).
+    ("kind", 6, lambda j: str(j.get("kind", "-"))),
+    # Placement shape (docs/FLEET.md "Placement"): pack | spread.
+    ("place", 7, lambda j: str(j.get("placement", "-"))),
     ("prio", 5, lambda j: "%d" % j.get("priority", 0)),
     ("live", 5, lambda j: "%d" % j.get("live", 0)),
     ("want", 5, lambda j: "%d" % j.get("np", 0)),
@@ -302,6 +307,100 @@ def render_fleet(fleet, endpoint):
     return "\n".join(lines)
 
 
+def fetch_serve(endpoint, timeout=5):
+    url = endpoint
+    if not url.startswith("http"):
+        url = "http://" + url
+    if not url.rstrip("/").endswith("/serve"):
+        url = url.rstrip("/") + "/serve"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _serve_num(field, fmt="%d"):
+    """Serve-column renderer under the same mixed-version tolerance
+    rule as _COLUMNS/_FLEET_COLUMNS: a replica (or pool) document that
+    predates the field renders '-' in that one cell."""
+    def render(v):
+        if v.get(field) is None:
+            return "-"
+        return fmt % v[field]
+    return render
+
+
+# Per-replica serve columns (docs/SERVE.md; the pool's /serve document
+# carries one row per replica under "per_replica").
+_SERVE_COLUMNS = [
+    ("state", 9, lambda v: str(v.get("state", "-"))),
+    ("step", 7, _serve_num("model_step")),
+    ("weights", 9, lambda v: str(v.get("weights_crc") or "-")),
+    ("queue", 6, _serve_num("queue_depth")),
+    ("infl", 5, _serve_num("inflight")),
+    ("req", 8, _serve_num("requests_total")),
+    ("resp", 8, _serve_num("responses_total")),
+    ("batch", 7, _serve_num("batches_total")),
+    ("rej", 5, _serve_num("rejects_total")),
+    ("err", 5, _serve_num("errors_total")),
+    # Frame-integrity failures caught by the per-row CRC gate.
+    ("corr", 5, _serve_num("frame_corrupt_total")),
+    # Rolling weight swaps: landed / rejected (torn or CRC-invalid
+    # lineage) / abandoned-to-drain.
+    ("swp", 4, _serve_num("swaps_total")),
+    ("swrej", 6, _serve_num("swap_rejects_total")),
+    ("swabt", 6, _serve_num("swap_aborts_total")),
+    ("p50ms", 8, _serve_num("p50_ms", "%.1f")),
+    ("p99ms", 8, _serve_num("p99_ms", "%.1f")),
+]
+
+
+def render_serve(doc, endpoint):
+    """One frame of the serving view: pool header + per-replica table
+    (docs/SERVE.md). Works against a supervisor's aggregated /serve
+    (per_replica rows) or a single replica's /serve (one row)."""
+    replicas = doc.get("per_replica")
+    if replicas is None:
+        replicas = [doc] if doc.get("replica") is not None else []
+    lines = ["hvd-serve — %s — %s replica(s) (%s reporting, %s "
+             "draining), %s scale event(s) — %s"
+             % (endpoint,
+                doc.get("replicas", len(replicas)),
+                doc.get("replicas_reporting", len(replicas)),
+                doc.get("draining", "-"),
+                doc.get("scale_events", "-"),
+                time.strftime("%H:%M:%S"))]
+    header = "%4s " % "rep" + " ".join(
+        "%*s" % (w, name) for name, w, _ in _SERVE_COLUMNS)
+    lines.append(header)
+    lines.append("-" * len(header))
+    for v in sorted(replicas, key=lambda v: v.get("replica", 0)):
+        rep = v.get("replica")
+        lines.append("%4s " % ("-" if rep is None else rep) + " ".join(
+            "%*s" % (w, fn(v)) for _, w, fn in _SERVE_COLUMNS))
+    totals = []
+    for label, field in (("req", "requests_total"),
+                         ("resp", "responses_total"),
+                         ("rej", "rejects_total"),
+                         ("err", "errors_total"),
+                         ("swaps", "swaps_total")):
+        if doc.get(field) is not None:
+            totals.append("%s %s" % (doc[field], label))
+    if doc.get("p99_ms") is not None:
+        totals.append("p99 %.1fms" % doc["p99_ms"])
+    if totals:
+        lines.append("pool: " + ", ".join(totals))
+    steps = doc.get("model_steps") or []
+    if len(steps) > 1:
+        lines.append("! mixed weights: replicas serve steps %s (a "
+                     "rolling swap is in flight)"
+                     % ", ".join(str(s) for s in steps))
+    if doc.get("frame_corrupt_total"):
+        lines.append("! %d corrupt batch frame(s) caught by the row-CRC "
+                     "gate (requests failed with cause "
+                     "'frame-corrupt', never silently wrong)"
+                     % doc["frame_corrupt_total"])
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="hvd-top",
@@ -318,6 +417,10 @@ def main(argv=None):
                     help="cross-job fleet view: poll a fleet "
                          "controller's /fleet endpoint instead of a "
                          "job's /job endpoint (docs/FLEET.md)")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving-pool view: poll an hvd-serve "
+                         "supervisor's (or single replica's) /serve "
+                         "endpoint (docs/SERVE.md)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="poll interval seconds (default 2)")
     ap.add_argument("--once", action="store_true",
@@ -327,6 +430,8 @@ def main(argv=None):
 
     if args.fleet:
         return _fleet_loop(args)
+    if args.serve:
+        return _serve_loop(args)
 
     prev_job, prev_t = None, None
     try:
@@ -360,6 +465,30 @@ def main(argv=None):
             sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
             sys.stdout.flush()
             prev_job, prev_t = job, now
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _serve_loop(args):
+    try:
+        while True:
+            try:
+                doc = fetch_serve(args.endpoint)
+            except Exception as e:
+                msg = "hvd-top: cannot reach serve pool at %s: %s" % (
+                    args.endpoint, e)
+                print(msg, file=sys.stderr)
+                if args.once:
+                    return 1
+                time.sleep(args.interval)
+                continue
+            frame = render_serve(doc, args.endpoint)
+            if args.once:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
             time.sleep(args.interval)
     except KeyboardInterrupt:
         return 0

@@ -1,0 +1,43 @@
+"""hvd-serve: the inference serving plane on the trained chip pool
+(docs/SERVE.md; ROADMAP "new traffic shapes" item 3).
+
+Everything this package moves is a REQUEST, not a gradient — but every
+structural part is a training part reused:
+
+* a **replica** (``replica.py``) is one worker process spawned by the
+  elastic driver (standalone via ``bin/hvd-serve``, or co-tenant under
+  the fleet controller as a ``JobSpec`` with ``kind: "serve"``). It
+  loads weights from a durable checkpoint lineage
+  (``elastic/durable.py``), runs a jitted forward pass, and fronts it
+  with a stdlib-only HTTP/JSON server (the same ThreadingHTTPServer
+  pattern as ``_metrics.py``). Replicas are INDEPENDENT — no collective
+  ever runs on the request path (``hvd-lint`` rule
+  ``collective-in-serve-handler`` makes that an ERROR);
+* **continuous micro-batching** (``batcher.py``): a bounded admission
+  queue feeds a size/deadline-bounded batcher that pads each batch up
+  to a power-of-two bucket (bounded XLA recompiles), then splits the
+  outputs back to their requests;
+* **rolling weight swap** (``swap.py``): a background watcher on the
+  checkpoint lineage loads a newer VALID manifest into a shadow buffer
+  and flips the serving weights between batches — never mid-batch, so
+  a swap drops zero requests; torn/CRC-invalid manifests are rejected
+  (``serve_swap_rejects_total``) and the replica keeps serving the
+  current weights;
+* **drain-native**: replicas poll the driver's drain record
+  (``elastic/run.py::drain_requested``), stop admitting (clients are
+  told the cause and re-queue to a surviving replica via
+  ``client.py``), finish the queue, and exit ``EXIT_DRAINED`` — the
+  same protocol training preemption uses, so fleet co-tenancy
+  composes unchanged.
+
+The metrics registry (``metrics.py``) mirrors the fleet plane's;
+``hvd-top --serve`` renders the supervisor's aggregated ``/serve``
+view.
+"""
+
+from .batcher import MicroBatcher, QueueFull, Ticket  # noqa: F401
+from .client import ServeClient, ServeError  # noqa: F401
+from .metrics import ServeMetrics, histogram_quantile  # noqa: F401
+from .model import (fingerprint, forward, init_leaves,  # noqa: F401
+                    make_forward)
+from .swap import SwapWatcher  # noqa: F401

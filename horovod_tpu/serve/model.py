@@ -1,0 +1,161 @@
+"""The serve plane's model registry (docs/SERVE.md).
+
+A serve model is two pure functions over a flat dict of numpy leaves —
+exactly what :func:`horovod_tpu.elastic.durable.load_leaves` hands back
+from a checkpoint lineage:
+
+* ``init_leaves(dim, seed)`` — deterministic initial weights (what a
+  replica serves before the first lineage checkpoint lands);
+* ``forward(leaves, x)`` — the batched forward pass, [B, D] -> [B, D].
+
+:func:`make_forward` wraps the forward in ``jax.jit`` when jax is
+importable (pad-to-bucket batch shapes keep the compile count bounded
+— one compile per bucket, see batcher.py) and falls back to the
+bit-identical numpy math otherwise (``HVD_TPU_SERVE_JIT=0`` forces the
+fallback; the sanitizer churn runs use it so the preloaded interpreter
+never pulls jax in).
+
+Every response carries :func:`fingerprint` of the serving leaves — the
+CRC32C chain over sorted leaf names and bytes. The rolling-swap e2e
+asserts post-swap responses carry the NEW lineage's fingerprint, which
+is how "provably computed from the new weights" is checked without
+trusting a step counter someone could forget to bump.
+"""
+
+import os
+
+import numpy as np
+
+from horovod_tpu.elastic import durable
+
+# Registered model -> (init_leaves, forward). The serving data path is
+# model-agnostic: anything mapping a leaves dict + [B, D] batch to
+# [B, D] outputs slots in here.
+_REGISTRY = {}
+
+
+def register_model(name, init_fn, forward_fn):
+    _REGISTRY[name] = (init_fn, forward_fn)
+
+
+def _affine_init(dim, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": rng.standard_normal((dim, dim)).astype(np.float32),
+        "b": rng.standard_normal((dim,)).astype(np.float32),
+    }
+
+
+def _affine_forward(leaves, x):
+    return x @ leaves["w"] + leaves["b"]
+
+
+def _mlp_init(dim, seed=0):
+    rng = np.random.RandomState(seed)
+    hidden = 4 * dim
+    return {
+        "w0": (rng.standard_normal((dim, hidden)) /
+               np.sqrt(dim)).astype(np.float32),
+        "b0": np.zeros((hidden,), np.float32),
+        "w1": (rng.standard_normal((hidden, dim)) /
+               np.sqrt(hidden)).astype(np.float32),
+        "b1": np.zeros((dim,), np.float32),
+    }
+
+
+def _mlp_forward(leaves, x):
+    h = x @ leaves["w0"] + leaves["b0"]
+    h = np.maximum(h, 0.0) if isinstance(h, np.ndarray) else _relu(h)
+    return h @ leaves["w1"] + leaves["b1"]
+
+
+def _relu(h):
+    import jax.numpy as jnp
+    return jnp.maximum(h, 0.0)
+
+
+register_model("affine", _affine_init, _affine_forward)
+register_model("mlp", _mlp_init, _mlp_forward)
+
+
+def init_leaves(name, dim, seed=0):
+    if name not in _REGISTRY:
+        raise ValueError("unknown serve model %r (have: %s)"
+                         % (name, sorted(_REGISTRY)))
+    return _REGISTRY[name][0](dim, seed)
+
+
+def forward(name, leaves, x):
+    """The un-jitted (numpy) forward — the parity reference the e2e
+    tests recompute answers with."""
+    if name not in _REGISTRY:
+        raise ValueError("unknown serve model %r (have: %s)"
+                         % (name, sorted(_REGISTRY)))
+    return np.asarray(_REGISTRY[name][1](leaves, np.asarray(x)))
+
+
+def fingerprint(leaves):
+    """CRC32C chain over sorted leaf names + bytes, hex8 — the identity
+    of a weight set on the response wire."""
+    crc = 0
+    for key in sorted(leaves):
+        crc = durable.crc32c(key.encode("utf-8"), crc)
+        crc = durable.crc32c(
+            np.ascontiguousarray(leaves[key]).tobytes(), crc)
+    return "%08x" % crc
+
+
+def extract_leaves(raw, template):
+    """Maps a raw lineage leaf dict (``load_leaves`` output, flattened
+    paths like ``.w`` / ``.opt.0.mu``) onto a model's leaf names by
+    basename match — so a TRAINING job's durable lineage serves
+    directly, optimizer slots and step counters ignored. Returns the
+    {name: float32 array} dict or None when any model leaf is missing
+    or shape-mismatched (the replica then falls back to its current
+    weights)."""
+    out = {}
+    for want, ref in template.items():
+        cands = sorted(
+            (k for k in raw if k == want or str(k).endswith("." + want)),
+            key=lambda k: (len(str(k)), str(k)))
+        picked = None
+        for k in cands:
+            arr = np.asarray(raw[k])
+            if arr.shape == ref.shape:
+                picked = arr.astype(np.float32)
+                break
+        if picked is None:
+            return None
+        out[want] = picked
+    return out
+
+
+def make_forward(name, leaves):
+    """Callable batch -> outputs over a FIXED leaves dict. Jitted when
+    jax is available (weights are closed over as constants — a weight
+    swap builds a fresh jitted callable for the shadow leaves, so the
+    flip is one reference swap and in-flight batches finish on the old
+    closure); numpy fallback otherwise."""
+    if name not in _REGISTRY:
+        raise ValueError("unknown serve model %r (have: %s)"
+                         % (name, sorted(_REGISTRY)))
+    fwd = _REGISTRY[name][1]
+    if os.environ.get("HVD_TPU_SERVE_JIT", "1") != "0":
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            jleaves = {k: jnp.asarray(v) for k, v in leaves.items()}
+            jitted = jax.jit(lambda x: fwd(jleaves, x))
+
+            def run(x):
+                return np.asarray(jitted(np.asarray(x)))
+
+            return run
+        except Exception:
+            pass  # no jax in this interpreter: serve the numpy math
+
+    def run(x):
+        return np.asarray(fwd(leaves, np.asarray(x)))
+
+    return run

@@ -1,0 +1,187 @@
+"""Serve-churn smoke for the sanitizer gates (`make check-tsan` /
+`check-asan` in native/Makefile; docs/SERVE.md).
+
+A 2-replica serve pool under seeded open-loop load, churned with the
+two events the serving plane must absorb without lying to a client:
+
+* a seeded SIGKILL of one replica mid-request (the elastic driver
+  respawns it; the client re-queues to the survivor), and
+* a CONCURRENT rolling weight swap (a newer durable checkpoint lands
+  while the kill is being absorbed).
+
+The invariant is the serving contract end to end: every request gets a
+correct answer — verified against the numpy forward of the weight set
+its response fingerprint names — or a prompt cause-named error; never
+a hang, never a wrong answer, never a silent drop. Exits 0 iff the
+contract held and the pool drained to EXIT_DRAINED.
+
+Usage::
+
+    python tests/serve_churn.py [--preload LIBSAN.SO] [ENV=VALUE...]
+
+``--preload`` prefixes the REPLICA command with ``env LD_PRELOAD=...``
+(plus any trailing ENV=VALUE args, e.g. TSAN_OPTIONS) — the sanitizer
+runtime must be preloaded into the replica pythons only; the
+supervisor/driver process forks and stays unpreloaded (see the
+Makefile's launch notes).
+"""
+
+import os
+import random
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import numpy as np  # noqa: E402
+
+from horovod_tpu.elastic.state import EXIT_DRAINED  # noqa: E402
+from horovod_tpu.serve import model as smodel  # noqa: E402
+from horovod_tpu.serve.loadgen import run_load  # noqa: E402
+from horovod_tpu.serve.supervisor import ServeSupervisor  # noqa: E402
+from horovod_tpu.serve.swap import publish_leaves  # noqa: E402
+
+DIM = 8
+SEED = 31
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    preload, extra_env = "", []
+    while argv:
+        arg = argv.pop(0)
+        if arg == "--preload":
+            preload = argv.pop(0)
+        elif "=" in arg:
+            extra_env.append(arg)
+        else:
+            sys.stderr.write(__doc__)
+            return 2
+
+    command = []
+    if preload:
+        command += ["env", "LD_PRELOAD=%s" % preload] + extra_env
+    command += [sys.executable, "-m", "horovod_tpu.serve.replica"]
+
+    ckpt = tempfile.mkdtemp(prefix="hvd-serve-churn-")
+    old = smodel.init_leaves("affine", DIM, seed=1)
+    new = smodel.init_leaves("affine", DIM, seed=2)
+    crc_old, crc_new = smodel.fingerprint(old), smodel.fingerprint(new)
+    publish_leaves(ckpt, 10, old)
+
+    rng = random.Random(SEED)
+    port_base = rng.randint(21000, 55000)
+    env = dict(os.environ)
+    env.update({
+        "HVD_TPU_SERVE_JIT": "0",
+        "HVD_TPU_SERVE_MODEL": "affine",
+        "HVD_TPU_SERVE_DIM": str(DIM),
+        "HVD_TPU_SERVE_PORT": str(port_base),
+        "HVD_TPU_SERVE_SWAP_INTERVAL": "0.1",
+        "HVD_TPU_SERVE_SWAP_STAGGER": "0.3",
+        "HVD_TPU_CKPT_DIR": ckpt,
+    })
+    # A SIGKILLed replica must respawn within the churn window.
+    os.environ["HVD_TPU_ELASTIC_COOLDOWN"] = "1"
+
+    sup = ServeSupervisor(command, {"localhost": 2}, min_replicas=1,
+                          max_replicas=2, np_initial=2,
+                          port_base=port_base, env=env, verbose=True)
+    rc_box = {}
+    thread = threading.Thread(
+        target=lambda: rc_box.update(
+            rc=sup.driver.run(install_signal_handlers=False)),
+        daemon=True)
+    thread.start()
+
+    def healthy():
+        return sum(1 for v in sup.replica_views(timeout=1.0)
+                   if v.get("state") == "serving")
+
+    deadline = time.monotonic() + 60
+    while healthy() < 2:
+        if time.monotonic() > deadline:
+            sys.stderr.write("serve_churn: pool never became healthy\n")
+            return 1
+        time.sleep(0.2)
+    print("serve_churn: 2 replicas serving on ports %d-%d"
+          % (port_base, port_base + 1))
+
+    by_crc = {crc_old: old, crc_new: new}
+    result_box = {}
+
+    def load():
+        result_box["r"], result_box["wall"] = run_load(
+            sup.endpoints, rate=25, duration=6.0, dim=DIM, seed=SEED,
+            leaves_by_crc=by_crc, workers=4, total_deadline=15.0)
+
+    loader = threading.Thread(target=load)
+    loader.start()
+
+    # Churn event 1 (seeded): SIGKILL one replica mid-request.
+    time.sleep(1.5)
+    victim = rng.choice(sup.driver.live_workers())
+    pid = sup.driver.worker_pid(victim)
+    print("serve_churn: SIGKILL replica %d (pid %d)" % (victim, pid))
+    os.kill(pid, signal.SIGKILL)
+
+    # Churn event 2, CONCURRENT with the kill's absorption: a newer
+    # checkpoint lands and the rolling swap flips the survivors.
+    time.sleep(0.5)
+    publish_leaves(ckpt, 20, new)
+    print("serve_churn: published step 20 (weights %s)" % crc_new)
+
+    loader.join(timeout=120)
+    if loader.is_alive():
+        sys.stderr.write("serve_churn: load generator hung\n")
+        return 1
+    res = result_box["r"]
+    total = res.ok + len(res.errors)
+    print("serve_churn: %d ok, %d errors, %d mismatches, by_crc=%s"
+          % (res.ok, len(res.errors), len(res.mismatches),
+             dict(res.by_crc)))
+    if res.mismatches:
+        sys.stderr.write("serve_churn: WRONG ANSWERS: %s\n"
+                         % res.mismatches[:5])
+        return 1
+    if total != 150:
+        sys.stderr.write("serve_churn: %d/150 requests unaccounted "
+                         "for (silent drop)\n" % (150 - total))
+        return 1
+    bad = [e for e in res.errors
+           if e[1] not in ("replica-lost", "draining", "overload",
+                           "deadline")]
+    if bad:
+        sys.stderr.write("serve_churn: unnamed failure causes: %s\n"
+                         % bad[:5])
+        return 1
+    if res.ok < 120:
+        sys.stderr.write("serve_churn: only %d/150 answered — the "
+                         "pool did not absorb the churn\n" % res.ok)
+        return 1
+    if res.by_crc.get(crc_new, 0) < 1:
+        sys.stderr.write("serve_churn: no response carried the swapped "
+                         "weights %s (by_crc=%s)\n"
+                         % (crc_new, dict(res.by_crc)))
+        return 1
+
+    sup.driver.request_drain("all")
+    thread.join(timeout=90)
+    if thread.is_alive():
+        sys.stderr.write("serve_churn: drain hung\n")
+        return 1
+    if rc_box.get("rc") != EXIT_DRAINED:
+        sys.stderr.write("serve_churn: driver rc %r (want EXIT_DRAINED "
+                         "%d)\n" % (rc_box.get("rc"), EXIT_DRAINED))
+        return 1
+    print("serve_churn: contract held through kill + concurrent swap; "
+          "pool drained clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

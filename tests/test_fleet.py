@@ -24,6 +24,7 @@ import sys
 import time
 import urllib.request
 
+import numpy as np
 import pytest
 
 from horovod_tpu.elastic.discovery import FixedHosts, HostManager
@@ -55,6 +56,62 @@ def test_plan_spawns_ignores_overfull_hosts():
     # More live workers than slots (mid-drain overlap) must not
     # produce a negative contribution.
     assert plan_spawns({"a": 1, "b": 1}, {"a": 3}, room=2) == ["b"]
+
+
+def test_plan_spawns_spread_balances_occupancy():
+    # Spread: each worker lands on the least-occupied host (ties by
+    # name) — serve replicas want failure-domain diversity.
+    assert plan_spawns({"a": 4, "b": 4}, {}, room=4,
+                       placement="spread") == ["a", "b", "a", "b"]
+    # Existing occupancy is honored: "a" already carries 2, so "b"
+    # catches up before the round-robin resumes.
+    assert plan_spawns({"a": 4, "b": 4}, {"a": 2}, room=3,
+                       placement="spread") == ["b", "b", "a"]
+    # Full hosts drop out; a full pool stops the plan short.
+    assert plan_spawns({"a": 1, "b": 2}, {"a": 1}, room=3,
+                       placement="spread") == ["b", "b"]
+
+
+def test_plan_spawns_rejects_unknown_placement():
+    with pytest.raises(ValueError):
+        plan_spawns({"a": 1}, {}, room=1, placement="sprinkle")
+
+
+def test_pool_spread_lease_round_robins_hosts():
+    pool = PlacementPool(FixedHosts({"a": 2, "b": 2}))
+    pool.refresh()
+    # Pack (default) fills "a" densely; spread alternates hosts.
+    assert pool.lease("packed", 2) == {"a": 2}
+    pool.release("packed")
+    grant = pool.lease("spread", 2, placement="spread")
+    assert grant == {"a": 1, "b": 1}
+    pool.release("spread")
+    # Want > one-per-host: the round-robin wraps for the remainder.
+    assert pool.lease("big", 3, placement="spread") == {"a": 2, "b": 1}
+    with pytest.raises(ValueError):
+        pool.lease("x", 1, placement="sprinkle")
+
+
+def test_jobspec_kind_defaults():
+    train = JobSpec("t", ["true"], np=2)
+    assert train.kind == "train"
+    assert train.placement == "pack"
+    assert train.start_timeout == 60
+    serve = JobSpec("s", ["true"], np=2, kind="serve")
+    assert serve.placement == "spread"  # failure-domain diversity
+    assert serve.start_timeout == 2  # growth gate unsticks by stalling
+    # Either default is overridable per job.
+    pinned = JobSpec("p", ["true"], np=2, kind="serve",
+                     placement="pack", start_timeout=30)
+    assert pinned.placement == "pack" and pinned.start_timeout == 30
+    with pytest.raises(ValueError):
+        JobSpec("x", ["true"], np=2, kind="batch")
+    with pytest.raises(ValueError):
+        JobSpec("x", ["true"], np=2, placement="sprinkle")
+    via_dict = JobSpec.from_dict(
+        {"name": "d", "command": ["true"], "np": 1, "kind": "serve",
+         "placement": "spread"})
+    assert via_dict.kind == "serve"
 
 
 def test_pool_gang_lease_all_or_nothing():
@@ -638,6 +695,116 @@ def test_fleet_preempt_reclaim_restore_observable(tmp_path):
     # Prometheus scrape is gone with the process, so re-derive from
     # events above plus the drain/restore latency histograms having
     # been observed — asserted through the controller's own summary).
+    assert "fleet finished: all 2 job(s) completed" in out
+
+
+# ---------------------------------------------------------------------------
+# E2E: serve/train co-tenancy (ISSUE 16 acceptance) — a serving job is
+# a first-class JobSpec: it preempts lower-priority training via the
+# same graceful drain, answers traffic from its checkpoint lineage
+# while it holds the chips, and when traffic subsides (replicas exit 0)
+# the training job restores from its durable lineage.
+
+@pytest.mark.e2e
+def test_fleet_serve_cotenancy_preempts_and_training_restores(tmp_path):
+    from horovod_tpu.serve import model as smodel
+    from horovod_tpu.serve.client import ServeClient
+    from horovod_tpu.serve.swap import publish_leaves
+    from tests.test_serve import _free_port_base
+
+    dim = 4
+    leaves = smodel.init_leaves("affine", dim, seed=11)
+    crc = smodel.fingerprint(leaves)
+    serve_ckpt = str(tmp_path / "ckpt-serve")
+    publish_leaves(serve_ckpt, 10, leaves)
+    port_base = _free_port_base(2)
+
+    jobfile = {
+        "hosts": "localhost:2",
+        "drain_grace": 30,
+        "jobs": [
+            # min_np == np == pool size: serving can only be admitted
+            # by a whole-job preemption of the training gang.
+            {"name": "train0", "command":
+                "%s %s" % (sys.executable,
+                           os.path.join(REPO_ROOT, "tests",
+                                        "fleet_worker.py")),
+             "np": 2, "min_np": 2, "priority": 0,
+             "ckpt_dir": str(tmp_path / "ckpt-train"),
+             "env": {"FLEET_TEST_JOB": "train0",
+                     "FLEET_TEST_TOTAL_STEPS": "60",
+                     "FLEET_TEST_STEP_SLEEP": "0.2"}},
+            {"name": "serve0", "kind": "serve",
+             "command": "%s -m horovod_tpu.serve.replica"
+                        % sys.executable,
+             "np": 2, "min_np": 2, "priority": 10, "arrival": 4.0,
+             "ckpt_dir": serve_ckpt,
+             "env": {"HVD_TPU_SERVE_JIT": "0",
+                     "HVD_TPU_SERVE_MODEL": "affine",
+                     "HVD_TPU_SERVE_DIM": str(dim),
+                     "HVD_TPU_SERVE_PORT": str(port_base),
+                     # "Traffic subsides": replicas retire after 6s of
+                     # serving and exit 0, completing the job.
+                     "HVD_TPU_SERVE_EXIT_AFTER": "6"}},
+        ],
+    }
+    jobfile_path = tmp_path / "jobs.json"
+    jobfile_path.write_text(json.dumps(jobfile))
+    log = str(tmp_path / "fleet.log")
+    env = _fleet_env({"HVD_TPU_ELASTIC_COOLDOWN": "2"})
+    with open(log, "w") as logf:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.fleet.cli",
+             "--port", "0", str(jobfile_path)],
+            env=env, stdout=logf, stderr=subprocess.STDOUT,
+            start_new_session=True)
+    try:
+        port = int(_wait_for(
+            lambda: (re.search(r"metrics at http://localhost:(\d+)",
+                               _read(log)) or [None, None])[1],
+            timeout=30, what="controller metrics port"))
+
+        def fleet_view():
+            with urllib.request.urlopen(
+                    "http://localhost:%d/fleet" % port,
+                    timeout=5) as resp:
+                return json.loads(resp.read().decode())
+
+        # The serving job carries its kind/placement through /fleet.
+        view = _wait_for(lambda: fleet_view(), timeout=10,
+                         what="/fleet view")
+        assert view["jobs"]["serve0"]["kind"] == "serve"
+        assert view["jobs"]["serve0"]["placement"] == "spread"
+        assert view["jobs"]["train0"]["kind"] == "train"
+
+        # While the serving job holds the chips, it ANSWERS — with the
+        # weights of its published lineage (fingerprint-checked).
+        endpoints = ["127.0.0.1:%d" % (port_base + wid)
+                     for wid in (0, 1)]
+        client = ServeClient(endpoints, total_deadline=45.0,
+                             attempt_timeout=3.0)
+        x = np.arange(dim, dtype=np.float32)
+        doc = client.infer(x, rid="cotenancy")
+        assert doc["weights_crc"] == crc, doc
+        assert doc["model_step"] == 10
+        assert np.allclose(doc["y"],
+                           smodel.forward("affine", leaves, x),
+                           atol=1e-4)
+
+        rc = proc.wait(timeout=240)
+    finally:
+        if proc.poll() is None:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            proc.wait(timeout=10)
+    out = _read(log)
+    assert rc == 0, out
+    # The serving job preempted training via the graceful drain...
+    assert "preempting job train0" in out
+    assert "job train0 preempted" in out
+    # ...and training restored from its durable lineage after traffic
+    # subsided, resuming bitwise-consistently.
+    assert "job train0 restored" in out
+    assert assert_lineage_consistent(out) >= 1
     assert "fleet finished: all 2 job(s) completed" in out
 
 

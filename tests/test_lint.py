@@ -83,6 +83,21 @@ BAD_CORPUS = {
         state = opt.init(params)
         mu = state["inner"][0].mu
     """,
+    "collective-in-serve-handler": """
+        import horovod_tpu.jax as hvd
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                y = self._pool_mean()
+                self.wfile.write(y)
+
+            def _pool_mean(self):
+                return refresh_stats(1.0)
+
+        def refresh_stats(x):
+            return hvd.allreduce(x, average=True, name="serve.stats")
+    """,
 }
 
 # --- known-good twins: the corrected version of each snippet ----------------
@@ -154,6 +169,17 @@ GOOD_CORPUS = {
         state = opt.init(params)
         full = hvd_jax.sharded_state_full(state)
         mu = full["inner"][0].mu
+    """,
+    "collective-in-serve-handler": """
+        import horovod_tpu.jax as hvd
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.wfile.write(b"ok")
+
+        def pool_mean(x):
+            return hvd.allreduce(x, name="serve.stats")
     """,
 }
 

@@ -1,0 +1,737 @@
+"""hvd-serve tests (ISSUE 16; docs/SERVE.md).
+
+Unit layer: micro-batcher policy (bucketing, deadline release, bounded
+queue, response split-back, per-row CRC integrity gate), the serve
+chaos grammar, serve metrics quantiles, model fingerprint/leaf
+extraction, the rolling-swap watcher's edge cases (torn/CRC-invalid
+newer manifest rejected with fallback; swap landing mid-drain
+abandoned), the HTTP front door's cause-named error contract, the
+retrying client, the supervisor's autoscaler, and the hvd-top --serve
+renderer's mixed-version tolerance.
+
+E2E layer (real replica subprocesses under the elastic driver): a
+rolling weight swap drops zero requests and post-swap answers are
+PROVABLY from the new weights (fingerprint-checked against recomputed
+math); a SIGKILLed replica mid-request costs the client a retry to a
+survivor, never a hang or a wrong answer; a whole-pool drain answers
+everything admitted and exits EXIT_DRAINED.
+"""
+
+import json
+import os
+import random
+import signal
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu.elastic import durable
+from horovod_tpu.elastic.state import EXIT_DRAINED
+from horovod_tpu.serve import model as smodel
+from horovod_tpu.serve.batcher import MicroBatcher, QueueFull, bucket_for
+from horovod_tpu.serve.chaos import ServeChaos
+from horovod_tpu.serve.client import ServeClient, ServeError
+from horovod_tpu.serve.loadgen import check_response, request_input, run_load
+from horovod_tpu.serve.metrics import ServeMetrics, histogram_quantile
+from horovod_tpu.serve.server import ReplicaContext, start_front_door
+from horovod_tpu.serve.supervisor import ServeSupervisor
+from horovod_tpu.serve.swap import SwapWatcher, publish_leaves
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DIM = 4
+
+
+def _leaves(seed):
+    return smodel.init_leaves("affine", DIM, seed=seed)
+
+
+def _run_batches(batcher, forward, stamp=None, stop=None):
+    """Drives the batch loop on a thread until `stop` is set."""
+    def loop():
+        while not stop.is_set():
+            tickets = batcher.next_batch(timeout=0.02)
+            if tickets:
+                batcher.run_batch(forward, tickets, stamp=stamp)
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Micro-batcher
+
+def test_bucket_for_powers_of_two_capped():
+    assert bucket_for(1, 16) == 1
+    assert bucket_for(3, 16) == 4
+    assert bucket_for(9, 16) == 16
+    assert bucket_for(9, 8) == 8
+    assert bucket_for(100, 64) == 64
+
+
+def test_batcher_batches_and_splits_responses():
+    m = ServeMetrics()
+    b = MicroBatcher(max_batch=8, max_delay=0.01, metrics=m)
+    leaves = _leaves(0)
+    fwd = smodel.make_forward("affine", leaves)
+    tickets = [b.submit(str(i), np.full(DIM, i, np.float32))
+               for i in range(5)]
+    batch = b.next_batch(timeout=1.0)
+    assert len(batch) == 5
+    b.run_batch(fwd, batch, stamp=(3, "abcd1234"))
+    for i, t in enumerate(tickets):
+        assert t.event.is_set()
+        assert t.error is None
+        expect = smodel.forward("affine", leaves,
+                                np.full(DIM, i, np.float32))
+        assert np.allclose(t.response, expect, atol=1e-5)
+        assert t.model_step == 3 and t.weights_crc == "abcd1234"
+    snap = m.snapshot()
+    assert snap["counters"]["serve_batches_total"] == 1
+    assert snap["counters"]["serve_responses_total"] == 5
+
+
+def test_batcher_releases_on_deadline_without_filling():
+    b = MicroBatcher(max_batch=64, max_delay=0.02)
+    b.submit("1", np.zeros(DIM, np.float32))
+    t0 = time.monotonic()
+    batch = b.next_batch(timeout=1.0)
+    took = time.monotonic() - t0
+    assert len(batch) == 1
+    assert took < 0.5  # released by max_delay, not the 1s timeout
+
+
+def test_batcher_bounded_queue_rejects_promptly():
+    m = ServeMetrics()
+    b = MicroBatcher(max_batch=4, queue_max=3, metrics=m)
+    for i in range(3):
+        b.submit(str(i), np.zeros(DIM, np.float32))
+    with pytest.raises(QueueFull):
+        b.submit("overflow", np.zeros(DIM, np.float32))
+    assert m.snapshot()["counters"]["serve_rejects_total"] == 1
+
+
+def test_batcher_close_drains_and_refuses_admission():
+    b = MicroBatcher(max_batch=4)
+    t = b.submit("1", np.zeros(DIM, np.float32))
+    b.close()
+    with pytest.raises(QueueFull):
+        b.submit("2", np.zeros(DIM, np.float32))
+    # The queued ticket is still served by the remaining iterations.
+    batch = b.next_batch(timeout=0.5)
+    assert batch == [t]
+    assert b.next_batch(timeout=0.05) == []
+
+
+def test_batcher_shape_mismatch_fails_only_that_request():
+    b = MicroBatcher(max_batch=4)
+    good = b.submit("g", np.zeros(DIM, np.float32))
+    bad = b.submit("b", np.zeros(DIM + 1, np.float32))
+    batch = b.next_batch(timeout=0.5)
+    b.run_batch(smodel.make_forward("affine", _leaves(0)), batch)
+    assert good.error is None and good.response is not None
+    assert bad.cause == "shape" and bad.event.is_set()
+
+
+def test_corrupt_frame_fails_request_with_named_cause():
+    m = ServeMetrics()
+    chaos = ServeChaos(seed=7, corrupt_batches=(1,))
+    b = MicroBatcher(max_batch=8, metrics=m, chaos=chaos)
+    leaves = _leaves(0)
+    fwd = smodel.make_forward("affine", leaves)
+    tickets = [b.submit(str(i), np.full(DIM, i, np.float32))
+               for i in range(4)]
+    b.run_batch(fwd, b.next_batch(timeout=0.5))
+    corrupted = [t for t in tickets if t.cause == "frame-corrupt"]
+    answered = [t for t in tickets if t.error is None]
+    assert len(corrupted) == 1  # chaos flips ONE byte in ONE row
+    assert len(answered) == 3
+    assert "not computed" in corrupted[0].error
+    snap = m.snapshot()
+    assert snap["counters"]["serve_frame_corrupt_total"] == 1
+    # Batch 2 is untouched (spec said corrupt_batch=1 only).
+    t2 = [b.submit("x%d" % i, np.full(DIM, i, np.float32))
+          for i in range(2)]
+    b.run_batch(fwd, b.next_batch(timeout=0.5))
+    assert all(t.error is None for t in t2)
+
+
+# ---------------------------------------------------------------------------
+# Chaos grammar
+
+def test_serve_chaos_parse_grammar():
+    c = ServeChaos.parse("seed=9;corrupt_batch=2,5;kill_after=1.5")
+    assert c.seed == 9
+    assert set(c.corrupt_batches) == {2, 5}
+    assert c.kill_after == 1.5
+    assert ServeChaos.from_env({"HVD_TPU_SERVE_CHAOS_SPEC": ""}) is None
+    got = ServeChaos.from_env(
+        {"HVD_TPU_SERVE_CHAOS_SPEC": "seed=3;corrupt_batch=1"})
+    assert got.seed == 3
+    with pytest.raises(ValueError):
+        ServeChaos.parse("seed=1;explode=now")
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+
+def test_histogram_quantiles_and_latency():
+    m = ServeMetrics()
+    for v in [0.002] * 50 + [0.004] * 45 + [0.5] * 5:
+        m.observe("serve_request_seconds", v)
+    p50, p99 = m.latency_quantiles()
+    assert p50 is not None and p50 <= 0.005
+    assert p99 >= 0.25
+    snap = m.snapshot()["histograms"]["serve_request_seconds"]
+    assert snap["count"] == 100
+    assert histogram_quantile(snap, 0.0) <= histogram_quantile(snap, 1.0)
+
+
+def test_metrics_render_prometheus_serve_families():
+    from horovod_tpu.serve.metrics import render_prometheus
+    m = ServeMetrics()
+    m.inc("serve_requests_total", 3)
+    m.observe("serve_request_seconds", 0.01)
+    text = render_prometheus(m)
+    assert "hvdtpu_serve_requests_total 3" in text
+    assert "hvdtpu_serve_request_seconds_bucket" in text
+
+
+# ---------------------------------------------------------------------------
+# Model registry / fingerprint / lineage extraction
+
+def test_fingerprint_identifies_weight_sets():
+    a, b = _leaves(1), _leaves(2)
+    assert smodel.fingerprint(a) == smodel.fingerprint(_leaves(1))
+    assert smodel.fingerprint(a) != smodel.fingerprint(b)
+
+
+def test_extract_leaves_from_training_lineage_paths():
+    leaves = _leaves(3)
+    raw = {".w": leaves["w"], ".b": leaves["b"],
+           ".opt.0.mu.w": np.zeros((DIM, DIM), np.float32),
+           ".step": np.int64(7)}
+    out = smodel.extract_leaves(raw, _leaves(0))
+    assert out is not None
+    assert smodel.fingerprint(out) == smodel.fingerprint(leaves)
+    # Missing leaf -> None (replica keeps current weights).
+    assert smodel.extract_leaves({".w": leaves["w"]}, _leaves(0)) is None
+    # Shape mismatch -> None, not a crash.
+    assert smodel.extract_leaves(
+        {".w": np.zeros((2, 2), np.float32), ".b": leaves["b"]},
+        _leaves(0)) is None
+
+
+def test_forward_jit_numpy_parity():
+    leaves = _leaves(4)
+    x = np.random.RandomState(0).standard_normal(
+        (8, DIM)).astype(np.float32)
+    ref = smodel.forward("affine", leaves, x)
+    jit_fwd = smodel.make_forward("affine", leaves)
+    assert np.allclose(jit_fwd(x), ref, atol=1e-4)
+    os.environ["HVD_TPU_SERVE_JIT"] = "0"
+    try:
+        np_fwd = smodel.make_forward("affine", leaves)
+    finally:
+        os.environ.pop("HVD_TPU_SERVE_JIT", None)
+    assert np.allclose(np_fwd(x), ref, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Rolling swap watcher (satellite: edge cases)
+
+def _watcher(ckpt_dir, metrics=None, current=(-1,), flips=None,
+             draining_fn=None, stagger=0.0):
+    flips = flips if flips is not None else []
+
+    def flip(step, leaves, crc):
+        current[0] = step
+        flips.append((step, crc))
+
+    return SwapWatcher(str(ckpt_dir), _leaves(0),
+                       current_step_fn=lambda: current[0],
+                       flip_fn=flip, metrics=metrics,
+                       draining_fn=draining_fn, stagger=stagger), flips
+
+
+def test_swap_watcher_flips_to_newer_checkpoint(tmp_path):
+    m = ServeMetrics()
+    leaves = _leaves(5)
+    publish_leaves(str(tmp_path), 10, leaves)
+    current = [-1]
+    w, flips = _watcher(tmp_path, metrics=m, current=current)
+    assert w.poll_once() == 10
+    assert flips == [(10, smodel.fingerprint(leaves))]
+    # Nothing newer: no re-flip.
+    assert w.poll_once() is None
+    assert m.snapshot()["counters"]["serve_swaps_total"] == 1
+
+
+def test_swap_watcher_rejects_torn_manifest_and_falls_back(tmp_path):
+    """A torn (truncated) NEWER manifest counts one
+    serve_swap_rejects_total and the watcher falls back to the
+    next-older valid checkpoint — the replica never serves a
+    half-loaded weight set."""
+    m = ServeMetrics()
+    good = _leaves(6)
+    publish_leaves(str(tmp_path), 10, good)
+    publish_leaves(str(tmp_path), 20, _leaves(7))
+    # Tear step 20's manifest mid-write.
+    step20 = [p for s, g, p in durable.list_checkpoints(str(tmp_path))
+              if s == 20][0]
+    manifest = os.path.join(step20, durable.MANIFEST_NAME)
+    raw = open(manifest, "rb").read()
+    with open(manifest, "wb") as f:
+        f.write(raw[:len(raw) // 2])
+    current = [-1]
+    w, flips = _watcher(tmp_path, metrics=m, current=current)
+    assert w.poll_once() == 10  # fell back to the older valid lineage
+    assert flips == [(10, smodel.fingerprint(good))]
+    assert m.snapshot()["counters"]["serve_swap_rejects_total"] == 1
+    # Re-polling does NOT re-count the same torn directory.
+    assert w.poll_once() is None
+    assert m.snapshot()["counters"]["serve_swap_rejects_total"] == 1
+
+
+def test_swap_watcher_rejects_crc_invalid_shard(tmp_path):
+    """A flipped bit in a newer checkpoint's shard bytes fails the deep
+    validation; the swap is rejected and the current weights keep
+    serving."""
+    m = ServeMetrics()
+    publish_leaves(str(tmp_path), 10, _leaves(8))
+    step10 = [p for s, g, p in durable.list_checkpoints(str(tmp_path))
+              if s == 10][0]
+    shard = [os.path.join(step10, f) for f in os.listdir(step10)
+             if f != durable.MANIFEST_NAME][0]
+    blob = bytearray(open(shard, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(shard, "wb") as f:
+        f.write(bytes(blob))
+    current = [5]  # serving something older than the poisoned ckpt
+    w, flips = _watcher(tmp_path, metrics=m, current=current)
+    assert w.poll_once() is None
+    assert flips == []
+    assert current[0] == 5  # still on the old weights
+    assert m.snapshot()["counters"]["serve_swap_rejects_total"] == 1
+
+
+def test_swap_abandoned_when_drain_wins_the_race(tmp_path):
+    """A drain that lands between shadow-load and flip abandons the
+    swap (serve_swap_aborts_total): the remaining queue finishes on the
+    weights it was admitted under."""
+    m = ServeMetrics()
+    publish_leaves(str(tmp_path), 10, _leaves(9))
+    calls = [0]
+
+    def draining():
+        # False at the scan guard, True at the flip gate: the drain
+        # arrives while the shadow is loading.
+        calls[0] += 1
+        return calls[0] > 1
+
+    current = [-1]
+    w, flips = _watcher(tmp_path, metrics=m, current=current,
+                        draining_fn=draining)
+    assert w.poll_once() is None
+    assert flips == []
+    snap = m.snapshot()["counters"]
+    assert snap["serve_swap_aborts_total"] == 1
+    assert snap["serve_swaps_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Front door + client
+
+def _replica_fixture(max_batch=8, deadline=5.0):
+    m = ServeMetrics()
+    b = MicroBatcher(max_batch=max_batch, max_delay=0.003, metrics=m)
+    leaves = _leaves(0)
+    crc = smodel.fingerprint(leaves)
+    ctx = ReplicaContext(b, m, worker_id=0, request_deadline=deadline)
+    ctx.set_weights(1, crc)
+    httpd, port = start_front_door(0, ctx)
+    stop = threading.Event()
+    _run_batches(b, smodel.make_forward("affine", leaves),
+                 stamp=(1, crc), stop=stop)
+    return ctx, b, httpd, port, stop, leaves, crc
+
+
+def test_front_door_roundtrip_and_error_causes():
+    ctx, b, httpd, port, stop, leaves, crc = _replica_fixture()
+    try:
+        client = ServeClient(["127.0.0.1:%d" % port], total_deadline=5)
+        x = np.arange(DIM, dtype=np.float32)
+        doc = client.infer(x, rid="r1")
+        assert np.allclose(doc["y"], smodel.forward("affine", leaves, x),
+                           atol=1e-4)
+        assert doc["weights_crc"] == crc and doc["model_step"] == 1
+
+        # Malformed body -> prompt 400 with cause, not a hang.
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/infer" % port, data=b"{nope",
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            raise AssertionError("bad request was accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert json.loads(e.read())["cause"] == "bad-request"
+
+        # /serve document carries the wire fields.
+        view = json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:%d/serve" % port, timeout=5).read())
+        assert view["state"] == "serving"
+        assert view["weights_crc"] == crc
+        assert view["responses_total"] >= 1
+
+        # Draining -> cause-named 503 the client treats as re-queueable.
+        ctx.begin_drain()
+        b.close()
+        with pytest.raises(ServeError) as err:
+            ServeClient(["127.0.0.1:%d" % port],
+                        total_deadline=0.4).infer(x)
+        assert err.value.cause == "draining"
+    finally:
+        stop.set()
+        httpd.shutdown()
+
+
+def test_client_retries_to_surviving_replica():
+    ctx, b, httpd, port, stop, leaves, crc = _replica_fixture()
+    try:
+        # First endpoint refuses connections (a SIGKILLed replica);
+        # the client's rotation lands on the live one.
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        client = ServeClient(
+            ["127.0.0.1:%d" % dead_port, "127.0.0.1:%d" % port],
+            total_deadline=5)
+        for i in range(4):
+            doc = client.infer(np.full(DIM, i, np.float32))
+            assert doc["replica"] == 0
+    finally:
+        stop.set()
+        httpd.shutdown()
+
+
+def test_loadgen_detects_wrong_weights():
+    x = request_input(seed=0, rid=1, dim=DIM)
+    leaves = _leaves(0)
+    crc = smodel.fingerprint(leaves)
+    y = smodel.forward("affine", leaves, x)
+    good = {"y": [float(v) for v in y], "weights_crc": crc}
+    assert check_response(good, x, "affine", {crc: leaves}) is None
+    # Answer computed with OTHER weights but claiming this crc.
+    wrong = {"y": [float(v) for v in smodel.forward(
+        "affine", _leaves(1), x)], "weights_crc": crc}
+    assert "does not match" in check_response(
+        wrong, x, "affine", {crc: leaves})
+    unknown = {"y": [0.0] * DIM, "weights_crc": "ffffffff"}
+    assert "unknown" in check_response(
+        unknown, x, "affine", {crc: leaves})
+
+
+# ---------------------------------------------------------------------------
+# Supervisor autoscaler (unit, against a stub driver)
+
+class _StubDriver:
+    def __init__(self, live):
+        self._live = list(live)
+        self.resized_to = None
+        self.drained = None
+
+    def live_workers(self):
+        return list(self._live)
+
+    def resize(self, n):
+        self.resized_to = n
+
+    def request_drain(self, victims, grace=None):
+        self.drained = victims
+
+
+def _stub_supervisor(live, views, **kwargs):
+    sup = ServeSupervisor(
+        ["true"], {"localhost": 8}, min_replicas=1, max_replicas=4,
+        **kwargs)
+    sup.driver = _StubDriver(live)
+    sup.replica_views = lambda timeout=0.5: views
+    return sup
+
+
+def test_autoscaler_grows_on_queue_pressure():
+    views = [{"queue_depth": 9}, {"queue_depth": 7}]
+    sup = _stub_supervisor([0, 1], views, scale_up_queue=4.0)
+    assert sup.autoscale_once() == 1
+    assert sup.driver.resized_to == 3
+    assert sup.scale_events[-1]["to"] == 3
+
+
+def test_autoscaler_shrinks_after_sustained_idle():
+    views = [{"queue_depth": 0}, {"queue_depth": 0}]
+    sup = _stub_supervisor([0, 3], views, scale_down_idle=0.0)
+    assert sup.autoscale_once() in (0, -1)  # first tick arms the timer
+    assert sup.autoscale_once() == -1
+    assert sup.driver.resized_to == 1
+    assert sup.driver.drained == [3]  # youngest replica drains
+
+
+def test_autoscaler_respects_ceiling():
+    views = [{"queue_depth": 50}] * 4
+    sup = _stub_supervisor([0, 1, 2, 3], views)
+    assert sup.autoscale_once() == 0
+    assert sup.driver.resized_to is None
+
+
+# ---------------------------------------------------------------------------
+# hvd-top --serve rendering + mixed-version tolerance (satellite)
+
+def _serve_doc():
+    rep = {"state": "serving", "replica": 0, "model_step": 12,
+           "weights_crc": "cafe0123", "queue_depth": 2, "inflight": 1,
+           "requests_total": 100, "responses_total": 97,
+           "batches_total": 30, "rejects_total": 1, "errors_total": 2,
+           "frame_corrupt_total": 1, "swaps_total": 3,
+           "swap_rejects_total": 1, "swap_aborts_total": 0,
+           "p50_ms": 4.2, "p99_ms": 19.0}
+    return {"kind": "serve-pool", "replicas": 2, "replicas_reporting": 2,
+            "draining": 0, "scale_events": 1, "requests_total": 150,
+            "responses_total": 140, "rejects_total": 1,
+            "errors_total": 2, "swaps_total": 3, "p99_ms": 19.0,
+            "frame_corrupt_total": 1, "model_steps": [11, 12],
+            "per_replica": [rep,
+                            # An OLDER replica mid-rolling-upgrade:
+                            # its document predates the swap fields.
+                            {"state": "serving", "replica": 1,
+                             "model_step": 11, "weights_crc": "beef",
+                             "queue_depth": 0, "requests_total": 50}]}
+
+
+def test_hvd_top_serve_renders_and_tolerates_old_replicas():
+    from horovod_tpu.run import top
+    frame = top.render_serve(_serve_doc(), "test:0")
+    lines = frame.splitlines()
+    rows = [ln for ln in lines if ln.strip().startswith(("0 ", "1 "))
+            or ln.strip().split()[:1] in (["0"], ["1"])]
+    assert len(rows) == 2, frame
+    # The new replica renders numbers; the old replica renders '-' in
+    # the columns its summary predates, WITHOUT shifting the row.
+    new_cells = rows[0].split()
+    old_cells = rows[1].split()
+    assert len(new_cells) == len(old_cells) == len(top._SERVE_COLUMNS) + 1
+    assert "cafe0123" in new_cells
+    assert "-" in old_cells  # e.g. the swp/p50 cells
+    # Mixed-weights banner: a rolling swap is visibly in flight.
+    assert "mixed weights" in frame
+    assert "corrupt batch frame" in frame
+
+
+def test_hvd_top_fleet_kind_column_tolerates_old_controller():
+    from horovod_tpu.run import top
+    fleet = {"t": 1.0, "free_slots": 0, "counters": {}, "hosts": {},
+             "jobs": {"train0": {"state": "running", "priority": 0,
+                                 "live": 2, "np": 2, "min_np": 1},
+                      "serve0": {"state": "running", "kind": "serve",
+                                 "placement": "spread", "priority": 5,
+                                 "live": 2, "np": 2, "min_np": 1}}}
+    frame = top.render_fleet(fleet, "test:0")
+    train_row = [ln for ln in frame.splitlines()
+                 if ln.startswith("train0")][0]
+    serve_row = [ln for ln in frame.splitlines()
+                 if ln.startswith("serve0")][0]
+    assert "serve" in serve_row and "spread" in serve_row
+    assert "-" in train_row.split()  # old controller doc: kind absent
+
+
+# ---------------------------------------------------------------------------
+# E2E: real replica subprocesses under the elastic driver
+
+def _free_port_base(n):
+    """A base port with n consecutive free ports (probe-and-release;
+    the tiny race against other suites is retried by the caller's
+    health-wait)."""
+    for _ in range(64):
+        base = random.randint(20000, 55000)
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+        return base
+    raise RuntimeError("no free port block found")
+
+
+class _Pool:
+    """Test harness: a real serve pool (supervisor in-process, replica
+    subprocesses) bounded by `max_np` slots on localhost."""
+
+    def __init__(self, replicas=2, max_np=None, ckpt_dir=None,
+                 extra_env=None, **sup_kwargs):
+        from tests.conftest import clean_worker_env
+        max_np = max_np or replicas
+        self.port_base = _free_port_base(max_np + 2)
+        env = clean_worker_env(dict({
+            # numpy forward: replica boot must not pay a jax import.
+            "HVD_TPU_SERVE_JIT": "0",
+            "HVD_TPU_SERVE_MODEL": "affine",
+            "HVD_TPU_SERVE_DIM": str(DIM),
+            "HVD_TPU_SERVE_PORT": str(self.port_base),
+            "HVD_TPU_SERVE_SWAP_INTERVAL": "0.1",
+            "HVD_TPU_SERVE_SWAP_STAGGER": "0.2",
+        }, **(extra_env or {})))
+        if ckpt_dir:
+            env["HVD_TPU_CKPT_DIR"] = str(ckpt_dir)
+        self.sup = ServeSupervisor(
+            [sys.executable, "-m", "horovod_tpu.serve.replica"],
+            {"localhost": max_np}, min_replicas=1,
+            max_replicas=max_np, np_initial=replicas,
+            port_base=self.port_base, env=env, **sup_kwargs)
+        self.rc = None
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        try:
+            self.rc = self.sup.driver.run(install_signal_handlers=False)
+        except Exception as e:  # surfaced by the test's join/assert
+            self.rc = ("driver crashed", e)
+
+    def wait_healthy(self, n, timeout=30):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            up = 0
+            for ep in self.sup.endpoints():
+                try:
+                    with urllib.request.urlopen(
+                            "http://%s/healthz" % ep, timeout=1) as r:
+                        if json.loads(r.read()).get("ok"):
+                            up += 1
+                except Exception:
+                    pass
+            if up >= n:
+                return
+            time.sleep(0.1)
+        raise AssertionError("only %d/%d replicas healthy (rc=%r)"
+                             % (up, n, self.rc))
+
+    def drain(self, timeout=60):
+        self.sup.driver.request_drain("all")
+        self.thread.join(timeout=timeout)
+        assert not self.thread.is_alive(), "driver did not finish drain"
+        return self.rc
+
+    def kill(self):
+        if self.thread.is_alive():
+            self.sup.driver.terminate()
+            self.thread.join(timeout=15)
+
+
+@pytest.mark.e2e
+def test_e2e_rolling_swap_zero_dropped_new_weights_proven(tmp_path):
+    """The tentpole acceptance: requests flow through a rolling weight
+    swap with zero drops, and post-swap responses are PROVABLY computed
+    from the new weights (every answer re-verified against the numpy
+    forward of the weight set its fingerprint names)."""
+    old, new = _leaves(1), _leaves(2)
+    crc_old, crc_new = (smodel.fingerprint(old), smodel.fingerprint(new))
+    publish_leaves(str(tmp_path), 10, old)
+    pool = _Pool(replicas=2, ckpt_dir=tmp_path)
+    try:
+        pool.wait_healthy(2)
+        by_crc = {crc_old: old, crc_new: new}
+        result_box = {}
+
+        def load():
+            result_box["r"], result_box["wall"] = run_load(
+                pool.sup.endpoints, rate=40, duration=4.0, dim=DIM,
+                seed=3, leaves_by_crc=by_crc, workers=4,
+                total_deadline=10.0)
+
+        t = threading.Thread(target=load)
+        t.start()
+        time.sleep(1.0)
+        publish_leaves(str(tmp_path), 20, new)  # the rolling swap lands
+        t.join(timeout=60)
+        assert not t.is_alive()
+        res = result_box["r"]
+        assert res.errors == [], res.errors[:5]
+        assert res.mismatches == [], res.mismatches[:5]
+        assert res.ok == 160  # zero dropped: every admitted answered
+        # Traffic provably crossed the swap: answers from BOTH weight
+        # sets, and the new fingerprint dominates the tail.
+        assert res.by_crc.get(crc_old, 0) > 0
+        assert res.by_crc.get(crc_new, 0) > 0, res.by_crc
+        # Both replicas converged on the new lineage step.
+        for ep in pool.sup.endpoints():
+            view = json.loads(urllib.request.urlopen(
+                "http://%s/serve" % ep, timeout=5).read())
+            assert view["model_step"] == 20
+            assert view["swaps_total"] >= 1
+        rc = pool.drain()
+        assert rc == EXIT_DRAINED
+    finally:
+        pool.kill()
+
+
+@pytest.mark.e2e
+def test_e2e_sigkill_replica_mid_request_no_hang_no_wrong_answer(
+        tmp_path, monkeypatch):
+    """Chaos acceptance: SIGKILL a replica while requests are in
+    flight. Every request gets a correct answer (re-queued to the
+    survivor) or a prompt cause-named error — never a hang, never a
+    wrong answer. The driver respawns the dead replica (failure
+    blacklist cooldown permitting)."""
+    monkeypatch.setenv("HVD_TPU_ELASTIC_COOLDOWN", "1")
+    leaves = _leaves(4)
+    crc = smodel.fingerprint(leaves)
+    publish_leaves(str(tmp_path), 10, leaves)
+    pool = _Pool(replicas=2, max_np=2, ckpt_dir=tmp_path)
+    try:
+        pool.wait_healthy(2)
+        by_crc = {crc: leaves}
+        result_box = {}
+
+        def load():
+            result_box["r"], _ = run_load(
+                pool.sup.endpoints, rate=30, duration=4.0, dim=DIM,
+                seed=5, leaves_by_crc=by_crc, workers=4,
+                total_deadline=8.0)
+
+        t = threading.Thread(target=load)
+        t.start()
+        time.sleep(1.0)
+        victim = pool.sup.driver.live_workers()[0]
+        pid = pool.sup.driver.worker_pid(victim)
+        os.kill(pid, signal.SIGKILL)
+        t.join(timeout=90)
+        assert not t.is_alive(), "load generator hung after the kill"
+        res = result_box["r"]
+        # The hard contract: NEVER a wrong answer, NEVER a silent drop.
+        assert res.mismatches == [], res.mismatches[:5]
+        assert res.ok + len(res.errors) == 120
+        # The client absorbed the kill: retries to the survivor answer
+        # (allow a small tail of prompt, cause-named errors).
+        assert res.ok >= 110, (res.ok, res.errors[:10])
+        for rid, cause, msg in res.errors:
+            assert cause in ("replica-lost", "draining", "overload",
+                             "deadline"), (rid, cause, msg)
+        # The pool healed: a respawned replica joins within cooldown.
+        pool.wait_healthy(2, timeout=30)
+        rc = pool.drain()
+        assert rc == EXIT_DRAINED
+    finally:
+        pool.kill()
