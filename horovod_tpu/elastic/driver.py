@@ -467,6 +467,12 @@ class ElasticDriver:
         """Sorted live worker ids (chaos kill-victim candidates)."""
         return sorted(self._workers)
 
+    def worker_hosts(self):
+        """{worker id: hostname} for live workers — serve endpoint
+        discovery needs the HOST each replica landed on, not just its
+        id (snapshot read; safe from another thread under the GIL)."""
+        return {wid: w.hostname for wid, w in self._workers.items()}
+
     def worker_pid(self, wid):
         w = self._workers.get(wid)
         return w.proc.pid if w is not None else None

@@ -341,6 +341,8 @@ _SERVE_COLUMNS = [
     ("batch", 7, _serve_num("batches_total")),
     ("rej", 5, _serve_num("rejects_total")),
     ("err", 5, _serve_num("errors_total")),
+    # Deadline-expired tickets dropped before spending a forward row.
+    ("cxl", 5, _serve_num("cancelled_total")),
     # Frame-integrity failures caught by the per-row CRC gate.
     ("corr", 5, _serve_num("frame_corrupt_total")),
     # Rolling weight swaps: landed / rejected (torn or CRC-invalid

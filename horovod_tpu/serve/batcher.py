@@ -45,11 +45,19 @@ class Ticket:
     until the batch loop fills ``response`` or ``error``."""
 
     __slots__ = ("rid", "x", "crc", "admitted", "event", "response",
-                 "error", "cause", "model_step", "weights_crc")
+                 "error", "cause", "model_step", "weights_crc",
+                 "cancelled")
 
     def __init__(self, rid, x):
         self.rid = rid
         self.x = np.ascontiguousarray(x, dtype=np.float32)
+        if self.x.ndim != 1:
+            # Reject at ADMISSION: a non-flat row would only blow up
+            # later inside the batch loop's frame assembly, where an
+            # exception kills the whole replica, not one request.
+            raise ValueError(
+                "request x must be a flat vector, got shape %r"
+                % (tuple(self.x.shape),))
         self.crc = durable.crc32c(self.x.tobytes())
         self.admitted = time.monotonic()
         self.event = threading.Event()
@@ -58,11 +66,18 @@ class Ticket:
         self.cause = None
         self.model_step = None
         self.weights_crc = None
+        self.cancelled = False
 
     def fail(self, cause, message):
         self.cause = cause
         self.error = message
         self.event.set()
+
+    def cancel(self):
+        """Marks the ticket abandoned (its handler already answered —
+        deadline expiry). The batch loop drops cancelled tickets
+        instead of spending a forward-pass row on them."""
+        self.cancelled = True
 
     def finish(self, row, stamp=None):
         # The weights identity is stamped BEFORE the event fires: the
@@ -144,6 +159,15 @@ class MicroBatcher:
                 if remain <= 0:
                     break
                 self._cond.wait(remain)
+            # Purge deadline-abandoned tickets first: their handlers
+            # already answered 504, so a forward row for them would
+            # only amplify the overload that expired them.
+            if any(t.cancelled for t in self._queue):
+                kept = [t for t in self._queue if not t.cancelled]
+                if self.metrics is not None:
+                    self.metrics.inc("serve_cancelled_total",
+                                     len(self._queue) - len(kept))
+                self._queue = kept
             batch = self._queue[:self.max_batch]
             del self._queue[:len(batch)]
             if self.metrics is not None:
@@ -158,23 +182,34 @@ class MicroBatcher:
         forward once, splits rows back to tickets (each stamped with
         ``stamp`` — the (step, weights_crc) identity of the weights the
         forward actually used). Never raises: every ticket ends
-        answered or cause-named-failed."""
-        if not tickets:
+        answered, cause-named-failed, or dropped as cancelled (its
+        handler already answered a deadline 504)."""
+        live = [t for t in tickets if not t.cancelled]
+        if self.metrics is not None and len(live) < len(tickets):
+            self.metrics.inc("serve_cancelled_total",
+                             len(tickets) - len(live))
+        if not live:
             return
-        dim = tickets[0].x.shape[-1]
-        bucket = bucket_for(len(tickets), self.max_batch)
+        dim = live[0].x.shape[-1] if live[0].x.ndim else 0
+        bucket = bucket_for(len(live), self.max_batch)
         frame = np.zeros((bucket, dim), np.float32)
         ok = []
-        for i, t in enumerate(tickets):
-            if t.x.shape[-1] != dim:
+        for i, t in enumerate(live):
+            if t.x.shape != (dim,):
                 t.fail("shape",
-                       "request dim %d does not match batch dim %d"
-                       % (t.x.shape[-1], dim))
+                       "request shape %r does not match batch row "
+                       "shape (%d,)" % (tuple(t.x.shape), dim))
                 continue
-            frame[i] = t.x
+            try:
+                frame[i] = t.x
+            except ValueError as e:
+                t.fail("shape",
+                       "request row does not fit the batch frame: %s"
+                       % e)
+                continue
             ok.append((i, t))
         if self.chaos is not None:
-            self.chaos.maybe_corrupt_frame(frame, rows=len(tickets))
+            self.chaos.maybe_corrupt_frame(frame, rows=len(live))
         # Integrity gate: the frame row must still be the bytes the
         # request was admitted with (catches the chaos bitflip and any
         # real copy bug between admission and the forward).
@@ -216,4 +251,4 @@ class MicroBatcher:
                                      now - t.admitted)
         if self.metrics is not None:
             self.metrics.inc("serve_batches_total")
-            self.metrics.observe("serve_batch_fill", len(tickets))
+            self.metrics.observe("serve_batch_fill", len(live))

@@ -38,6 +38,8 @@ COUNTERS = (
     "serve_batches_total",         # forward passes executed
     "serve_rejects_total",         # refused at admission (full/draining)
     "serve_errors_total",          # answered with a cause-named error
+    "serve_cancelled_total",       # deadline-expired tickets dropped
+                                   # before spending a forward row
     "serve_frame_corrupt_total",   # batch-frame CRC mismatches detected
     "serve_swaps_total",           # weight swaps flipped in
     "serve_swap_rejects_total",    # newer-but-invalid manifests refused

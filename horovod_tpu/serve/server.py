@@ -89,6 +89,7 @@ class ReplicaContext:
             "batches_total": c["serve_batches_total"],
             "rejects_total": c["serve_rejects_total"],
             "errors_total": c["serve_errors_total"],
+            "cancelled_total": c["serve_cancelled_total"],
             "frame_corrupt_total": c["serve_frame_corrupt_total"],
             "swaps_total": c["serve_swaps_total"],
             "swap_rejects_total": c["serve_swap_rejects_total"],
@@ -161,6 +162,10 @@ def _make_handler(ctx):
                                  "cause": "bad-request"})
                 return
             if not ticket.event.wait(ctx.request_deadline):
+                # Mark the ticket abandoned so the batch loop drops it
+                # instead of computing an answer nobody is waiting for
+                # (expired requests must not keep amplifying overload).
+                ticket.cancel()
                 ctx.metrics.inc("serve_errors_total")
                 self._json(504, {"error": "request deadline (%.1fs) "
                                           "expired in the batch queue"

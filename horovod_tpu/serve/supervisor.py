@@ -12,8 +12,10 @@ autoscaling is "move the ceiling" and nothing else.
 
 The supervisor adds the pool-level view: an aggregated ``/serve``
 status endpoint (what ``hvd-top --serve`` renders), a queue-pressure
-autoscaler, and endpoint discovery (replica ports are deterministic:
-``port_base + worker_id``).
+autoscaler, and endpoint discovery (each replica listens on
+``port_base + worker_id`` on the host the driver placed it on, read
+from the driver's worker records — multi-host ``-H`` inventories
+resolve to reachable endpoints).
 """
 
 import argparse
@@ -27,6 +29,7 @@ import urllib.request
 
 from horovod_tpu.elastic.discovery import FixedHosts
 from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.run import util
 
 
 def _fetch_json(url, timeout=1.0):
@@ -68,17 +71,29 @@ class ServeSupervisor:
             sys.stderr.flush()
 
     # -- pool introspection -------------------------------------------
+    def _replica_addrs(self):
+        """[(worker id, "host:port")] from the driver's worker records
+        — the HOST each replica actually landed on (-H accepts
+        multi-host inventories), with local spellings normalized to
+        the loopback the replica's listener is certainly reachable
+        on."""
+        addrs = []
+        for wid, host in sorted(self.driver.worker_hosts().items()):
+            if util.is_local_host(host):
+                host = "127.0.0.1"
+            addrs.append((wid, "%s:%d" % (host, self.port_base + wid)))
+        return addrs
+
     def endpoints(self):
-        return ["127.0.0.1:%d" % (self.port_base + wid)
-                for wid in self.driver.live_workers()]
+        return [addr for _, addr in self._replica_addrs()]
 
     def replica_views(self, timeout=1.0):
         """Per-replica /serve documents for every reachable replica."""
         views = []
-        for wid in self.driver.live_workers():
-            url = "http://127.0.0.1:%d/serve" % (self.port_base + wid)
+        for _, addr in self._replica_addrs():
             try:
-                views.append(_fetch_json(url, timeout=timeout))
+                views.append(_fetch_json("http://%s/serve" % addr,
+                                         timeout=timeout))
             except Exception:
                 continue  # booting or dying; the pool view skips it
         return views
@@ -101,6 +116,7 @@ class ServeSupervisor:
         }
         for field in ("requests_total", "responses_total",
                       "batches_total", "rejects_total", "errors_total",
+                      "cancelled_total",
                       "frame_corrupt_total", "swaps_total",
                       "swap_rejects_total", "swap_aborts_total",
                       "queue_depth", "inflight"):

@@ -138,6 +138,63 @@ def test_batcher_shape_mismatch_fails_only_that_request():
     assert bad.cause == "shape" and bad.event.is_set()
 
 
+def test_batcher_rejects_non_flat_input_at_admission():
+    """A 2-D body whose inner length matches the model dim must be
+    refused at submit (ValueError -> the front door's 400), never
+    reach frame assembly where it would crash the batch loop."""
+    b = MicroBatcher(max_batch=4)
+    with pytest.raises(ValueError):
+        b.submit("2d", [[0.0] * DIM, [1.0] * DIM])
+    with pytest.raises(ValueError):
+        b.submit("3d", np.zeros((1, 2, DIM), np.float32))
+    assert b.depth() == 0  # nothing was admitted
+    # The replica serves on: a well-formed request still works.
+    t = b.submit("ok", np.zeros(DIM, np.float32))
+    b.run_batch(smodel.make_forward("affine", _leaves(0)),
+                b.next_batch(timeout=0.5))
+    assert t.error is None and t.response is not None
+
+
+def test_run_batch_never_raises_on_malformed_ticket():
+    """run_batch's 'never raises' contract must hold even for a ticket
+    whose x stopped being a flat row (hand-made ticket / future
+    admission bug): that request fails cause-named, the rest answer."""
+    b = MicroBatcher(max_batch=4)
+    good = b.submit("g", np.zeros(DIM, np.float32))
+    bad = b.submit("b", np.zeros(DIM, np.float32))
+    bad.x = np.zeros((2, DIM), np.float32)  # simulate the bypass
+    batch = b.next_batch(timeout=0.5)
+    b.run_batch(smodel.make_forward("affine", _leaves(0)), batch)
+    assert good.error is None and good.response is not None
+    assert bad.cause == "shape" and bad.event.is_set()
+
+
+def test_cancelled_ticket_dropped_without_forward_row():
+    """A deadline-expired (cancelled) ticket is purged before frame
+    assembly: no forward row, no response counter — only
+    serve_cancelled_total moves."""
+    m = ServeMetrics()
+    b = MicroBatcher(max_batch=4, metrics=m)
+    kept = b.submit("kept", np.zeros(DIM, np.float32))
+    gone = b.submit("gone", np.ones(DIM, np.float32))
+    gone.cancel()  # what server._infer does when 504ing
+    batch = b.next_batch(timeout=0.5)
+    assert gone not in batch  # purged in next_batch
+    b.run_batch(smodel.make_forward("affine", _leaves(0)), batch)
+    assert kept.response is not None
+    assert gone.response is None and not gone.event.is_set()
+    snap = m.snapshot()
+    assert snap["counters"]["serve_cancelled_total"] == 1
+    assert snap["counters"]["serve_responses_total"] == 1
+    # Cancellation after the batch was taken is caught by run_batch.
+    late = b.submit("late", np.zeros(DIM, np.float32))
+    batch = b.next_batch(timeout=0.5)
+    late.cancel()
+    b.run_batch(smodel.make_forward("affine", _leaves(0)), batch)
+    assert late.response is None
+    assert m.snapshot()["counters"]["serve_cancelled_total"] == 2
+
+
 def test_corrupt_frame_fails_request_with_named_cause():
     m = ServeMetrics()
     chaos = ServeChaos(seed=7, corrupt_batches=(1,))
@@ -382,6 +439,24 @@ def test_front_door_roundtrip_and_error_causes():
             assert e.code == 400
             assert json.loads(e.read())["cause"] == "bad-request"
 
+        # A 2-D x whose inner length matches the dim (the remote-DoS
+        # vector: it used to pass admission and crash the batch loop
+        # at frame assembly) -> prompt 400, replica survives.
+        body = json.dumps({"id": "r2",
+                           "x": [[0.0] * DIM, [1.0] * DIM]}).encode()
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/infer" % port, data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            raise AssertionError("2-D request was accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert json.loads(e.read())["cause"] == "bad-request"
+        doc = client.infer(x, rid="r3")  # still serving
+        assert np.allclose(doc["y"], smodel.forward("affine", leaves, x),
+                           atol=1e-4)
+
         # /serve document carries the wire fields.
         view = json.loads(urllib.request.urlopen(
             "http://127.0.0.1:%d/serve" % port, timeout=5).read())
@@ -442,13 +517,17 @@ def test_loadgen_detects_wrong_weights():
 # Supervisor autoscaler (unit, against a stub driver)
 
 class _StubDriver:
-    def __init__(self, live):
+    def __init__(self, live, hosts=None):
         self._live = list(live)
+        self._hosts = hosts or {wid: "localhost" for wid in live}
         self.resized_to = None
         self.drained = None
 
     def live_workers(self):
         return list(self._live)
+
+    def worker_hosts(self):
+        return dict(self._hosts)
 
     def resize(self, n):
         self.resized_to = n
@@ -490,6 +569,19 @@ def test_autoscaler_respects_ceiling():
     assert sup.driver.resized_to is None
 
 
+def test_supervisor_endpoints_follow_worker_hosts():
+    """-H accepts multi-host inventories: endpoints must point at the
+    host each replica actually landed on (local spellings normalized
+    to loopback), not a hardcoded 127.0.0.1."""
+    sup = _stub_supervisor([0, 1, 2], [])
+    sup.driver = _StubDriver(
+        [0, 1, 2], hosts={0: "localhost", 1: "nodeB", 2: "127.0.0.1"})
+    base = sup.port_base
+    assert sup.endpoints() == ["127.0.0.1:%d" % base,
+                               "nodeB:%d" % (base + 1),
+                               "127.0.0.1:%d" % (base + 2)]
+
+
 # ---------------------------------------------------------------------------
 # hvd-top --serve rendering + mixed-version tolerance (satellite)
 
@@ -498,7 +590,8 @@ def _serve_doc():
            "weights_crc": "cafe0123", "queue_depth": 2, "inflight": 1,
            "requests_total": 100, "responses_total": 97,
            "batches_total": 30, "rejects_total": 1, "errors_total": 2,
-           "frame_corrupt_total": 1, "swaps_total": 3,
+           "cancelled_total": 0, "frame_corrupt_total": 1,
+           "swaps_total": 3,
            "swap_rejects_total": 1, "swap_aborts_total": 0,
            "p50_ms": 4.2, "p99_ms": 19.0}
     return {"kind": "serve-pool", "replicas": 2, "replicas_reporting": 2,
