@@ -622,3 +622,172 @@ def check_collective_in_serve_handler(model):
                 "client retry stacks another. Move collective work off "
                 "the serving plane (weights arrive via the swap "
                 "watcher)" % (coll, cls.name, name, via))
+
+
+def _self_attr_name(node):
+    import ast
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+
+def _class_has_lock(methods):
+    """True when any method stores a threading lock/condition on self —
+    the class has a locking discipline, and whether each access holds
+    it is beyond a lexical pass (that is the native audit's job; in
+    Python we stand down rather than flag disciplined code)."""
+    import ast
+    for meth in methods.values():
+        for node in ast.walk(meth):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(_self_attr_name(t) for t in node.targets):
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            fn = call.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name in _LOCK_FACTORIES:
+                return True
+    return False
+
+
+def _thread_entry_methods(methods):
+    """Method names handed to `threading.Thread(target=self.m)` anywhere
+    in the class, plus everything transitively reachable from them via
+    `self.helper()` calls — the full set of code the spawned thread can
+    run."""
+    import ast
+    entries = set()
+    for meth in methods.values():
+        for node in ast.walk(meth):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    tgt = _self_attr_name(kw.value)
+                    if tgt and tgt in methods:
+                        entries.add(tgt)
+    # transitive closure over self-method calls
+    frontier = list(entries)
+    while frontier:
+        meth = methods.get(frontier.pop())
+        if meth is None:
+            continue
+        for node in ast.walk(meth):
+            if isinstance(node, ast.Call):
+                callee = _self_attr_name(node.func)
+                if (callee in methods and callee not in entries):
+                    entries.add(callee)
+                    frontier.append(callee)
+    return entries
+
+
+def _attr_mutations(meth):
+    """{attr: first mutating node} for self-attribute stores, skipping
+    plain constant assigns (`self._stop = True` is a GIL-atomic flag —
+    the benign signaling idiom); `+=`-style read-modify-write is never
+    atomic and always counts."""
+    import ast
+    out = {}
+    for node in ast.walk(meth):
+        if isinstance(node, ast.AugAssign):
+            attr = _self_attr_name(node.target)
+            if attr:
+                out.setdefault(attr, node)
+        elif isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Constant):
+                continue
+            for t in node.targets:
+                attr = _self_attr_name(t)
+                if attr:
+                    out.setdefault(attr, node)
+    return out
+
+
+def _attr_references(meth):
+    import ast
+    out = set()
+    for node in ast.walk(meth):
+        attr = _self_attr_name(node)
+        if attr:
+            out.add(attr)
+    return out
+
+
+@register("thread-shared-mutable-without-lock", WARNING,
+          "attribute shared between a spawned thread and the rest of "
+          "its class with no lock anywhere in the class")
+def check_thread_shared_mutable(model):
+    """A class that spawns `threading.Thread(target=self.m)` and
+    mutates `self.x` on one side while the other side reads or writes
+    it — with NO threading.Lock/RLock/Condition attribute anywhere in
+    the class — is relying on the GIL making compound operations look
+    atomic. It does not: `self.n += 1` is a read-modify-write that
+    loses updates under preemption, and a non-constant assign can
+    publish a half-built object to a reader between bytecodes. Plain
+    constant flags (`self._stop = True`) are the one idiomatic
+    exception and are not flagged. WARNING, not ERROR: the pattern is
+    sometimes externally serialized (e.g. the thread only runs while
+    the caller is parked in join()) — suppress those with an inline
+    `# hvd-lint: disable=thread-shared-mutable-without-lock` naming
+    the serialization."""
+    import ast
+
+    for cls in ast.walk(model.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        if not methods or _class_has_lock(methods):
+            continue
+        thread_names = _thread_entry_methods(methods)
+        if not thread_names:
+            continue
+        main_names = [n for n in methods
+                      if n not in thread_names and n != "__init__"]
+        thread_muts = {}
+        thread_refs = set()
+        for n in sorted(thread_names):
+            for attr, node in _attr_mutations(methods[n]).items():
+                thread_muts.setdefault(attr, (n, node))
+            thread_refs |= _attr_references(methods[n])
+        main_muts = {}
+        main_refs = set()
+        for n in sorted(main_names):
+            for attr, node in _attr_mutations(methods[n]).items():
+                main_muts.setdefault(attr, (n, node))
+            main_refs |= _attr_references(methods[n])
+
+        hit = []
+        for attr, (meth, node) in sorted(thread_muts.items()):
+            if attr in main_refs:
+                hit.append((attr, meth, node, "thread", "the class"))
+        for attr, (meth, node) in sorted(main_muts.items()):
+            if attr in thread_refs and attr not in thread_muts:
+                hit.append((attr, meth, node, "main", "the thread"))
+        for attr, meth, node, side, other in hit:
+            yield make_finding(
+                model, node, "thread-shared-mutable-without-lock",
+                "`self.%s` is mutated in `%s.%s` (the %s side) and "
+                "touched from %s, but %s has no Lock/RLock/Condition "
+                "attribute at all — a `+=` or compound update here "
+                "loses writes under preemption; guard the attribute "
+                "with a threading.Lock, hand values over via "
+                "queue.Queue, or reduce the shared state to a "
+                "constant flag"
+                % (attr, cls.name, meth, side, other, cls.name))

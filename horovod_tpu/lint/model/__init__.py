@@ -1,0 +1,30 @@
+"""hvd-model: explicit-state model checking for the coordination protocols.
+
+hvd-verify (``horovod_tpu/lint/``) proves that per-rank collective
+*schedules* agree.  This package covers the orthogonal failure class:
+cross-process *interleavings*.  Every coordination protocol the runtime
+ships — response-cache bit sync, elastic drain agreement, the SPSC shm
+ring's futex wake protocol, group-ring connection establishment — is
+modeled as a set of processes taking guarded atomic actions over shared
+state, and the explorer enumerates every reachable interleaving, checking
+invariants, deadlocks, and livelock (no-progress cycles).
+
+Layout:
+
+- ``dsl.py``      — Action/Invariant/Model: the state-machine DSL.
+- ``explore.py``  — BFS explorer with canonical hashing, symmetry
+                    reduction over rank permutations, and minimal
+                    counterexample traces.
+- ``protocols/``  — the shipped models, each cross-referenced
+                    ``file:line`` to the real implementation and each
+                    carrying "revert the fix" bug variants that the
+                    checker must re-find (regressions for the historical
+                    bugs in CHANGES.md).
+- ``cli.py``      — ``bin/hvd-model``: human/JSON/SARIF reporters
+                    reusing hvd-lint's fingerprinting.
+
+See docs/MODEL.md for the DSL reference and how to read a trace.
+"""
+
+from .dsl import Action, Invariant, Model, freeze  # noqa: F401
+from .explore import ExploreResult, Violation, explore  # noqa: F401

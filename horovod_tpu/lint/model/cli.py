@@ -1,0 +1,256 @@
+"""bin/hvd-model — explore the shipped protocol models and re-find the
+seeded historical bugs.
+
+Two duties, both CI-gated via ``make check-model``:
+
+1. every shipped (fixed) protocol model explores CLEAN — no invariant
+   violation, no deadlock, no livelock — across its whole supported rank
+   range;
+2. every seeded "revert the fix in-model" bug variant produces a
+   violation of the REQUIRED kind: a checker that stops re-finding the
+   late-registration hang (or any other historical bug) is itself
+   broken, and that is a CI failure even though the shipped models are
+   clean.
+
+Problems are emitted as hvd-lint ``Finding`` records anchored into the
+model source files, so the human/JSON/SARIF reporters — including the
+stable fingerprints SARIF consumers diff across runs — are reused
+verbatim from ``horovod_tpu/lint/report.py``.
+"""
+
+import argparse
+import sys
+
+from ..report import format_human, format_json, format_sarif
+from ..rules import ERROR, Finding, register_meta
+from .explore import BudgetExceeded, explore, format_state, replay
+from .protocols import MODELS
+
+register_meta("model-invariant", ERROR,
+              "a protocol model reached a state violating a safety "
+              "invariant cross-referenced to the real implementation")
+register_meta("model-deadlock", ERROR,
+              "a protocol model reached a state with no enabled action "
+              "before the protocol completed")
+register_meta("model-livelock", ERROR,
+              "a protocol model can cycle forever without progress")
+register_meta("model-regression-missed", ERROR,
+              "a seeded historical-bug variant no longer produces its "
+              "violation — the checker lost a regression")
+register_meta("model-budget", ERROR,
+              "a protocol model exceeded the state budget — it no "
+              "longer closes under the CI cap")
+
+
+def _anchor(spec, needle):
+    """Line in the model's source where ``needle`` appears (for finding
+    anchors: invariants anchor at their definition, everything else at
+    the model's NAME line)."""
+    path = sys.modules[spec.build.__module__].__file__
+    if path.endswith(".pyc"):
+        path = path[:-1]
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for i, text in enumerate(fh, 1):
+                if needle in text:
+                    return path, i
+    except OSError:
+        pass
+    return path, 1
+
+
+def _violation_finding(spec, model, violation):
+    kind = violation.kind
+    if kind == "invariant" and violation.invariant is not None:
+        path, line = _anchor(spec, '"%s"' % violation.invariant.name)
+        ref = violation.invariant.code_ref
+    else:
+        path, line = _anchor(spec, "NAME = ")
+        ref = ""
+    msg = ("model %s: %s after %d step(s): %s"
+           % (model.name, kind, len(violation.trace),
+              " -> ".join(violation.trace) or "<initial state>"))
+    if ref:
+        msg += " [see %s]" % ref
+    return Finding(path=path, line=line, col=1, rule="model-%s" % kind,
+                   severity="error", message=msg, end_line=line)
+
+
+def _print_trace(model, violation, out):
+    out.write("\n  counterexample (%s, %d steps, minimal):\n"
+              % (violation.kind, len(violation.trace)))
+    try:
+        states = replay(model, violation.trace)
+    except (ValueError, KeyError):
+        states = None
+    for i, name in enumerate(violation.trace, 1):
+        out.write("    %2d. %s\n" % (i, name))
+    if violation.cycle:
+        out.write("    ... then forever: %s\n"
+                  % " -> ".join(violation.cycle))
+    out.write("  final state:\n")
+    final = states[-1] if states else violation.state
+    out.write(format_state(final) + "\n")
+    if violation.invariant is not None and violation.invariant.code_ref:
+        out.write("  real code: %s\n" % violation.invariant.code_ref)
+
+
+def _rank_list(spec, ranks_arg):
+    if ranks_arg is not None:
+        return [ranks_arg]
+    lo, hi = spec.rank_range
+    return list(range(lo, hi + 1))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="hvd-model",
+        description="explicit-state model checker for the coordination "
+                    "protocols (see docs/MODEL.md)")
+    ap.add_argument("--model", action="append", default=None,
+                    metavar="NAME", help="check only this model "
+                    "(repeatable; default: all)")
+    ap.add_argument("--ranks", type=int, default=None,
+                    help="rank count (default: each model's full "
+                    "supported range)")
+    ap.add_argument("--bug", default=None, metavar="NAME",
+                    help="explore ONE seeded bug variant (requires "
+                    "--model) and print its counterexample")
+    ap.add_argument("--no-bugs", action="store_true",
+                    help="skip the seeded-bug regressions")
+    ap.add_argument("--format", default="human",
+                    choices=("human", "json", "sarif"))
+    ap.add_argument("--max-states", type=int, default=200000)
+    ap.add_argument("--list", action="store_true",
+                    help="list models and their seeded bugs")
+    args = ap.parse_args(argv)
+    out = sys.stdout
+
+    if args.list:
+        for spec in MODELS.values():
+            out.write("%-12s ranks %d-%d  %s\n"
+                      % (spec.name, spec.rank_range[0],
+                         spec.rank_range[1], spec.description))
+            for bug, bs in spec.bugs.items():
+                out.write("  bug %-22s -> %-9s %s\n"
+                          % (bug, bs.kind, bs.description))
+        return 0
+
+    names = args.model or list(MODELS)
+    for name in names:
+        if name not in MODELS:
+            ap.error("unknown model %r (have: %s)"
+                     % (name, ", ".join(MODELS)))
+    if args.bug is not None and len(names) != 1:
+        ap.error("--bug requires exactly one --model")
+
+    findings = []
+    human = args.format == "human"
+    models_clean = bugs_refound = 0
+    total_states = total_edges = 0
+
+    # single-bug mode: show the counterexample and exit 0 if found
+    if args.bug is not None:
+        spec = MODELS[names[0]]
+        if args.bug not in spec.bugs:
+            ap.error("model %s has no bug %r (have: %s)"
+                     % (spec.name, args.bug, ", ".join(spec.bugs)))
+        model = spec.build(ranks=args.ranks, bug=args.bug)
+        result = explore(model, max_states=args.max_states)
+        expected = spec.bugs[args.bug].kind
+        hit = [v for v in result.violations if v.kind == expected]
+        if hit:
+            out.write("%s: re-found %s (%d canonical states)\n"
+                      % (model.name, expected, result.num_states))
+            _print_trace(model, hit[0], out)
+            return 0
+        out.write("%s: expected a %s violation, found %s\n"
+                  % (model.name, expected,
+                     [v.kind for v in result.violations] or "nothing"))
+        return 1
+
+    for name in names:
+        spec = MODELS[name]
+        for ranks in _rank_list(spec, args.ranks):
+            for model in spec.clean_builds(ranks):
+                try:
+                    result = explore(model, max_states=args.max_states)
+                except BudgetExceeded as exc:
+                    path, line = _anchor(spec, "NAME = ")
+                    findings.append(Finding(
+                        path=path, line=line, col=1, rule="model-budget",
+                        severity="error", message=str(exc),
+                        end_line=line))
+                    continue
+                total_states += result.num_states
+                total_edges += result.num_edges
+                if result.violations:
+                    for v in result.violations:
+                        findings.append(
+                            _violation_finding(spec, model, v))
+                        if human:
+                            out.write("FAIL %s @ %d ranks\n"
+                                      % (model.name, ranks))
+                            _print_trace(model, v, out)
+                else:
+                    models_clean += 1
+                    if human:
+                        out.write("ok   %-28s @ %d ranks: %6d states, "
+                                  "%7d transitions, clean (%.2fs)\n"
+                                  % (model.name, ranks,
+                                     result.num_states,
+                                     result.num_edges, result.elapsed))
+
+        if args.no_bugs:
+            continue
+        for bug, bs in spec.bugs.items():
+            model = spec.build(ranks=None, bug=bug)
+            try:
+                result = explore(model, max_states=args.max_states)
+            except BudgetExceeded as exc:
+                path, line = _anchor(spec, '"%s"' % bug)
+                findings.append(Finding(
+                    path=path, line=line, col=1, rule="model-budget",
+                    severity="error", message=str(exc), end_line=line))
+                continue
+            hit = [v for v in result.violations if v.kind == bs.kind]
+            if hit:
+                bugs_refound += 1
+                if human:
+                    out.write("ok   %-28s seeded bug re-found: %s in "
+                              "%d step(s)\n"
+                              % (model.name, bs.kind,
+                                 len(hit[0].trace)))
+            else:
+                path, line = _anchor(spec, '"%s"' % bug)
+                got = ([v.kind for v in result.violations]
+                       if result.violations else "a clean exploration")
+                findings.append(Finding(
+                    path=path, line=line, col=1,
+                    rule="model-regression-missed", severity="error",
+                    message="model %s: seeded bug %r must produce a %s "
+                            "violation but produced %s"
+                            % (spec.name, bug, bs.kind, got),
+                    end_line=line))
+                if human:
+                    out.write("FAIL %s: seeded bug %r NOT re-found "
+                              "(%s)\n" % (model.name, bug, got))
+
+    if args.format == "json":
+        format_json(findings, len(names), out)
+    elif args.format == "sarif":
+        format_sarif(findings, len(names), out, tool_name="hvd-model",
+                     information_uri="docs/MODEL.md")
+    else:
+        if findings:
+            format_human(findings, out)
+        out.write("hvd-model: %d model explorations clean (%d canonical "
+                  "states, %d transitions), %d seeded bugs re-found, "
+                  "%d problem(s)\n"
+                  % (models_clean, total_states, total_edges,
+                     bugs_refound, len(findings)))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,177 @@
+"""State-machine DSL for the protocol models.
+
+A model is a set of *guarded atomic actions* over a single shared state
+dict.  There is no separate process object: a "process" is a naming
+convention (actions named ``"w1.publish"`` belong to process ``w1``) plus
+an optional symmetry declaration saying which processes are
+interchangeable.  This keeps the DSL honest about what explicit-state
+checking actually explores — one flat transition relation — while still
+letting models read like per-process pseudocode.
+
+State values must be hashable after :func:`freeze` (ints, bools, strings,
+tuples, frozensets, or nested dicts thereof).  Effects receive a deep
+copy and mutate it in place; the explorer freezes the result for hashing,
+so models never worry about aliasing.
+"""
+
+import copy
+import itertools
+
+
+def freeze(value):
+    """Recursively convert a state value into a hashable canonical form.
+
+    Dicts become sorted (key, value) tuples, lists/tuples become tuples,
+    sets become frozensets of frozen elements.  Used both for the visited
+    set and for symmetry canonicalization (min over permuted freezings).
+    """
+    if isinstance(value, dict):
+        return tuple(sorted((k, freeze(v)) for k, v in value.items()))
+    if isinstance(value, (list, tuple)):
+        return tuple(freeze(v) for v in value)
+    if isinstance(value, (set, frozenset)):
+        return frozenset(freeze(v) for v in value)
+    return value
+
+
+class Action(object):
+    """One guarded atomic step.
+
+    ``guard(state) -> bool`` decides enabledness; ``effect(state)``
+    mutates a private copy.  ``progress=True`` marks actions that
+    represent real forward progress for liveness purposes: a reachable
+    cycle that uses only non-progress actions while the model is not
+    ``done`` is reported as a livelock (e.g. the coordinator spinning
+    fast cycles forever while a tensor never clears negotiation).
+    """
+
+    __slots__ = ("name", "guard", "effect", "progress")
+
+    def __init__(self, name, guard, effect, progress=False):
+        self.name = name
+        self.guard = guard
+        self.effect = effect
+        self.progress = progress
+
+    def __repr__(self):
+        return "Action(%r)" % (self.name,)
+
+
+class Invariant(object):
+    """A safety predicate checked in every reachable state.
+
+    ``code_ref`` anchors the property to the real implementation
+    (``"horovod_tpu/native/controller.cc:449"``) so a violation report
+    points at the code whose behavior the invariant abstracts.
+    """
+
+    __slots__ = ("name", "pred", "detail", "code_ref")
+
+    def __init__(self, name, pred, detail="", code_ref=""):
+        self.name = name
+        self.pred = pred
+        self.detail = detail
+        self.code_ref = code_ref
+
+    def __repr__(self):
+        return "Invariant(%r)" % (self.name,)
+
+
+class Model(object):
+    """A closed system: initial state, actions, properties.
+
+    Parameters
+    ----------
+    name: model identifier (``"cache_bits"``).
+    init: initial state dict.
+    actions: list of :class:`Action`.
+    invariants: list of :class:`Invariant` checked in every state.
+    done: predicate marking acceptable terminal states.  A state with no
+        enabled action where ``done`` is false is a deadlock; a
+        no-progress cycle through states where ``done`` is false is a
+        livelock.
+    symmetry: list of process-id lists that are interchangeable
+        (e.g. ``[[1, 2, 3]]`` for worker ranks).  The explorer
+        canonicalizes each state as the minimum freezing over all
+        permutations within each class, collapsing symmetric
+        interleavings.
+    permute: ``permute(state, mapping) -> state`` applying a pid
+        renaming.  The default handles the common layout where
+        per-process values live in dicts keyed by pid; models that store
+        pid *values* inside globals must supply their own.
+    source: path of the module defining the model (for report anchors).
+    """
+
+    def __init__(self, name, init, actions, invariants=(), done=None,
+                 symmetry=(), permute=None, source=""):
+        self.name = name
+        self.init = init
+        self.actions = list(actions)
+        self.invariants = list(invariants)
+        self.done = done if done is not None else (lambda s: True)
+        self.symmetry = [list(cls) for cls in symmetry]
+        self._permute = permute
+        self.source = source
+
+    # -- symmetry ---------------------------------------------------------
+
+    def permutations(self):
+        """Yield pid->pid mappings for the full symmetry group (incl. id)."""
+        if not self.symmetry:
+            yield {}
+            return
+        per_class = []
+        for cls in self.symmetry:
+            per_class.append([dict(zip(cls, perm))
+                              for perm in itertools.permutations(cls)])
+        for combo in itertools.product(*per_class):
+            mapping = {}
+            for m in combo:
+                mapping.update(m)
+            yield mapping
+
+    def permute(self, state, mapping):
+        if not mapping or all(k == v for k, v in mapping.items()):
+            return state
+        if self._permute is not None:
+            return self._permute(state, mapping)
+        return default_permute(state, mapping)
+
+    def canon(self, state):
+        """Canonical hashable form: min freezing over the symmetry group."""
+        if not self.symmetry:
+            return freeze(state)
+        return min(freeze(self.permute(state, m))
+                   for m in self.permutations())
+
+    # -- execution --------------------------------------------------------
+
+    def enabled(self, state):
+        return [a for a in self.actions if a.guard(state)]
+
+    def step(self, state, action):
+        nxt = copy.deepcopy(state)
+        action.effect(nxt)
+        return nxt
+
+
+def default_permute(state, mapping):
+    """Permute a state whose per-process values live in pid-keyed dicts.
+
+    Any dict (at any nesting level) whose keys are all ints is treated as
+    pid-indexed and re-keyed through ``mapping``; everything else is
+    copied through.  Pid values stored elsewhere (e.g. a global holding
+    "the rank that won") need a model-specific permute.
+    """
+    def walk(v):
+        if isinstance(v, dict):
+            if v and all(isinstance(k, int) for k in v):
+                return {mapping.get(k, k): walk(val) for k, val in v.items()}
+            return {k: walk(val) for k, val in v.items()}
+        if isinstance(v, list):
+            return [walk(x) for x in v]
+        if isinstance(v, (set, frozenset)):
+            return type(v)(walk(x) for x in v)
+        return v
+
+    return walk(state)

@@ -1,0 +1,244 @@
+"""Breadth-first explicit-state explorer.
+
+BFS (rather than DFS) is deliberate: the first time a violating state is
+dequeued, the path to it is a shortest path, so every counterexample
+trace is minimal by construction — no separate trace-minimization pass.
+Canonical hashing with symmetry reduction (``Model.canon``) collapses
+states that differ only by a permutation of interchangeable ranks, which
+is what keeps 3–4-rank models in the low thousands of states.
+
+Three property classes are checked:
+
+- **invariants** — every dequeued state is run through every
+  ``Invariant``; a failure is reported with the minimal trace.
+- **deadlock** — a state with no enabled action where ``done`` is false.
+- **livelock** — after exploration, a reachable cycle whose edges are all
+  non-``progress`` actions through states where ``done`` is false: the
+  system can run forever without anything real happening (e.g. the
+  coordinator ticking fast cycles while a tensor never clears
+  negotiation).
+"""
+
+import collections
+import time
+
+from .dsl import freeze
+
+
+class Violation(object):
+    """One property failure with its minimal counterexample.
+
+    ``trace`` is a list of action names from the initial state; ``state``
+    is the offending state (for livelock, a state on the cycle and
+    ``cycle`` holds the repeating action suffix).
+    """
+
+    __slots__ = ("kind", "message", "invariant", "trace", "state", "cycle")
+
+    def __init__(self, kind, message, trace, state,
+                 invariant=None, cycle=None):
+        self.kind = kind
+        self.message = message
+        self.invariant = invariant
+        self.trace = list(trace)
+        self.state = state
+        self.cycle = list(cycle) if cycle else []
+
+    def __repr__(self):
+        return "Violation(%s, %r, %d steps)" % (
+            self.kind, self.message, len(self.trace))
+
+
+class BudgetExceeded(Exception):
+    """Raised when exploration exceeds ``max_states``.
+
+    A shipped model hitting this is itself a bug: the models are designed
+    to close in well under the CI budget (see tests/test_model.py).
+    """
+
+
+ExploreResult = collections.namedtuple(
+    "ExploreResult",
+    [
+        "model",        # the Model explored
+        "num_states",   # canonical (symmetry-reduced) reachable states
+        "num_edges",    # explored transitions
+        "violations",   # list of Violation, minimal-trace-first
+        "complete",     # False if stopped early at a violation
+        "elapsed",      # wall seconds
+    ],
+)
+
+
+def explore(model, max_states=200000, stop_at_first=True,
+            check_liveness=True):
+    """Exhaustively explore ``model``; return an :class:`ExploreResult`.
+
+    With ``stop_at_first`` (the default) exploration stops at the first
+    safety violation — BFS order guarantees its trace is minimal.  Pass
+    ``False`` to keep going and collect every distinct violating state.
+    """
+    start = time.monotonic()
+    init = model.init
+    init_key = model.canon(init)
+
+    states = {init_key: init}             # canonical key -> representative
+    parent = {init_key: None}             # key -> (parent_key, action name)
+    edges = collections.defaultdict(list)  # key -> [(name, succ, progress)]
+    queue = collections.deque([init_key])
+    violations = []
+    num_edges = 0
+
+    def trace_to(key):
+        names = []
+        cur = key
+        while parent[cur] is not None:
+            prev, name = parent[cur]
+            names.append(name)
+            cur = prev
+        names.reverse()
+        return names
+
+    while queue:
+        key = queue.popleft()
+        state = states[key]
+
+        for inv in model.invariants:
+            if not inv.pred(state):
+                violations.append(Violation(
+                    "invariant",
+                    "invariant %r violated%s" % (
+                        inv.name,
+                        " (%s)" % inv.detail if inv.detail else ""),
+                    trace_to(key), state, invariant=inv))
+                if stop_at_first:
+                    return ExploreResult(
+                        model, len(states), num_edges, violations,
+                        False, time.monotonic() - start)
+
+        enabled = model.enabled(state)
+        if not enabled:
+            if not model.done(state):
+                violations.append(Violation(
+                    "deadlock",
+                    "no action enabled and the protocol is not done",
+                    trace_to(key), state))
+                if stop_at_first:
+                    return ExploreResult(
+                        model, len(states), num_edges, violations,
+                        False, time.monotonic() - start)
+            continue
+
+        for action in enabled:
+            succ = model.step(state, action)
+            succ_key = model.canon(succ)
+            num_edges += 1
+            edges[key].append((action.name, succ_key, action.progress))
+            if succ_key not in states:
+                if len(states) >= max_states:
+                    raise BudgetExceeded(
+                        "model %r exceeded %d states" % (
+                            model.name, max_states))
+                states[succ_key] = succ
+                parent[succ_key] = (key, action.name)
+                queue.append(succ_key)
+
+    if check_liveness and not violations:
+        lv = _find_livelock(model, states, edges, trace_to)
+        if lv is not None:
+            violations.append(lv)
+
+    return ExploreResult(model, len(states), num_edges, violations,
+                         True, time.monotonic() - start)
+
+
+def _find_livelock(model, states, edges, trace_to):
+    """Find a reachable no-progress cycle through not-``done`` states.
+
+    Iterative three-color DFS over the subgraph restricted to
+    non-progress edges between states where ``done`` is false.  The first
+    back edge closes a cycle the system can traverse forever without a
+    single progress action firing.
+    """
+    sub = {}
+    for key, outs in edges.items():
+        if model.done(states[key]):
+            continue
+        nexts = [(name, succ) for (name, succ, progress) in outs
+                 if not progress and succ in states
+                 and not model.done(states[succ])]
+        if nexts:
+            sub[key] = nexts
+
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = collections.defaultdict(int)
+    on_path = []          # stack of (key, action-name-into-key)
+    on_path_pos = {}
+
+    for root in sub:
+        if color[root] != WHITE:
+            continue
+        stack = [(root, None, iter(sub.get(root, ())))]
+        on_path = [(root, None)]
+        on_path_pos = {root: 0}
+        color[root] = GREY
+        while stack:
+            key, _, it = stack[-1]
+            advanced = False
+            for name, succ in it:
+                if color[succ] == GREY:
+                    # Cycle: from succ's position on the path back to key,
+                    # then the closing edge `name`.
+                    pos = on_path_pos[succ]
+                    cycle_names = [n for (_, n) in on_path[pos + 1:]]
+                    cycle_names.append(name)
+                    return Violation(
+                        "livelock",
+                        "no-progress cycle: the system can run forever "
+                        "without completing (actions repeat: %s)"
+                        % ", ".join(cycle_names),
+                        trace_to(succ), states[succ], cycle=cycle_names)
+                if color[succ] == WHITE:
+                    color[succ] = GREY
+                    stack.append((succ, name, iter(sub.get(succ, ()))))
+                    on_path.append((succ, name))
+                    on_path_pos[succ] = len(on_path) - 1
+                    advanced = True
+                    break
+            if not advanced:
+                done_key, _, _ = stack.pop()
+                color[done_key] = BLACK
+                popped = on_path.pop()
+                on_path_pos.pop(popped[0], None)
+    return None
+
+
+def format_state(state, indent="    "):
+    """Pretty-print a state dict for human trace output."""
+    lines = []
+    for k in sorted(state):
+        lines.append("%s%s = %r" % (indent, k, state[k]))
+    return "\n".join(lines)
+
+
+def replay(model, trace):
+    """Re-execute a trace (list of action names) from init; return states.
+
+    Used by the human reporter to show the state after every step of a
+    counterexample, and by tests to assert traces stay executable.
+    """
+    by_name = {a.name: a for a in model.actions}
+    state = model.init
+    out = [state]
+    for name in trace:
+        action = by_name[name]
+        if not action.guard(state):
+            raise ValueError("trace step %r not enabled" % (name,))
+        state = model.step(state, action)
+        out.append(state)
+    return out
+
+
+def assert_frozen_equal(a, b):
+    """Helper for tests: compare two states modulo freezing."""
+    return freeze(a) == freeze(b)

@@ -1,0 +1,32 @@
+"""The shipped protocol models and their seeded historical-bug variants.
+
+Each module exports ``build(ranks=None, bug=None)`` returning a
+:class:`~horovod_tpu.lint.model.dsl.Model`.  ``bug`` selects a
+"revert the fix in-model" variant; the registry records, per bug, the
+violation kind the checker is required to re-find (these are the CI
+regressions for the historical bugs logged in CHANGES.md).
+"""
+
+import collections
+
+from . import cache_bits, drain, group_ring, rendezvous, shm_ring
+from ._bugspec import BugSpec  # noqa: F401  (re-exported)
+
+ModelSpec = collections.namedtuple(
+    "ModelSpec",
+    ["name", "build", "clean_builds", "bugs", "default_ranks",
+     "rank_range", "description"])
+
+
+def _spec(mod):
+    # ``clean_builds(ranks)`` returns every fixed model a module ships
+    # (some protocols carry a sub-protocol, e.g. drain's sticky slots).
+    clean = getattr(mod, "clean_builds",
+                    lambda ranks=None, _m=mod: [_m.build(ranks)])
+    return ModelSpec(mod.NAME, mod.build, clean, mod.BUGS,
+                     mod.DEFAULT_RANKS, mod.RANK_RANGE, mod.DESCRIPTION)
+
+
+MODELS = collections.OrderedDict(
+    (mod.NAME, _spec(mod))
+    for mod in (cache_bits, drain, rendezvous, shm_ring, group_ring))

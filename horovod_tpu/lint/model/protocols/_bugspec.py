@@ -1,0 +1,12 @@
+"""Shared BugSpec record for the seeded historical-bug variants.
+
+Kept in its own module so protocol modules can import it without going
+through ``protocols/__init__`` (which imports them — a cycle otherwise).
+``kind`` is the violation class the checker is REQUIRED to re-find when
+the bug variant is explored: ``"deadlock"``, ``"invariant"``, or
+``"livelock"``.
+"""
+
+import collections
+
+BugSpec = collections.namedtuple("BugSpec", ["kind", "description"])

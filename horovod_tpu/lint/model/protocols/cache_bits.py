@@ -1,0 +1,296 @@
+"""Response-cache bit-sync protocol with group FOREIGN placeholders and
+the rearm-epoch bootstrap (PR 9/10).
+
+What is modeled
+---------------
+Rank 0 is the coordinator.  One process group ``g`` spans every rank but
+the coordinator; every rank registers the group locally (``new_group`` is
+called on all ranks, registration is per-process and unsynchronized).
+Two tensors negotiate: ``e1`` (a group tensor of ``g``) and ``e2`` (a
+world tensor).  After negotiation, each rank mirrors cache entries in
+response-broadcast order and the steady-state bit protocol runs: each
+cycle ANDs per-position hit bits across ranks and executes the agreed
+positions.  The autotuner's rearm-epoch bootstrap rides the same loop.
+
+Real-code anchors for the invariants and actions:
+
+- late-registration sweep: horovod_tpu/native/controller.cc:451-460
+  (pending group tensors re-checked once ``group_table_->Size`` resolves;
+  ``ShouldForceFullCycle`` keeps full cycles coming).
+- FOREIGN placeholders: horovod_tpu/native/response_cache.h:63 (mirror
+  on non-members), :79 (``NonMemberBits`` vacuous-ready), :18-20 (the
+  cross-rank AND must span exactly the members).
+- rearm-epoch bootstrap: horovod_tpu/native/controller.cc:650-651
+  (``RearmPending`` forces ``set_uncached_in_queue(true)`` so the
+  (epoch, profile) wire word rides a full-cycle broadcast).
+
+Seeded historical bugs (revert the fix in-model):
+
+- ``late_registration`` — drop the re-check sweep.  Schedule: both
+  members register + announce before the coordinator registers; the
+  pending entry is only examined on announcement arrival, all
+  announcements have already arrived → the op never goes ready →
+  **deadlock** (the PR 10 hang).
+- ``no_foreign`` — non-members do not mirror group entries.  Their cache
+  table is shorter, so bit position 0 decodes to ``e2`` on the
+  coordinator but ``e1`` on the members; the AND still agrees (each rank
+  has a genuine hit at position 0) and the fast path executes different
+  tensors on different ranks → **invariant** ``decode-agreement``.
+- ``rearm_no_force`` — rearm does not break the all-cached fast path.
+  Once every tensor is cached only fast cycles fire, the epoch word
+  never rides a broadcast, and the tuner's re-arm spins forever →
+  **livelock** (no-progress cycle of idle fast cycles).
+"""
+
+import collections
+
+from ..dsl import Action, Invariant, Model
+
+NAME = "cache_bits"
+DESCRIPTION = ("response-cache bit sync: group registration race, FOREIGN "
+               "placeholders, rearm-epoch bootstrap")
+DEFAULT_RANKS = 3
+RANK_RANGE = (2, 4)
+
+from ._bugspec import BugSpec
+
+BUGS = collections.OrderedDict([
+    ("late_registration", BugSpec(
+        "deadlock",
+        "PR 10 hang: member announcements arrive before the coordinator "
+        "registers the group and no sweep re-checks pending entries")),
+    ("no_foreign", BugSpec(
+        "invariant",
+        "missing FOREIGN placeholders misalign bit positions; a fast "
+        "cycle decodes the same agreed bit to different tensors")),
+    ("rearm_no_force", BugSpec(
+        "livelock",
+        "rearm does not force a full cycle; the epoch word never ships "
+        "while the all-cached fast path spins")),
+])
+
+E1, E2 = "e1", "e2"
+
+
+def build(ranks=None, bug=None):
+    n = DEFAULT_RANKS if ranks is None else int(ranks)
+    if not (RANK_RANGE[0] <= n <= RANK_RANGE[1]):
+        raise ValueError("cache_bits supports %d-%d ranks" % RANK_RANGE)
+    if bug is not None and bug not in BUGS:
+        raise ValueError("unknown bug %r" % (bug,))
+
+    coord = 0
+    members = list(range(1, n))          # e1's group: everyone but rank 0
+    all_ranks = list(range(n))
+
+    init = {
+        "reg": {r: False for r in all_ranks},     # new_group called
+        "ann": {r: False for r in members},       # e1 announced
+        "arrived": 0,                             # announcements at coord
+        "ready": False,                           # e1 fully counted
+        "responded": False,                       # e1 response broadcast
+        "deliv1": {r: False for r in all_ranks},  # e1 response received
+        "deliv2": {r: False for r in all_ranks},  # e2 response received
+        "table": {r: () for r in all_ranks},      # cache insertion order
+        "want": {r: frozenset() for r in all_ranks},  # queued cached work
+        "epoch": {r: 0 for r in all_ranks},       # applied tuning epoch
+        "rearm_pending": False,
+        "rearm_target": 0,
+    }
+
+    def is_member(r):
+        return r != coord
+
+    def all_delivered(s):
+        return all(s["deliv2"][r] for r in all_ranks)
+
+    # -- phase 1: registration + announcement race -----------------------
+
+    def mk_register(r):
+        def effect(s):
+            s["reg"][r] = True
+            # Registering the group on the coordinator does NOT by itself
+            # re-examine pending entries — that is the sweep's job
+            # (controller.cc:451-460), which is exactly what the
+            # late_registration bug removes.
+        return Action("reg%d.new_group" % r,
+                      lambda s: not s["reg"][r], effect)
+
+    def mk_announce(r):
+        def guard(s):
+            return s["reg"][r] and not s["ann"][r]
+
+        def effect(s):
+            s["ann"][r] = True
+            s["arrived"] += 1
+            # IncrementTensorCount at arrival: only resolves the member
+            # set if the coordinator's own registry knows the group.
+            if s["reg"][coord] and s["arrived"] == len(members):
+                s["ready"] = True
+        return Action("w%d.announce" % r, guard, effect)
+
+    def sweep_guard(s):
+        return (s["reg"][coord] and s["arrived"] == len(members)
+                and not s["ready"])
+
+    def sweep_effect(s):
+        s["ready"] = True
+
+    def respond_effect(s):
+        s["responded"] = True
+
+    def mk_deliver1(r):
+        def guard(s):
+            return s["responded"] and not s["deliv1"][r]
+
+        def effect(s):
+            s["deliv1"][r] = True
+            if is_member(r):
+                s["table"][r] = s["table"][r] + (E1,)
+                # each member wants one cached re-execution of e1
+                s["want"][r] = s["want"][r] | {E1}
+            elif bug != "no_foreign":
+                # response_cache.h:63 — non-members mirror a FOREIGN
+                # placeholder so positions stay aligned.
+                s["table"][r] = s["table"][r] + (E1,)
+        return Action("r%d.deliver_e1" % r, guard, effect, progress=True)
+
+    def mk_deliver2(r):
+        def guard(s):
+            return s["deliv1"][r] and not s["deliv2"][r]
+
+        def effect(s):
+            s["deliv2"][r] = True
+            s["table"][r] = s["table"][r] + (E2,)
+            s["want"][r] = s["want"][r] | {E2}
+        return Action("r%d.deliver_e2" % r, guard, effect, progress=True)
+
+    # -- phase 2: steady-state bit cycles --------------------------------
+
+    def bit(s, r, p):
+        """Rank r's reported hit bit for its table position p."""
+        entry = s["table"][r][p]
+        if is_member_of(entry, r):
+            return 1 if entry in s["want"][r] else 0
+        # FOREIGN placeholder: vacuously ready (response_cache.h:79).
+        return 1
+
+    def is_member_of(entry, r):
+        return entry == E2 or (entry == E1 and r != coord)
+
+    def agreed_positions(s):
+        width = min(len(s["table"][r]) for r in all_ranks)
+        out = []
+        for p in range(width):
+            if all(bit(s, r, p) for r in all_ranks):
+                out.append(p)
+        return out
+
+    def fast_guard(s):
+        if not all_delivered(s):
+            return False
+        if not any(s["want"][r] for r in all_ranks):
+            return False
+        if bug == "rearm_no_force":
+            # fast path fires regardless of a pending rearm
+            return bool(agreed_positions(s))
+        if s["rearm_pending"]:
+            # controller.cc:650-651 — a pending rearm forces the full
+            # cycle; the fast path is broken until the epoch ships.
+            return False
+        return bool(agreed_positions(s))
+
+    def fast_effect(s):
+        decoded = {}
+        for p in agreed_positions(s):
+            for r in all_ranks:
+                entry = s["table"][r][p]
+                decoded.setdefault(r, []).append(entry)
+                if is_member_of(entry, r):
+                    s["want"][r] = s["want"][r] - {entry}
+        s["last_decoded"] = {r: tuple(v) for r, v in decoded.items()}
+
+    def rearm_guard(s):
+        return (all_delivered(s) and not s["rearm_pending"]
+                and s["rearm_target"] == 0)
+
+    def rearm_effect(s):
+        s["rearm_pending"] = True
+        s["rearm_target"] = 1
+
+    def full_guard(s):
+        if bug == "rearm_no_force":
+            return False
+        return all_delivered(s) and s["rearm_pending"]
+
+    def full_effect(s):
+        # the (epoch, profile) word rides the full-cycle broadcast and is
+        # applied in rank-lockstep (controller.cc:650-663)
+        for r in all_ranks:
+            s["epoch"][r] = s["rearm_target"]
+        s["rearm_pending"] = False
+
+    def idle_tick_guard(s):
+        # rearm_no_force only: the all-cached steady state keeps ticking
+        # fast cycles that carry nothing — the no-progress loop.
+        return (bug == "rearm_no_force" and all_delivered(s)
+                and s["rearm_pending"]
+                and not any(s["want"][r] for r in all_ranks))
+
+    def idle_tick_effect(s):
+        pass
+
+    actions = []
+    for r in all_ranks:
+        actions.append(mk_register(r))
+    for r in members:
+        actions.append(mk_announce(r))
+    if bug != "late_registration":
+        actions.append(Action("coord.sweep_pending", sweep_guard,
+                              sweep_effect))
+    actions.append(Action("coord.respond_e1",
+                          lambda s: s["ready"] and not s["responded"],
+                          respond_effect, progress=True))
+    for r in all_ranks:
+        actions.append(mk_deliver1(r))
+        actions.append(mk_deliver2(r))
+    actions.append(Action("cycle.fast", fast_guard, fast_effect,
+                          progress=True))
+    actions.append(Action("tuner.rearm", rearm_guard, rearm_effect))
+    actions.append(Action("cycle.full_rearm", full_guard, full_effect,
+                          progress=True))
+    actions.append(Action("cycle.idle_tick", idle_tick_guard,
+                          idle_tick_effect))
+
+    invariants = [
+        Invariant(
+            "no-premature-response",
+            lambda s: (not s["ready"]
+                       or (s["reg"][coord]
+                           and s["arrived"] == len(members))),
+            "an op goes ready only after the coordinator's registry "
+            "resolves the group and every member announced",
+            "horovod_tpu/native/controller.cc:457"),
+        Invariant(
+            "decode-agreement",
+            lambda s: len(set(s.get("last_decoded", {}).values()
+                              or [()])) <= 1,
+            "every rank must decode an agreed bit position to the same "
+            "tensor — FOREIGN placeholders keep the tables aligned",
+            "horovod_tpu/native/response_cache.h:18"),
+        Invariant(
+            "epoch-lockstep",
+            lambda s: len(set(s["epoch"].values())) == 1,
+            "tuning epochs apply in rank-lockstep via the full-cycle "
+            "broadcast",
+            "horovod_tpu/native/controller.cc:650"),
+    ]
+
+    def done(s):
+        return (all_delivered(s)
+                and not any(s["want"][r] for r in all_ranks)
+                and not s["rearm_pending"])
+
+    return Model(NAME if bug is None else "%s[%s]" % (NAME, bug),
+                 init, actions, invariants, done,
+                 symmetry=[members], source=__file__)

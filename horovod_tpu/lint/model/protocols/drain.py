@@ -1,0 +1,295 @@
+"""Elastic drain agreement: KV publish → indicator-allreduce → sticky
+force-write (PR 7, hardened in PR 13's satellite fix).
+
+Two models share this module:
+
+``drain`` (this file's ``build``) — the agreement protocol itself.
+A drain record is published to the KV at an arbitrary moment; each rank's
+local KV poll is rate-limited, so ranks see it at different commits.  The
+fix under test: the drain *decision* is never taken from the local poll
+alone — an indicator allreduce rides EVERY commit (commits are the
+elastic contract's rank-uniform points), all ranks act on the OR'd
+result at the same commit, and a rank whose own poll missed the record
+re-reads the KV synchronously (a peer proved it exists).
+
+Real-code anchors:
+
+- horovod_tpu/elastic/run.py:140-191 — ``poll_drain_agreement``:
+  rate-limited local read (:156-158), ``_hvd_drain_poll`` indicator
+  allreduce at every commit (:162-166), ``agreed < 0.5`` (:167),
+  bounded synchronous re-read when a peer agreed (:170-178).
+- horovod_tpu/elastic/run.py:417 — the agreed drain force-enqueues the
+  sticky snapshot at that same commit.
+
+Seeded bug ``local_poll`` — revert to acting on the local poll alone.
+The rank that saw the record drains immediately; a peer that has not
+seen it yet enters the next training allreduce and waits for the drained
+rank forever → **deadlock** (the pre-PR-7 behavior the agreement was
+built to kill).  The ``drain-step-uniform`` invariant additionally pins
+the contract the fix restores.
+
+``build_sticky`` — the sticky snapshot slots in the durable writer.
+The drain's force-enqueued snapshot must survive newer stickies: the
+writer thread drains slots at its own pace with a bounded write budget,
+so "latest wins" on a single slot lets a newer sticky displace the
+first one before it was ever written — two ranks then write disjoint
+sticky steps, no step is written by ALL ranks, and no manifest can
+anchor (ranks anti-align).  The fix pins the OLDEST unwritten sticky
+(``_sticky_head``, capture decided at enqueue = rank-uniform) and keeps
+latest-wins only among newer stickies (``_sticky_next``).
+
+Real-code anchors: horovod_tpu/elastic/durable.py:544-559 (slot
+contract), :635-659 (``maybe_enqueue``), :670-690 (``force_enqueue``),
+:707-710 (writer dequeue: head, then promote next).
+
+Seeded bug ``sticky_displacement`` — collapse head/next back to a single
+latest-wins slot → terminal states where the ranks' written sticky sets
+have an empty intersection → **invariant** ``common-written-sticky``.
+"""
+
+import collections
+
+from ..dsl import Action, Invariant, Model
+from ._bugspec import BugSpec
+
+NAME = "drain"
+DESCRIPTION = ("elastic drain agreement: rate-limited KV poll + "
+               "indicator allreduce at every commit")
+DEFAULT_RANKS = 3
+RANK_RANGE = (2, 4)
+COMMITS = 2  # bounded horizon: enough for every see/miss split
+
+BUGS = collections.OrderedDict([
+    ("local_poll", BugSpec(
+        "deadlock",
+        "acting on the local KV poll alone: the seeing rank drains "
+        "while a peer waits in the next allreduce forever")),
+    ("sticky_displacement", BugSpec(
+        "invariant",
+        "single latest-wins sticky slot: a newer sticky displaces the "
+        "unwritten first one; ranks write disjoint sticky steps and no "
+        "manifest can anchor")),
+])
+
+RUN, DRAINED, FINISHED = "run", "drained", "finished"
+
+
+def build(ranks=None, bug=None):
+    if bug == "sticky_displacement":
+        return build_sticky(ranks)
+    n = DEFAULT_RANKS if ranks is None else int(ranks)
+    if not (RANK_RANGE[0] <= n <= RANK_RANGE[1]):
+        raise ValueError("drain supports %d-%d ranks" % RANK_RANGE)
+    if bug is not None and bug not in BUGS:
+        raise ValueError("unknown bug %r" % (bug,))
+    all_ranks = list(range(n))
+
+    init = {
+        "published": False,
+        "seen": {r: False for r in all_ranks},
+        "status": {r: RUN for r in all_ranks},
+        "step": {r: 1 for r in all_ranks},        # commit being entered
+        "contributed": {r: False for r in all_ranks},
+        "indicator": {r: 0 for r in all_ranks},
+        "drain_step": {r: 0 for r in all_ranks},  # 0 = not drained
+    }
+
+    def publish_effect(s):
+        s["published"] = True
+
+    def mk_poll(r):
+        # The rate-limited local KV read (run.py:156-158).  Whether it
+        # lands before a given commit is scheduling nondeterminism —
+        # exactly what the rate limit makes true in production.
+        def guard(s):
+            return (s["published"] and not s["seen"][r]
+                    and s["status"][r] == RUN and not s["contributed"][r])
+
+        def effect(s):
+            s["seen"][r] = True
+        return Action("w%d.poll_kv" % r, guard, effect)
+
+    if bug == "local_poll":
+        def mk_decide(r):
+            # BUG: the commit-time decision uses only the local poll.
+            def guard(s):
+                return s["status"][r] == RUN and not s["contributed"][r]
+
+            def effect(s):
+                if s["seen"][r]:
+                    s["status"][r] = DRAINED
+                    s["drain_step"][r] = s["step"][r]
+                else:
+                    s["contributed"][r] = True
+                    s["indicator"][r] = 0
+            return Action("w%d.commit" % r, guard, effect)
+        arrive_actions = [mk_decide(r) for r in all_ranks]
+    else:
+        def mk_arrive(r):
+            # Fixed: every running rank contributes its indicator to the
+            # commit's allreduce unconditionally (run.py:162-166).
+            def guard(s):
+                return s["status"][r] == RUN and not s["contributed"][r]
+
+            def effect(s):
+                s["contributed"][r] = True
+                s["indicator"][r] = 1 if s["seen"][r] else 0
+            return Action("w%d.commit" % r, guard, effect)
+        arrive_actions = [mk_arrive(r) for r in all_ranks]
+
+    def resolve_guard(s):
+        running = [r for r in all_ranks if s["status"][r] == RUN]
+        # The ring's membership is fixed until re-bootstrap: the
+        # allreduce completes only when EVERY rank arrived — a drained
+        # rank never will, which is precisely the hang the agreement
+        # prevents.
+        return (bool(running)
+                and all(s["status"][r] == RUN for r in all_ranks)
+                and all(s["contributed"][r] for r in running))
+
+    def resolve_effect(s):
+        agreed = any(s["indicator"][r] for r in all_ranks)
+        for r in all_ranks:
+            s["contributed"][r] = False
+            s["indicator"][r] = 0
+            if bug != "local_poll" and agreed:
+                # run.py:170-178 — a rank that agreed without seeing the
+                # record re-reads the KV synchronously (bounded): the
+                # record is committed before any peer can report it.
+                s["seen"][r] = True
+                s["status"][r] = DRAINED
+                s["drain_step"][r] = s["step"][r]
+            elif s["step"][r] == COMMITS:
+                s["status"][r] = FINISHED
+            else:
+                s["step"][r] += 1
+
+    actions = [Action("driver.publish_record",
+                      lambda s: not s["published"], publish_effect)]
+    actions.extend(mk_poll(r) for r in all_ranks)
+    actions.extend(arrive_actions)
+    actions.append(Action("ring.allreduce", resolve_guard, resolve_effect,
+                          progress=True))
+
+    invariants = [
+        Invariant(
+            "drain-step-uniform",
+            lambda s: len({s["drain_step"][r] for r in all_ranks
+                           if s["status"][r] == DRAINED}) <= 1,
+            "every rank drains at the same commit — the agreement is "
+            "taken from the allreduced indicator, not the local poll",
+            "horovod_tpu/elastic/run.py:162"),
+        Invariant(
+            "drain-implies-record",
+            lambda s: all(s["seen"][r] for r in all_ranks
+                          if s["status"][r] == DRAINED),
+            "a draining rank has read the drain record (post-agreement "
+            "bounded re-read closes the gap)",
+            "horovod_tpu/elastic/run.py:170"),
+    ]
+
+    def done(s):
+        st = {s["status"][r] for r in all_ranks}
+        if st == {DRAINED}:
+            return len({s["drain_step"][r] for r in all_ranks}) == 1
+        return st == {FINISHED}
+
+    return Model(NAME if bug is None else "%s[%s]" % (NAME, bug),
+                 init, actions, invariants, done,
+                 symmetry=[all_ranks], source=__file__)
+
+
+def clean_builds(ranks=None):
+    """Both fixed models this module ships: the agreement protocol and
+    the durable writer's sticky slots."""
+    return [build(ranks), build_sticky(ranks, bug=None)]
+
+
+# -- sticky snapshot slots (durable writer) ------------------------------
+
+STICKIES = 2     # two sticky snapshots per rank, steps 1 then 2
+BUDGET = 1       # writer budget before terminal: slow storage
+
+
+def build_sticky(ranks=None, bug="sticky_displacement"):
+    """The durable writer's sticky slots; ``bug=None`` for the fixed
+    head/next protocol, ``"sticky_displacement"`` for the single
+    latest-wins slot it replaced."""
+    n = DEFAULT_RANKS if ranks is None else int(ranks)
+    if not (RANK_RANGE[0] <= n <= RANK_RANGE[1]):
+        raise ValueError("drain supports %d-%d ranks" % RANK_RANGE)
+    all_ranks = list(range(n))
+    single_slot = bug == "sticky_displacement"
+
+    init = {
+        "enq": {r: 0 for r in all_ranks},      # stickies enqueued so far
+        "head": {r: 0 for r in all_ranks},     # 0 = empty
+        "nxt": {r: 0 for r in all_ranks},
+        "budget": {r: BUDGET for r in all_ranks},
+        "written": {r: frozenset() for r in all_ranks},
+    }
+
+    def mk_enqueue(r):
+        def guard(s):
+            return s["enq"][r] < STICKIES
+
+        def effect(s):
+            step = s["enq"][r] + 1
+            s["enq"][r] = step
+            if single_slot:
+                # BUG: latest wins outright — may displace an unwritten
+                # earlier sticky.
+                s["head"][r] = step
+            elif s["head"][r] == 0:
+                # durable.py:654-655 — the oldest unwritten sticky is
+                # pinned; its capture is decided at enqueue, which is the
+                # rank-uniform point.
+                s["head"][r] = step
+            else:
+                # durable.py:659 — latest-wins only among NEWER stickies.
+                s["nxt"][r] = step
+        return Action("w%d.enqueue_sticky" % r, guard, effect)
+
+    def mk_write(r):
+        def guard(s):
+            return s["budget"][r] > 0 and s["head"][r] != 0
+
+        def effect(s):
+            step = s["head"][r]
+            s["written"][r] = s["written"][r] | {step}
+            s["budget"][r] -= 1
+            # durable.py:707-710 — dequeue head, promote next.
+            s["head"][r] = s["nxt"][r]
+            s["nxt"][r] = 0
+        return Action("w%d.writer_flush" % r, guard, effect, progress=True)
+
+    def terminal(s):
+        return (all(s["enq"][r] == STICKIES for r in all_ranks)
+                and all(s["budget"][r] == 0 or s["head"][r] == 0
+                        for r in all_ranks))
+
+    def common_written(s):
+        sets = [s["written"][r] for r in all_ranks]
+        inter = sets[0]
+        for w in sets[1:]:
+            inter = inter & w
+        return inter
+
+    actions = []
+    for r in all_ranks:
+        actions.append(mk_enqueue(r))
+        actions.append(mk_write(r))
+
+    invariants = [
+        Invariant(
+            "common-written-sticky",
+            lambda s: not terminal(s) or bool(common_written(s)),
+            "some sticky step is written by EVERY rank once the dust "
+            "settles — the manifest anchor; a displaced unwritten head "
+            "anti-aligns the ranks",
+            "horovod_tpu/elastic/durable.py:544"),
+    ]
+
+    name = "drain[sticky]" if not single_slot else "drain[sticky_displacement]"
+    return Model(name, init, actions, invariants, terminal,
+                 symmetry=[all_ranks], source=__file__)

@@ -1,0 +1,194 @@
+"""Group-ring connection establishment: connect-before-accept with the
+stash/reconnect window (PR 10, round-2 fix).
+
+What is modeled
+---------------
+Three ranks, two overlapping 2-member groups: ``g1 = {0, 1}`` and
+``g2 = {0, 2}``.  Rank 0 builds its rings in op order (g1 then g2 — one
+controller thread).  ``GroupPairConnect`` connects to the ring successor
+FIRST — the TCP backlog completes the connect without the peer accepting,
+so connect never blocks and the connect/accept cycle cannot deadlock
+(horovod_tpu/native/tcp_context.cc:634-660) — then accepts from the
+predecessor.  The accept loop pops whatever connection arrives next:
+group connects are ONE-SHOT on the connector side, so an accepted
+connect belonging to a *different* group (rank 2 racing ahead into
+rank 0's g1 build) must be stashed under ``GroupFdKey(gid, chan, rank)``
+for that group's own build to find (tcp_context.cc:666-671 consume,
+:704-714 stash) — dropping it wedges the later build forever.  The
+round-2 fix extends the same stash to group connects that land inside a
+control-RECONNECT accept window (tcp_context.cc:1081-1085).
+
+Seeded bugs (revert the fix in-model):
+
+- ``no_stash`` — a mismatched group connect accepted during another
+  group's build is dropped.  Rank 2's g2 connect races into rank 0's g1
+  build and is destroyed; rank 2 will never reconnect (one-shot), so
+  rank 0's g2 accept waits forever → **deadlock** (the PR 10 round-1
+  hang).
+- ``reconnect_drop`` — a group connect landing inside rank 0's control
+  reconnect window is closed instead of stashed → same wedge →
+  **deadlock** (the round-2 race).
+"""
+
+import collections
+
+from ..dsl import Action, Invariant, Model
+from ._bugspec import BugSpec
+
+NAME = "group_ring"
+DESCRIPTION = ("group-ring connect-before-accept with the stash for "
+               "cross-group and reconnect-window races")
+DEFAULT_RANKS = 3
+RANK_RANGE = (3, 3)
+
+BUGS = collections.OrderedDict([
+    ("no_stash", BugSpec(
+        "deadlock",
+        "mismatched group connect dropped during another group's "
+        "build: the one-shot connector never retries, the group's own "
+        "build waits forever")),
+    ("reconnect_drop", BugSpec(
+        "deadlock",
+        "group connect landing inside the control reconnect window is "
+        "closed instead of stashed — same wedge, round-2 race")),
+])
+
+G1, G2 = "g1", "g2"
+GROUPS = {G1: (0, 1), G2: (0, 2)}
+# builds: (rank, group) pairs; rank 0 builds g1 before g2 (op order)
+BUILDS = ((0, G1), (1, G1), (0, G2), (2, G2))
+
+
+def _peer(rank, group):
+    a, b = GROUPS[group]
+    return b if rank == a else a
+
+
+def build(ranks=None, bug=None):
+    if ranks is not None and int(ranks) != DEFAULT_RANKS:
+        raise ValueError("group_ring models exactly 3 ranks "
+                         "(two overlapping 2-member groups)")
+    if bug is not None and bug not in BUGS:
+        raise ValueError("unknown bug %r" % (bug,))
+
+    # In no_stash the reconnect window is irrelevant (the round-1 race
+    # already wedges); keep it shut so the counterexample is minimal.
+    recon_active = bug != "no_stash"
+
+    init = {
+        "phase": {b: "todo" for b in BUILDS},
+        "backlog": {r: frozenset() for r in range(3)},
+        "stash": {r: frozenset() for r in range(3)},
+        "recon": "idle" if recon_active else "closed",
+    }
+
+    def match_token(b):
+        rank, group = b
+        return (_peer(rank, group), group)
+
+    def gated(s, b):
+        # rank 0's second build waits for the first (op order)
+        return b == (0, G2) and s["phase"][(0, G1)] != "done"
+
+    def mk_connect(b):
+        rank, group = b
+
+        def guard(s):
+            if s["phase"][b] != "todo" or gated(s, b):
+                return False
+            if b == (0, G1) and s["recon"] == "open":
+                return False        # controller busy in the window
+            return True
+
+        def effect(s):
+            s["phase"][b] = "conn"
+            peer = _peer(rank, group)
+            s["backlog"][peer] = s["backlog"][peer] | {(rank, group)}
+            if b == (0, G1) and s["recon"] == "idle":
+                s["recon"] = "closed"   # window never opened
+        return Action("r%d.connect_%s" % (rank, group), guard, effect)
+
+    def mk_accept_match(b):
+        rank, group = b
+        tok = match_token(b)
+
+        def guard(s):
+            return (s["phase"][b] == "conn"
+                    and (tok in s["backlog"][rank]
+                         or tok in s["stash"][rank]))
+
+        def effect(s):
+            # tcp_context.cc:666-671 — the stash is consulted first
+            if tok in s["stash"][rank]:
+                s["stash"][rank] = s["stash"][rank] - {tok}
+            else:
+                s["backlog"][rank] = s["backlog"][rank] - {tok}
+            s["phase"][b] = "done"
+        return Action("r%d.accept_%s" % (rank, group), guard, effect,
+                      progress=True)
+
+    def mk_accept_other(b, tok):
+        rank, _ = b
+
+        def guard(s):
+            return (s["phase"][b] == "conn"
+                    and tok != match_token(b)
+                    and tok in s["backlog"][rank])
+
+        def effect(s):
+            s["backlog"][rank] = s["backlog"][rank] - {tok}
+            if bug != "no_stash":
+                # tcp_context.cc:704-714 — stash by (group, chan, rank)
+                s["stash"][rank] = s["stash"][rank] | {tok}
+            # else: dropped — the connector is one-shot and never retries
+        label = "drop" if bug == "no_stash" else "stash"
+        return Action("r%d.accept_%s_foreign_r%d_%s"
+                      % (rank, label, tok[0], tok[1]), guard, effect)
+
+    actions = [mk_connect(b) for b in BUILDS]
+    actions += [mk_accept_match(b) for b in BUILDS]
+    # the only cross-group race lands on rank 0: rank 2's g2 connect
+    # arriving during the g1 build
+    actions.append(mk_accept_other((0, G1), (2, G2)))
+
+    if recon_active:
+        def recon_pop_effect(s):
+            tok = (2, G2)
+            s["backlog"][0] = s["backlog"][0] - {tok}
+            if bug != "reconnect_drop":
+                # tcp_context.cc:1081-1085 — round-2 fix: stash group
+                # connects landing inside the reconnect window too
+                s["stash"][0] = s["stash"][0] | {tok}
+
+        actions.append(Action(
+            "r0.reconnect_window_open",
+            lambda s: s["recon"] == "idle"
+            and s["phase"][(0, G1)] == "todo",
+            lambda s: s.update(recon="open")))
+        actions.append(Action(
+            "r0.reconnect_pop_group_connect",
+            lambda s: s["recon"] == "open"
+            and (2, G2) in s["backlog"][0],
+            recon_pop_effect))
+        actions.append(Action(
+            "r0.reconnect_window_close",
+            lambda s: s["recon"] == "open",
+            lambda s: s.update(recon="closed")))
+
+    invariants = [
+        Invariant(
+            "no-connection-invented",
+            lambda s: all(s["phase"][b] != "done"
+                          or match_token(b) not in s["backlog"][b[0]]
+                          for b in BUILDS),
+            "a completed build consumed its peer's one-shot connect — "
+            "it cannot still be pending",
+            "horovod_tpu/native/tcp_context.cc:634"),
+    ]
+
+    def done(s):
+        return all(s["phase"][b] == "done" for b in BUILDS)
+
+    return Model(NAME if bug is None else "%s[%s]" % (NAME, bug),
+                 init, actions, invariants, done, symmetry=(),
+                 source=__file__)

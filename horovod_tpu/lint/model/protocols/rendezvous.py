@@ -1,0 +1,151 @@
+"""Elastic rendezvous generation gating (the PR 4 one-survivor-per-
+generation split).
+
+What is modeled
+---------------
+After a shrink, the driver publishes generation ``N`` and the survivors
+re-bootstrap — but seconds apart (connection-loss detection and
+reconnect windows are not synchronized across ranks).  Each survivor
+fetches its assignment, which names the CURRENT published generation,
+and then waits in that generation's rendezvous.  Meanwhile a blacklist
+cooldown can expire inside that gap: a respawned host asks for a grow
+generation ``N+1``.
+
+The fix under test: the driver's growth gate ``_generation_ready`` —
+a grow generation is only published once the current generation's
+rendezvous has resolved (or provably stalled, which bumps it anyway; the
+stall path is outside this bounded model).  Without the gate, one
+survivor fetches ``N`` and the other ``N+1``; each waits in a rendezvous
+the other will never join, and both time out.
+
+Real-code anchors:
+
+- horovod_tpu/elastic/driver.py:213-227 — ``_generation_ready`` and the
+  comment narrating exactly this failure.
+- horovod_tpu/elastic/driver.py:616-618 — growth planned only when ready.
+- horovod_tpu/elastic/run.py:204 — ``fetch_assignment`` (the fetch that
+  binds a survivor to whatever generation is published at that instant).
+
+Seeded bug ``ungated_growth`` — remove the gate: the respawn may bump
+the published generation between the two survivors' fetches.  The
+``no-generation-split`` invariant (both survivors, once waiting, wait in
+the SAME generation) fires with a minimal trace; the same schedule also
+deadlocks (neither rendezvous can ever resolve).
+"""
+
+import collections
+
+from ..dsl import Action, Invariant, Model
+from ._bugspec import BugSpec
+
+NAME = "rendezvous"
+DESCRIPTION = ("post-shrink re-bootstrap vs. grow-generation publish: "
+               "the _generation_ready gate")
+DEFAULT_RANKS = 2          # survivors of the shrink
+RANK_RANGE = (2, 3)
+
+BUGS = collections.OrderedDict([
+    ("ungated_growth", BugSpec(
+        "invariant",
+        "grow generation published between the survivors' bootstraps: "
+        "one waits in gen N, the other in gen N+1, both time out")),
+])
+
+WAITING = None
+
+
+def build(ranks=None, bug=None):
+    n = DEFAULT_RANKS if ranks is None else int(ranks)
+    if not (RANK_RANGE[0] <= n <= RANK_RANGE[1]):
+        raise ValueError("rendezvous supports %d-%d survivors" % RANK_RANGE)
+    if bug is not None and bug not in BUGS:
+        raise ValueError("unknown bug %r" % (bug,))
+    survivors = list(range(n))
+
+    init = {
+        "pub_gen": 0,                 # generation currently published
+        "fetched": {r: -1 for r in survivors},   # -1 = not yet fetched
+        "arrived": {r: -1 for r in survivors},   # generation waited in
+        "resolved": {0: False, 1: False},
+        "respawn_pending": True,      # blacklist cooldown may expire
+        "new_arrived": False,         # the respawned worker, gen 1 only
+    }
+
+    def mk_fetch(r):
+        # run.py:204 fetch_assignment: binds to the instant's pub_gen.
+        def guard(s):
+            return s["fetched"][r] == -1
+
+        def effect(s):
+            s["fetched"][r] = s["pub_gen"]
+        return Action("s%d.fetch_assignment" % r, guard, effect)
+
+    def mk_arrive(r):
+        def guard(s):
+            return s["fetched"][r] != -1 and s["arrived"][r] == -1
+
+        def effect(s):
+            s["arrived"][r] = s["fetched"][r]
+        return Action("s%d.join_rendezvous" % r, guard, effect)
+
+    def grow_guard(s):
+        if not s["respawn_pending"]:
+            return False
+        if bug == "ungated_growth":
+            return True
+        # driver.py:213-227 — growth gated on the current generation's
+        # rendezvous having resolved.
+        return s["resolved"][s["pub_gen"]]
+
+    def grow_effect(s):
+        s["respawn_pending"] = False
+        s["pub_gen"] = 1
+
+    def resolve0_guard(s):
+        return (not s["resolved"][0]
+                and all(s["arrived"][r] == 0 for r in survivors))
+
+    def resolve1_guard(s):
+        return (not s["resolved"][1] and s["new_arrived"]
+                and all(s["arrived"][r] == 1 for r in survivors))
+
+    def mk_resolve(g, guard):
+        def effect(s):
+            s["resolved"][g] = True
+        return Action("rendezvous.resolve_gen%d" % g, guard, effect,
+                      progress=True)
+
+    def new_arrive_effect(s):
+        s["new_arrived"] = True
+
+    actions = [mk_fetch(r) for r in survivors]
+    actions += [mk_arrive(r) for r in survivors]
+    actions.append(Action("driver.publish_grow_gen", grow_guard,
+                          grow_effect))
+    actions.append(Action("respawn.join_rendezvous",
+                          lambda s: s["pub_gen"] == 1
+                          and not s["new_arrived"],
+                          new_arrive_effect))
+    actions.append(mk_resolve(0, resolve0_guard))
+    actions.append(mk_resolve(1, resolve1_guard))
+
+    invariants = [
+        Invariant(
+            "no-generation-split",
+            lambda s: len({g for g in
+                           (s["arrived"][r] for r in survivors)
+                           if g != -1}) <= 1,
+            "once waiting, all shrink survivors wait in the SAME "
+            "generation's rendezvous — a split strands both sides until "
+            "timeout",
+            "horovod_tpu/elastic/driver.py:213"),
+    ]
+
+    def done(s):
+        # training resumes once some generation's rendezvous resolved
+        # with every survivor in it
+        return s["resolved"][0] or s["resolved"][1]
+
+    return Model(NAME if bug is None else "%s[%s]" % (NAME, bug),
+                 init, actions, invariants, done,
+                 symmetry=[survivors], source=__file__)

@@ -1,0 +1,264 @@
+"""SPSC shm ring: the spin-then-futex waiter-gated wake protocol,
+including the closed-word hangup (PR 15).
+
+What is modeled
+---------------
+One writer, one reader, a ring of capacity 1 carrying 2 items — small
+enough to close exhaustively, large enough that the writer exercises the
+write-side wait path too.  The wake protocol's individual memory
+accesses are separate atomic actions, so the explorer interleaves them
+exactly as two CPUs would under sequential consistency:
+
+- writer publish: occupy a slot → ``data_seq.fetch_add`` →
+  load ``read_waiters`` → conditional ``FutexWake``
+  (horovod_tpu/native/shm_context.cc:302-305; the space-side mirror is
+  :328-330 and is modeled atomically in ``r.consume`` — same protocol,
+  same proof).
+- waiter park: set the waiters flag → load the seq word → recheck
+  emptiness/closed → ``FutexWait(expected=seq)`` where the kernel
+  re-compares the word and refuses to sleep on a stale value
+  (shm_context.cc:369-376 read side, :386-399 write side).
+- close: set ``closed`` → bump BOTH seq words → unconditional wakes
+  (shm_context.cc:250-257); EOF only after the ring drains (:315-317).
+
+The fixed model is the PR 15 hand-proof, mechanized: under SC, either
+the publisher sees the waiter flag (and wakes) or the parking side sees
+the bumped seq (and refuses to sleep).  Spin iterations are not modeled
+— scheduling nondeterminism covers every spin-count outcome.  Futex
+timeouts are also omitted deliberately: the production timeout would
+re-poll and mask a missed wake as latency; the model checks the wake
+protocol proper, where a missed wake is a hang.
+
+Seeded bugs (revert the fix in-model):
+
+- ``missed_wake`` — the writer's ``read_waiters`` load is hoisted above
+  publish+bump (what a relaxed load/store pair permits the hardware to
+  do).  The reader parks in the window, the writer publishes without
+  waking, fills the ring, parks on the space side → both sides asleep →
+  **deadlock**.  This is the exact hazard the seq_cst pairing at
+  shm_context.cc:302-303 forbids (and the lockorder atomics-pairing
+  rule now checks statically).
+- ``no_close_wake`` — ``Close()`` sets the closed word but neither bumps
+  the seqs nor wakes.  A reader that parked just before the hangup never
+  observes EOF → **deadlock** (the closed-word hangup).
+"""
+
+import collections
+
+from ..dsl import Action, Invariant, Model
+from ._bugspec import BugSpec
+
+NAME = "shm_ring"
+DESCRIPTION = ("SPSC shm ring spin-then-futex wake protocol "
+               "(waiter-gated wake, closed-word hangup)")
+DEFAULT_RANKS = 2          # one writer, one reader — SPSC by contract
+RANK_RANGE = (2, 2)
+ITEMS = 2                  # frames the writer ships
+CAP = 1                    # ring capacity: forces the write-side wait
+
+BUGS = collections.OrderedDict([
+    ("missed_wake", BugSpec(
+        "deadlock",
+        "waiters load hoisted above publish+seq-bump: reader parks in "
+        "the window, ring fills, writer parks too — both asleep")),
+    ("no_close_wake", BugSpec(
+        "deadlock",
+        "Close() without seq bumps + unconditional wakes: a reader "
+        "parked just before hangup never sees EOF")),
+])
+
+
+def build(ranks=None, bug=None):
+    if ranks is not None and int(ranks) != 2:
+        raise ValueError("shm_ring is SPSC: exactly 2 processes")
+    if bug is not None and bug not in BUGS:
+        raise ValueError("unknown bug %r" % (bug,))
+
+    init = {
+        "occ": 0, "written": 0, "read": 0,
+        "dseq": 0, "sseq": 0,          # data_seq / space_seq
+        "rw": 0, "ww": 0,              # read_waiters / write_waiters
+        "closed": False,
+        "wpc": "idle", "rpc": "idle",  # program counters
+        "wsaw": 0,                     # bug only: stale waiters load
+        "rexp": 0, "wexp": 0,          # FutexWait expected values
+    }
+
+    def unpark_reader(s):
+        if s["rpc"] == "r_parked":
+            # FutexWake unblocks; the waiter clears its own flag on the
+            # way out (shm_context.cc:376) — collapsed into the unpark.
+            s["rpc"] = "idle"
+            s["rw"] = 0
+
+    def unpark_writer(s):
+        if s["wpc"] == "w_parked":
+            s["wpc"] = "idle"          # shm_context.cc:399
+            s["ww"] = 0
+
+    actions = []
+    add = actions.append
+
+    # -- writer: publish path --------------------------------------------
+
+    def can_start_write(s):
+        return (s["wpc"] == "idle" and s["written"] < ITEMS
+                and not s["closed"])
+
+    if bug == "missed_wake":
+        add(Action(
+            "w.stale_waiter_load",
+            lambda s: can_start_write(s) and s["occ"] < CAP,
+            lambda s: (s.update(wsaw=s["rw"], wpc="w_pub"))))
+        add(Action(
+            "w.publish",
+            lambda s: s["wpc"] == "w_pub",
+            lambda s: s.update(occ=s["occ"] + 1,
+                               written=s["written"] + 1, wpc="w_bump"),
+            progress=True))
+        add(Action(
+            "w.bump_data_seq",
+            lambda s: s["wpc"] == "w_bump",
+            lambda s: s.update(dseq=s["dseq"] + 1, wpc="w_wake")))
+
+        def wake_effect(s):
+            if s["wsaw"]:
+                unpark_reader(s)
+            s["wpc"] = "idle"
+        add(Action("w.wake_if_stale_saw_waiter",
+                   lambda s: s["wpc"] == "w_wake", wake_effect))
+    else:
+        add(Action(
+            "w.publish",
+            lambda s: can_start_write(s) and s["occ"] < CAP,
+            lambda s: s.update(occ=s["occ"] + 1,
+                               written=s["written"] + 1, wpc="w_bump"),
+            progress=True))
+        add(Action(
+            "w.bump_data_seq",          # shm_context.cc:302
+            lambda s: s["wpc"] == "w_bump",
+            lambda s: s.update(dseq=s["dseq"] + 1, wpc="w_wake")))
+
+        def wake_effect(s):
+            if s["rw"]:                  # shm_context.cc:303-305
+                unpark_reader(s)
+            s["wpc"] = "idle"
+        add(Action("w.wake_if_read_waiters",
+                   lambda s: s["wpc"] == "w_wake", wake_effect))
+
+    # -- writer: wait-for-space path (shm_context.cc:386-399) ------------
+
+    add(Action(
+        "w.set_write_waiters",
+        lambda s: (s["wpc"] == "idle" and s["written"] < ITEMS
+                   and s["occ"] >= CAP and not s["closed"]),
+        lambda s: s.update(ww=1, wpc="w_ldseq")))
+    add(Action(
+        "w.load_space_seq",
+        lambda s: s["wpc"] == "w_ldseq",
+        lambda s: s.update(wexp=s["sseq"], wpc="w_recheck")))
+
+    def w_recheck_effect(s):
+        if s["occ"] < CAP or s["closed"]:
+            s["ww"] = 0
+            s["wpc"] = "idle"
+        else:
+            s["wpc"] = "w_park"
+    add(Action("w.recheck_space",
+               lambda s: s["wpc"] == "w_recheck", w_recheck_effect))
+
+    def w_park_effect(s):
+        if s["sseq"] == s["wexp"]:
+            s["wpc"] = "w_parked"        # kernel compare passed
+        else:
+            s["ww"] = 0                  # stale expected: EAGAIN, retry
+            s["wpc"] = "idle"
+    add(Action("w.futex_wait_space",
+               lambda s: s["wpc"] == "w_park", w_park_effect))
+
+    # -- writer: close (shm_context.cc:250-257) --------------------------
+
+    def close_effect(s):
+        s["closed"] = True
+        if bug != "no_close_wake":
+            s["dseq"] += 1
+            s["sseq"] += 1
+            unpark_reader(s)             # unconditional wakes
+            unpark_writer(s)
+    add(Action(
+        "w.close",
+        lambda s: (s["wpc"] == "idle" and s["written"] == ITEMS
+                   and not s["closed"]),
+        close_effect, progress=True))
+
+    # -- reader ----------------------------------------------------------
+
+    def consume_effect(s):
+        # ReadSome: drain a frame, bump space_seq, gated wake of the
+        # writer (shm_context.cc:328-330).  Modeled atomically in the
+        # CORRECT order (bump before waiter load); the write side above
+        # is where the seeded ordering bug lives.
+        s["occ"] -= 1
+        s["read"] += 1
+        s["sseq"] += 1
+        if s["ww"]:
+            unpark_writer(s)
+    add(Action("r.consume",
+               lambda s: s["rpc"] == "idle" and s["occ"] > 0,
+               consume_effect, progress=True))
+    add(Action(
+        "r.eof",                         # shm_context.cc:315-317
+        lambda s: (s["rpc"] == "idle" and s["occ"] == 0 and s["closed"]),
+        lambda s: s.update(rpc="r_done"), progress=True))
+    add(Action(
+        "r.set_read_waiters",            # shm_context.cc:369
+        lambda s: (s["rpc"] == "idle" and s["occ"] == 0
+                   and not s["closed"]),
+        lambda s: s.update(rw=1, rpc="r_ldseq")))
+    add(Action(
+        "r.load_data_seq",
+        lambda s: s["rpc"] == "r_ldseq",
+        lambda s: s.update(rexp=s["dseq"], rpc="r_recheck")))
+
+    def r_recheck_effect(s):
+        if s["occ"] > 0 or s["closed"]:  # shm_context.cc:370-373
+            s["rw"] = 0
+            s["rpc"] = "idle"
+        else:
+            s["rpc"] = "r_park"
+    add(Action("r.recheck_empty",
+               lambda s: s["rpc"] == "r_recheck", r_recheck_effect))
+
+    def r_park_effect(s):
+        if s["dseq"] == s["rexp"]:       # shm_context.cc:374
+            s["rpc"] = "r_parked"
+        else:
+            s["rw"] = 0
+            s["rpc"] = "idle"
+    add(Action("r.futex_wait_data",
+               lambda s: s["rpc"] == "r_park", r_park_effect))
+
+    invariants = [
+        Invariant(
+            "ring-accounting",
+            lambda s: (s["occ"] == s["written"] - s["read"]
+                       and 0 <= s["occ"] <= CAP),
+            "occupancy is exactly written-minus-read and bounded by "
+            "capacity — no frame is lost or duplicated",
+            "horovod_tpu/native/shm_context.cc:281"),
+        Invariant(
+            "eof-only-after-drain",
+            lambda s: (s["rpc"] != "r_done"
+                       or (s["read"] == s["written"] and s["closed"])),
+            "EOF is reported only once the ring drained AND the peer "
+            "hung up — closed with bytes in flight keeps reading",
+            "horovod_tpu/native/shm_context.cc:315"),
+    ]
+
+    def done(s):
+        return (s["closed"] and s["rpc"] == "r_done"
+                and s["read"] == ITEMS and s["wpc"] == "idle")
+
+    return Model(NAME if bug is None else "%s[%s]" % (NAME, bug),
+                 init, actions, invariants, done, symmetry=(),
+                 source=__file__)

@@ -99,7 +99,8 @@ def format_json(findings, files_checked, out):
 _SARIF_LEVEL = {"error": "error", "warning": "warning", "info": "note"}
 
 
-def format_sarif(findings, files_checked, out):
+def format_sarif(findings, files_checked, out, tool_name="hvd-lint",
+                 information_uri="docs/LINT.md"):
     """SARIF 2.1.0: one run, rules from the registry, results with
     partialFingerprints so SARIF consumers (GitHub code scanning et
     al.) match findings across commits even when lines shift."""
@@ -153,8 +154,8 @@ def format_sarif(findings, files_checked, out):
         "runs": [{
             "tool": {
                 "driver": {
-                    "name": "hvd-lint",
-                    "informationUri": "docs/LINT.md",
+                    "name": tool_name,
+                    "informationUri": information_uri,
                     "rules": rules,
                 },
             },

@@ -230,9 +230,9 @@ class SegmentWorker {
 
   std::mutex mu_;
   std::condition_variable cv_;
-  std::function<void()> job_;
-  bool busy_ = false;
-  bool stop_ = false;
+  std::function<void()> job_;  // guarded_by(mu_)
+  bool busy_ = false;          // guarded_by(mu_)
+  bool stop_ = false;          // guarded_by(mu_)
   std::thread thread_;
 };
 

@@ -120,9 +120,9 @@ class FaultInjector {
   std::atomic<bool> active_{false};
   std::atomic<uint64_t> fires_{0};
   std::mutex mutex_;
-  std::vector<Rule> rules_;
-  std::mt19937_64 rng_;
-  int rank_ = -1;
+  std::vector<Rule> rules_;  // guarded_by(mutex_)
+  std::mt19937_64 rng_;      // guarded_by(mutex_)
+  int rank_ = -1;            // guarded_by(mutex_)
 };
 
 // Process-wide injector (configured by TcpContext::Initialize; reached

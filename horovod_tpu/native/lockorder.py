@@ -20,6 +20,28 @@ in one run. This pass proves the stronger static property over
   declaration must only be touched in method bodies while that mutex
   is held. Constructors/destructors are exempt (no concurrent access
   before/after the object's lifetime).
+* **drift guard**: a file that declares a ``std::mutex`` (any flavor)
+  but annotates ZERO guarded fields contributes nothing to the guard
+  audit — new mutex-protected state silently escapes coverage. Such a
+  file is itself a finding (``mutex-without-guarded-fields``) until
+  its fields are annotated or the mutex is explicitly excused.
+* **blocking-call-under-lock**: a socket send/recv/connect/accept, a
+  ``FutexWait``, an fsync, or a sleep executed while a mutex is held
+  turns every contender into a convoy and can deadlock against the
+  very peer the call waits on. Condition-variable waits are exempt
+  (they release the lock); the scan flags the raw calls only.
+* **atomics-pairing**: the shm ring's wake protocol is only correct
+  because the publisher's seq bump + waiters-flag load and the
+  waiter's flag store + expected-seq load are ALL seq_cst (see
+  shm_context.cc WriteSome/WaitReadable). A relaxed or release store
+  feeding a *gated* ``FutexWake`` can commit after the gate's load in
+  the SC order — the wake is skipped and the peer parks forever. The
+  scan pairs every gated wake / ``FutexWait`` with its surrounding
+  atomics and demands seq_cst on each side of the handshake.
+
+Intentional exceptions are suppressed in-source with
+``// lockorder: allow(rule-name[, rule-name])`` on the flagged line;
+each suppression should carry a justification in the same comment.
 
 The parser is a token scanner, not a C++ front end: it strips comments
 and strings, tracks braces, and recognizes the repo's idioms (SURVEY
@@ -50,18 +72,65 @@ _NOT_FUNCS = {"if", "for", "while", "switch", "return", "catch",
               "assert", "static_assert", "alignof", "decltype",
               "constexpr", "throw"}
 GUARDED_BY_RE = re.compile(r"guarded_by\((?P<mu>\w+)\)", re.I)
+REQUIRES_RE = re.compile(r"lockorder:\s*requires\((?P<mu>\w+)\)")
 FIELD_DECL_RE = re.compile(r"\b(?P<field>[a-zA-Z_]\w*_)\s*[;={(\[]")
 CALL_RE = re.compile(r"\b(?P<name>[A-Z]\w+)\s*\(")
+ALLOW_RE = re.compile(r"lockorder:\s*allow\(\s*(?P<rules>[\w\-, ]+?)\s*\)")
+MUTEX_DECL_RE = re.compile(
+    r"\bstd::(?:recursive_|shared_|timed_|recursive_timed_)?mutex\s+"
+    r"(?P<name>\w+)\s*[;,={]")
+# Calls that park/stall the calling thread: POSIX socket ops, futex
+# parks, durability syscalls, sleeps. `cv_.wait()` is deliberately NOT
+# here — a condition_variable wait releases the lock, which is the
+# correct idiom; only raw blocking under a held mutex convoys.
+BLOCKING_CALL_RE = re.compile(
+    r"(?<![\w.>])(?P<call>send|recv|sendmsg|recvmsg|sendto|recvfrom|"
+    r"connect|accept|accept4|poll|ppoll|select|pselect|fsync|"
+    r"fdatasync|usleep|nanosleep|sleep_for|sleep_until|FutexWait)"
+    r"\s*\(")
+_ATOMIC_WORD = r"[\w.>\[\]-]+"
+# `if (<waiters>.load(<order>) ...) { ... }` — the gated-wake shape.
+WAKE_GATE_RE = re.compile(
+    r"if\s*\(\s*(?P<waiters>%s)\.load\s*\((?P<order>[^()]*)\)"
+    r"[^;{]*\)\s*\{(?P<body>[^{}]*)\}" % _ATOMIC_WORD, re.S)
+FUTEX_WAKE_RE = re.compile(r"FutexWake\s*\(\s*&?(?P<word>%s)"
+                           % _ATOMIC_WORD)
+FUTEX_WAIT_RE = re.compile(r"FutexWait\s*\(\s*&?(?P<word>%s)"
+                           % _ATOMIC_WORD)
+WAITER_FLAG_STORE_RE = re.compile(
+    r"\b(?P<flag>%s)\.store\s*\(\s*1\s*,\s*(?P<order>[^()]*)\)"
+    % _ATOMIC_WORD)
 
 Finding = collections.namedtuple(
     "Finding", ["rule", "path", "line", "message"])
 
 
+def _harvest_allow(seg, line, allows):
+    m = ALLOW_RE.search(seg)
+    if m:
+        allows.setdefault(line, set()).update(
+            r.strip() for r in m.group("rules").split(",") if r.strip())
+
+
 def _strip(source):
     """Removes comments and string/char literals (preserving line
-    structure) but first harvests `guarded_by` annotations:
-    {line_number: mutex_name}."""
+    structure) but first harvests `guarded_by` annotations
+    ({line_number: mutex_name}), `lockorder: requires(mu)` function
+    preconditions ({line_number: mutex_name}), and
+    `lockorder: allow(...)` suppressions ({line_number: set(rules)})."""
     annotations = {}
+    requires = {}
+    allows = {}
+
+    def harvest(seg, line):
+        m = GUARDED_BY_RE.search(seg)
+        if m:
+            annotations[line] = m.group("mu")
+        m = REQUIRES_RE.search(seg)
+        if m:
+            requires[line] = m.group("mu")
+        _harvest_allow(seg, line, allows)
+
     out = []
     i, n = 0, len(source)
     line = 1
@@ -74,17 +143,13 @@ def _strip(source):
         elif source.startswith("//", i):
             j = source.find("\n", i)
             j = n if j < 0 else j
-            m = GUARDED_BY_RE.search(source[i:j])
-            if m:
-                annotations[line] = m.group("mu")
+            harvest(source[i:j], line)
             i = j
         elif source.startswith("/*", i):
             j = source.find("*/", i)
             j = n if j < 0 else j + 2
             seg = source[i:j]
-            m = GUARDED_BY_RE.search(seg)
-            if m:
-                annotations[line] = m.group("mu")
+            harvest(seg, line)
             line += seg.count("\n")
             out.append("\n" * seg.count("\n"))
             i = j
@@ -106,7 +171,7 @@ def _strip(source):
         else:
             out.append(ch)
             i += 1
-    return "".join(out), annotations
+    return "".join(out), annotations, requires, allows
 
 
 class Acquisition(object):
@@ -230,23 +295,28 @@ def _enclosing_class(text, pos):
     return best
 
 
-def _scan_function(fn):
+def _scan_function(fn, pre_held=()):
     """Walks one body; returns (edges, top_level_mutexes, accesses)
     where edges are (held, acquired, path, line), top_level_mutexes the
     locks taken while holding nothing (for one-level call edges), and
     accesses [(token_line, held_mutex_names_set)] for the guard audit —
     accesses is a callable mapping a regex to occurrences for
-    efficiency."""
+    efficiency. `pre_held` mutex tokens (a `lockorder: requires(mu)`
+    annotation on the definition) are held on entry — the caller's
+    contract — at depth 0 so no closing brace releases them."""
     text = fn.text
     edges = []
     top_level = []
-    held = []  # Acquisition stack
+    held = [Acquisition(_norm_mutex(fn.cls, tok), 0, "<requires>",
+                        fn.path, fn.start_line, False)
+            for tok in pre_held]
     depth = 0
     line = fn.start_line
     i = 0
     calls = []     # (name, line, held_snapshot)
     accesses = []  # (line, frozenset(held mutex names)) per source line
     line_held = {}
+    lock_vars = {}  # guard-object var -> raw mutex token (for relock)
 
     def record_line():
         prev = line_held.get(line)
@@ -293,10 +363,11 @@ def _scan_function(fn):
             m4 = BARE_LOCK_RE.match(text, i)
             if m4 is not None:
                 raw = m4.group("mu").strip()
-                # `lk.lock()` re-locks through a unique_lock var; a
-                # direct `mu_.lock()` names the mutex itself.
-                _acquire(fn, raw, depth, raw, line, held, edges,
-                         top_level)
+                # `lk.lock()` re-locks the mutex its unique_lock was
+                # constructed over (tracked in lock_vars); a direct
+                # `mu_.lock()` names the mutex itself.
+                _acquire(fn, lock_vars.get(raw, raw), depth, raw, line,
+                         held, edges, top_level)
                 i = m4.end()
                 continue
             m5 = CALL_RE.match(text, i)
@@ -310,6 +381,7 @@ def _scan_function(fn):
             continue
         _acquire(fn, m.group("mu"), depth, m.group("var"), line, held,
                  edges, top_level)
+        lock_vars[m.group("var")] = m.group("mu")
         i = m.end()
     record_line()
     return edges, top_level, calls, line_held
@@ -331,6 +403,8 @@ def analyze_files(paths):
     findings = []
     functions = []
     file_annotations = {}  # path -> {line: mutex}
+    file_requires = {}     # path -> {line: mutex}
+    file_allows = {}       # path -> {line: set(rule)}
     texts = {}
     for path in paths:
         try:
@@ -341,9 +415,11 @@ def analyze_files(paths):
             findings.append(Finding(
                 "io-error", path, 1, "cannot read: %s" % e))
             continue
-        text, annotations = _strip(raw)
+        text, annotations, requires, allows = _strip(raw)
         texts[path] = text
         file_annotations[path] = annotations
+        file_requires[path] = requires
+        file_allows[path] = allows
         functions.extend(_extract_functions(text, path))
 
     # Pass 1: per-function scans.
@@ -352,7 +428,12 @@ def analyze_files(paths):
     top_by_name = collections.defaultdict(set)
     fn_results = []
     for fn in functions:
-        f_edges, top_level, calls, line_held = _scan_function(fn)
+        # a `lockorder: requires(mu)` on the definition line (or the
+        # line above it) means the caller holds `mu` on entry
+        req = file_requires.get(fn.path, {})
+        pre = [mu for mu in (req.get(fn.start_line),
+                             req.get(fn.start_line - 1)) if mu]
+        f_edges, top_level, calls, line_held = _scan_function(fn, pre)
         edges.extend(f_edges)
         fn_results.append((fn, calls, line_held))
         if top_level:
@@ -423,10 +504,168 @@ def analyze_files(paths):
                 % (fn.cls, field, mu, fn.qualname,
                    os.path.basename(fn.path), line, mu)))
 
+    # Pass 5: drift guard — a file that declares a mutex but annotates
+    # zero guarded fields gives the guard audit nothing to check; its
+    # protected state is invisible to Pass 4 and stays that way as the
+    # file grows. Annotating at least one field (or excusing the mutex
+    # in-source) is the price of declaring one.
+    mutex_files = 0
+    for path in sorted(texts):
+        text = texts[path]
+        decl = MUTEX_DECL_RE.search(text)
+        if decl is None:
+            continue
+        mutex_files += 1
+        if _has_field_annotation(text, file_annotations[path]):
+            continue
+        line = text.count("\n", 0, decl.start()) + 1
+        findings.append(Finding(
+            "mutex-without-guarded-fields", path, line,
+            "file declares mutex %s but annotates zero guarded_by "
+            "fields — the guard audit covers none of this file's "
+            "shared state, and new fields silently escape it; "
+            "annotate the fields this mutex protects, or excuse it "
+            "with `// lockorder: allow(mutex-without-guarded-fields)` "
+            "plus a justification" % decl.group("name")))
+
+    # Pass 6: blocking calls under a held mutex. A send/recv/futex/
+    # fsync/sleep inside a critical section stalls every contender for
+    # the lock's full syscall latency — and when the blocked-on peer
+    # needs that same lock to make progress, it is a deadlock no
+    # acquisition-order analysis can see.
+    for fn, _, line_held in fn_results:
+        for m in BLOCKING_CALL_RE.finditer(fn.text):
+            line = fn.start_line + fn.text.count("\n", 0, m.start())
+            held = line_held.get(line, frozenset())
+            if not held:
+                continue
+            findings.append(Finding(
+                "blocking-call-under-lock", fn.path, line,
+                "%s calls %s() while holding %s — the lock is pinned "
+                "across a call that can block indefinitely, convoying "
+                "every contender (and deadlocking if the peer this "
+                "call waits on needs the same lock); move the call "
+                "outside the critical section"
+                % (fn.qualname, m.group("call"),
+                   ", ".join(sorted(held)))))
+
+    # Pass 7: atomics pairing around the futex wake protocol.
+    for fn, _, _ in fn_results:
+        _audit_atomics(fn, findings)
+
+    # Suppressions: `lockorder: allow(rule)` on the flagged line, or on
+    # the line directly above it (trailing comments don't fit next to a
+    # long C++ statement; comment-above is the NOLINTNEXTLINE idiom).
+    suppressed = 0
+    kept = []
+    for f in findings:
+        allows = file_allows.get(f.path, {})
+        if (f.rule in allows.get(f.line, ())
+                or f.rule in allows.get(f.line - 1, ())):
+            suppressed += 1
+            continue
+        kept.append(f)
+
     stats = {"files": len(texts), "functions": len(functions),
              "edges": len(set((a, b) for a, b, _, _, _ in edges)),
-             "guarded_fields": sum(len(v) for v in guarded.values())}
-    return findings, stats
+             "guarded_fields": sum(len(v) for v in guarded.values()),
+             "mutex_files": mutex_files,
+             "suppressed": suppressed}
+    return kept, stats
+
+
+def _has_field_annotation(text, annotations):
+    """True if at least one guarded_by annotation sits on a field
+    declaration line (an annotation on a non-field line is harvested
+    but resolves to nothing in Pass 4 — it must not satisfy the drift
+    guard)."""
+    lines = text.split("\n")
+    for line_no in annotations:
+        if (0 < line_no <= len(lines)
+                and FIELD_DECL_RE.search(lines[line_no - 1])):
+            return True
+    return False
+
+
+def _audit_atomics(fn, findings):
+    """The shm ring's missed-wake-free handshake (shm_context.cc
+    WriteSome :296-305 / WaitReadable :364-376 and their write-side
+    mirrors) needs seq_cst at all four corners:
+
+      publisher:  seq.fetch_add(seq_cst);  if (waiters.load(seq_cst))
+                  FutexWake(&seq);
+      waiter:     waiters.store(1, seq_cst);  exp = seq.load(seq_cst);
+                  recheck; FutexWait(&seq, exp);
+
+    Weaken ANY one of them and there is an SC execution where the
+    publisher misses the waiter flag AND the waiter misses the bump —
+    the wake is skipped and the waiter parks for its full timeout
+    (hvd-model's shm_ring[missed_wake] seeded bug is exactly this).
+    An *unconditional* FutexWake (the Close() hangup path) has no such
+    dependency and release ordering suffices — only gated wakes and
+    waits are audited."""
+    text = fn.text
+
+    def lineof(pos):
+        return fn.start_line + text.count("\n", 0, pos)
+
+    for m in WAKE_GATE_RE.finditer(text):
+        wake = FUTEX_WAKE_RE.search(m.group("body"))
+        if wake is None:
+            continue
+        if "seq_cst" not in m.group("order"):
+            findings.append(Finding(
+                "atomics-pairing", fn.path, lineof(m.start()),
+                "%s gates FutexWake(&%s) on %s.load(%s) — the gate "
+                "load must be seq_cst to pair with the waiter's "
+                "seq_cst flag store, or the publisher can miss a "
+                "parked waiter"
+                % (fn.qualname, wake.group("word"), m.group("waiters"),
+                   m.group("order").strip() or "<relaxed>")))
+        word = wake.group("word")
+        pub = None
+        for pm in re.finditer(
+                re.escape(word) + r"\.(?:fetch_add|store)\s*"
+                r"\(([^()]*)\)", text[:m.start()]):
+            pub = pm
+        if pub is not None and "seq_cst" not in pub.group(1):
+            findings.append(Finding(
+                "atomics-pairing", fn.path, lineof(pub.start()),
+                "%s publishes %s with ordering (%s) but its wake is "
+                "gated on a waiters flag — a store weaker than "
+                "seq_cst can commit after the gate's load in the SC "
+                "order, skipping the wake while the peer parks; the "
+                "publish and the gate load must both be seq_cst"
+                % (fn.qualname, word, pub.group(1).strip())))
+
+    for m in FUTEX_WAIT_RE.finditer(text):
+        word = m.group("word")
+        before = text[:m.start()]
+        flag = None
+        for sm in WAITER_FLAG_STORE_RE.finditer(before):
+            flag = sm
+        if flag is not None and "seq_cst" not in flag.group("order"):
+            findings.append(Finding(
+                "atomics-pairing", fn.path, lineof(flag.start()),
+                "%s announces its park via %s.store(1, %s) before "
+                "FutexWait(&%s) — the flag store must be seq_cst so "
+                "the publisher's gate load observes it; anything "
+                "weaker allows a missed wake"
+                % (fn.qualname, flag.group("flag"),
+                   flag.group("order").strip(), word)))
+        exp = None
+        for lm in re.finditer(
+                re.escape(word) + r"\.load\s*\(([^()]*)\)", before):
+            exp = lm
+        if exp is not None and "seq_cst" not in exp.group(1):
+            findings.append(Finding(
+                "atomics-pairing", fn.path, lineof(exp.start()),
+                "%s loads the FutexWait expected value %s.load(%s) "
+                "with an ordering weaker than seq_cst — the load can "
+                "hoist above the waiter-flag store and miss the "
+                "publisher's bump, so the kernel compare passes on a "
+                "stale value and the wait parks through a wake"
+                % (fn.qualname, word, exp.group(1).strip())))
 
 
 def _collect_guarded_fields(texts, file_annotations):
@@ -519,9 +758,11 @@ def main(argv=None):
     if args.stats or not findings:
         sys.stderr.write(
             "check-lockorder: %d file(s), %d function(s), %d "
-            "acquisition edge(s), %d guarded field(s): %s\n"
+            "acquisition edge(s), %d guarded field(s), %d "
+            "mutex-declaring file(s), %d suppression(s): %s\n"
             % (stats["files"], stats["functions"], stats["edges"],
-               stats["guarded_fields"],
+               stats["guarded_fields"], stats["mutex_files"],
+               stats["suppressed"],
                "clean" if not findings else
                "%d finding(s)" % len(findings)))
     return 1 if findings else 0

@@ -327,11 +327,11 @@ class Metrics {
 
   mutable std::mutex rank_mutex_;
   // Announce-lag accumulators, indexed by rank (coordinator only).
-  std::vector<double> rank_lag_seconds_;
-  std::vector<uint64_t> rank_lag_count_;
+  std::vector<double> rank_lag_seconds_;    // guarded_by(rank_mutex_)
+  std::vector<uint64_t> rank_lag_count_;    // guarded_by(rank_mutex_)
   // Latest ingested summary per rank + receive time (coordinator only).
-  std::vector<std::vector<double>> rank_summaries_;
-  std::vector<Clock::time_point> rank_summary_time_;
+  std::vector<std::vector<double>> rank_summaries_;      // guarded_by(rank_mutex_)
+  std::vector<Clock::time_point> rank_summary_time_;     // guarded_by(rank_mutex_)
   bool is_coordinator_ = false;
 };
 

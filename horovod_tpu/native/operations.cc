@@ -121,8 +121,8 @@ class HandleManager {
  private:
   std::mutex mutex_;
   std::condition_variable cv_;
-  int next_handle_ = 0;
-  std::map<int, std::shared_ptr<HandleEntry>> entries_;
+  int next_handle_ = 0;  // guarded_by(mutex_)
+  std::map<int, std::shared_ptr<HandleEntry>> entries_;  // guarded_by(mutex_)
 };
 
 HandleManager g_handles;
@@ -481,6 +481,11 @@ bool InitializeHorovodOnce() {
         std::thread(BackgroundThreadLoop, std::ref(g_state));
   }
   while (!g_state.initialization_done.load()) {
+    // Deliberately under g_init_mutex: the lock IS the once-guard —
+    // a concurrent initializer must block until the first init fully
+    // resolves (done or failed), and the background thread it waits
+    // on never takes g_init_mutex, so this cannot deadlock.
+    // lockorder: allow(blocking-call-under-lock)
     std::this_thread::sleep_for(std::chrono::milliseconds(1));
   }
   if (g_state.initialization_failed.load()) {

@@ -77,6 +77,7 @@ void ParameterManager::Initialize(int32_t rank,
   BuildSearchSpace();
 }
 
+// lockorder: requires(mu_)
 void ParameterManager::BuildSearchSpace() {
   // Categorical combos to sweep: (cache, hier_allreduce, hier_allgather,
   // hier_reduce_scatter). Fixed knobs collapse their dimension, and the
@@ -140,6 +141,7 @@ void ParameterManager::BuildSearchSpace() {
   }
 }
 
+// lockorder: requires(mu_)
 void ParameterManager::Arm() {
   armed_once_ = true;
   active_ = true;
@@ -285,6 +287,7 @@ void ParameterManager::ObserveWorkload(bool compression_active,
                                                          : "profile-shm")));
 }
 
+// lockorder: requires(mu_)
 bool ParameterManager::TriggerRearm(const char* reason) {
   // Caller holds mu_. Re-arm subsumes any in-flight tuning pass: the
   // measurement regime just changed, so its samples are stale. Before
@@ -347,6 +350,7 @@ uint64_t ParameterManager::rearms_total() const {
   return rearms_total_;
 }
 
+// lockorder: requires(mu_)
 void ParameterManager::ReadyTune() {
   // Apply the next sample point of the current categorical combo.
   if (combo_index_ >= categorical_combos_.size()) return;
@@ -364,6 +368,7 @@ void ParameterManager::ReadyTune() {
   if (!pipeline_fixed_) pipeline_chunk_kb_ = next[2];
 }
 
+// lockorder: requires(mu_)
 void ParameterManager::LogSample(double score, const char* event) {
   if (!log_.is_open()) return;
   log_ << fusion_mb_ << "," << cycle_time_ms_ << "," << pipeline_chunk_kb_
@@ -437,6 +442,7 @@ bool ParameterManager::Update(int64_t tensors, int64_t bytes) {
   return Tune(score);
 }
 
+// lockorder: requires(mu_)
 bool ParameterManager::Tune(double score) {
   LogSample(score, "sample");
   if (score > best_score_) {
@@ -496,6 +502,7 @@ bool ParameterManager::Tune(double score) {
   return true;
 }
 
+// lockorder: requires(mu_)
 ParameterManager::Params ParameterManager::GetParamsLocked() const {
   Params p;
   p.fusion_mb = fusion_mb_;

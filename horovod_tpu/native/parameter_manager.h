@@ -145,9 +145,9 @@ class ParameterManager {
   mutable std::mutex mu_;
 
   // Current values.
-  double fusion_mb_ = 64.0;
-  double cycle_time_ms_ = 5.0;
-  double pipeline_chunk_kb_ = 1024.0;
+  double fusion_mb_ = 64.0;           // guarded_by(mu_)
+  double cycle_time_ms_ = 5.0;        // guarded_by(mu_)
+  double pipeline_chunk_kb_ = 1024.0; // guarded_by(mu_)
   bool cache_enabled_ = true;
   bool hierarchical_allreduce_ = false;
   bool hierarchical_allgather_ = false;

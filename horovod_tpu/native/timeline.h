@@ -66,7 +66,7 @@ class TimelineWriter {
   std::thread writer_thread_;
   std::mutex mutex_;
   std::condition_variable cv_;
-  std::deque<TimelineRecord> queue_;
+  std::deque<TimelineRecord> queue_;  // guarded_by(mutex_)
   // tensor name -> stable integer "pid" for chrome tracing rows.
   std::unordered_map<std::string, int> tensor_table_;
   int next_tensor_id_ = 0;
@@ -116,7 +116,7 @@ class Timeline {
   std::chrono::steady_clock::time_point start_time_;
   TimelineWriter writer_;
   std::recursive_mutex mutex_;
-  std::unordered_map<std::string, TimelineState> tensor_states_;
+  std::unordered_map<std::string, TimelineState> tensor_states_;  // guarded_by(mutex_)
 };
 
 }  // namespace hvdtpu

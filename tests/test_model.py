@@ -1,0 +1,332 @@
+"""hvd-model: the explicit-state protocol model checker (docs/MODEL.md).
+
+Three layers:
+
+1. engine unit tests — freeze/canon, BFS trace minimality, deadlock /
+   livelock detection, symmetry reduction, the state budget;
+2. golden seeded-bug regressions — every historical bug encoded in the
+   protocol models must be re-found with its exact minimal
+   counterexample length (and, for the shm missed wake, the exact
+   interleaving), so a model edit that loses a regression fails here
+   before it ships;
+3. CLI: default run clean under the CI budget, --bug mode, JSON and
+   SARIF output, and the model-regression-missed tripwire.
+"""
+
+import io
+import json
+import time
+
+import pytest
+
+from horovod_tpu.lint.model import cli
+from horovod_tpu.lint.model.dsl import (Action, Invariant, Model,
+                                        default_permute, freeze)
+from horovod_tpu.lint.model.explore import (BudgetExceeded, explore,
+                                            replay)
+from horovod_tpu.lint.model.protocols import MODELS, BugSpec, ModelSpec
+
+
+# --- engine -----------------------------------------------------------------
+
+def test_freeze_canonicalizes_nested_state():
+    a = {"t": {1: [1, 2], 0: {"x"}}, "u": (3, {"k": 4})}
+    b = {"u": (3, {"k": 4}), "t": {0: {"x"}, 1: [1, 2]}}
+    assert freeze(a) == freeze(b)
+    assert hash(freeze(a)) == hash(freeze(b))
+    assert freeze({"t": {1: [1, 2]}}) != freeze({"t": {1: [2, 1]}})
+
+
+def test_default_permute_rekeys_int_dicts_at_any_depth():
+    state = {"phase": {0: "a", 1: "b"}, "misc": {"n": 3},
+             "nested": {"by_rank": {0: [1], 1: [2]}}}
+    swapped = default_permute(state, {0: 1, 1: 0})
+    assert swapped["phase"] == {1: "a", 0: "b"}
+    assert swapped["nested"]["by_rank"] == {1: [1], 0: [2]}
+    assert swapped["misc"] == {"n": 3}  # string keys untouched
+
+
+def _counter_model(limit, bug_at=None):
+    """x counts 0..limit via two interleaved incrementers; optionally an
+    invariant that trips at x == bug_at."""
+    invs = []
+    if bug_at is not None:
+        invs.append(Invariant("x-below-%d" % bug_at,
+                              lambda s: s["x"] < bug_at))
+
+    def inc(s):
+        s["x"] += 1
+
+    return Model(
+        "counter",
+        {"x": 0},
+        [Action("a.inc", lambda s: s["x"] < limit, inc, progress=True),
+         Action("b.inc", lambda s: s["x"] < limit, inc, progress=True)],
+        invs,
+        done=lambda s: s["x"] == limit)
+
+
+def test_bfs_trace_is_minimal_by_construction():
+    result = explore(_counter_model(10, bug_at=3))
+    (v,) = result.violations
+    assert v.kind == "invariant"
+    # shortest path to x==3 is exactly 3 increments, never more
+    assert len(v.trace) == 3
+    assert v.state["x"] == 3
+
+
+def test_deadlock_and_clean_termination():
+    # done==limit: terminal state accepted, no violations
+    assert explore(_counter_model(4)).violations == []
+    # done never true: the same terminal state is now a deadlock
+    wedge = _counter_model(4)
+    wedge.done = lambda s: False
+    (v,) = explore(wedge).violations
+    assert v.kind == "deadlock"
+    assert len(v.trace) == 4
+
+
+def test_livelock_needs_a_progress_free_cycle():
+    def spin(s):
+        s["t"] = (s["t"] + 1) % 2
+
+    def mk(progress):
+        return Model(
+            "spinner", {"t": 0, "done": False},
+            [Action("tick", lambda s: True, spin, progress=progress)],
+            done=lambda s: s["done"])
+
+    (v,) = explore(mk(progress=False)).violations
+    assert v.kind == "livelock"
+    assert v.cycle  # the repeating suffix is reported
+    # the same cycle made of `progress` edges is not a livelock
+    assert explore(mk(progress=True)).violations == []
+
+
+def test_budget_exceeded_raises():
+    with pytest.raises(BudgetExceeded):
+        explore(_counter_model(100), max_states=5)
+
+
+def test_replay_rejects_disabled_step():
+    model = _counter_model(2)
+    with pytest.raises(ValueError):
+        replay(model, ["a.inc", "a.inc", "a.inc"])  # third is disabled
+
+
+# --- symmetry reduction -----------------------------------------------------
+
+def test_symmetry_reduction_shrinks_the_state_space():
+    """The drain model declares all ranks interchangeable; stripping the
+    declaration must explore strictly more canonical states while
+    reaching the same verdict."""
+    sym = MODELS["drain"].build(3)
+    nosym = MODELS["drain"].build(3)
+    nosym.symmetry = []
+    r_sym = explore(sym)
+    r_nosym = explore(nosym)
+    assert r_sym.violations == [] and r_nosym.violations == []
+    assert r_sym.num_states < r_nosym.num_states
+    # pinned: the golden counts the CLI run reports
+    assert r_sym.num_states == 52
+
+
+def test_canon_is_invariant_under_rank_permutation():
+    model = MODELS["cache_bits"].build(3)
+    state = model.init
+    for mapping in model.permutations():
+        assert model.canon(model.permute(state, mapping)) == \
+            model.canon(state)
+
+
+# --- clean explorations (golden state counts) -------------------------------
+
+GOLDEN_CLEAN = {
+    # (model, ranks, sub-model index) -> canonical states
+    ("cache_bits", 2, 0): 21,
+    ("cache_bits", 3, 0): 36,
+    ("cache_bits", 4, 0): 56,
+    ("drain", 2, 0): 30,
+    ("drain", 2, 1): 15,   # drain[sticky]
+    ("drain", 3, 0): 52,
+    ("drain", 3, 1): 35,
+    ("drain", 4, 0): 84,
+    ("drain", 4, 1): 70,
+    ("rendezvous", 2, 0): 9,
+    ("rendezvous", 3, 0): 21,
+    ("shm_ring", 2, 0): 274,
+    ("group_ring", 3, 0): 45,
+}
+
+
+@pytest.mark.parametrize("name,ranks,idx", sorted(GOLDEN_CLEAN))
+def test_shipped_models_explore_clean(name, ranks, idx):
+    spec = MODELS[name]
+    model = spec.clean_builds(ranks)[idx]
+    result = explore(model)
+    assert result.complete
+    assert result.violations == [], [
+        (v.kind, v.trace) for v in result.violations]
+    # Pinned canonical state counts: a drop means the model lost
+    # behaviors (under-approximation hides bugs); a jump means symmetry
+    # reduction broke (CI budget erodes).
+    assert result.num_states == GOLDEN_CLEAN[(name, ranks, idx)]
+
+
+# --- golden seeded-bug regressions ------------------------------------------
+
+GOLDEN_BUGS = [
+    # (model, bug, violation kind, minimal counterexample length)
+    ("cache_bits", "late_registration", "deadlock", 5),
+    ("cache_bits", "no_foreign", "invariant", 13),
+    ("cache_bits", "rearm_no_force", "livelock", 14),
+    ("drain", "local_poll", "deadlock", 5),
+    ("drain", "sticky_displacement", "invariant", 9),
+    ("rendezvous", "ungated_growth", "invariant", 5),
+    ("shm_ring", "missed_wake", "deadlock", 12),
+    ("shm_ring", "no_close_wake", "deadlock", 13),
+    ("group_ring", "no_stash", "deadlock", 8),
+    ("group_ring", "reconnect_drop", "deadlock", 10),
+]
+
+
+def test_every_registered_bug_has_a_golden_entry():
+    registered = {(name, bug) for name, spec in MODELS.items()
+                  for bug in spec.bugs}
+    assert registered == {(n, b) for n, b, _, _ in GOLDEN_BUGS}
+
+
+@pytest.mark.parametrize("name,bug,kind,steps", GOLDEN_BUGS)
+def test_seeded_bug_refound_with_minimal_trace(name, bug, kind, steps):
+    spec = MODELS[name]
+    assert spec.bugs[bug].kind == kind
+    model = spec.build(ranks=None, bug=bug)
+    result = explore(model)
+    hits = [v for v in result.violations if v.kind == kind]
+    assert hits, [v.kind for v in result.violations]
+    v = hits[0]
+    # BFS makes the first hit minimal; these lengths are golden — a
+    # longer trace means the model grew noise steps, a shorter one
+    # means the bug got easier (the abstraction drifted).
+    assert len(v.trace) == steps
+    # every counterexample replays from init (guards stay consistent)
+    states = replay(model, v.trace)
+    assert freeze(states[-1]) == freeze(v.state)
+
+
+def test_shm_missed_wake_exact_interleaving():
+    """The missed-wake counterexample IS the historical bug: the writer
+    loads the waiters flag BEFORE bumping data_seq (the relaxed-order
+    reverted variant), the reader parks in the window, and both sides
+    end up in FutexWait — the exact interleaving the seq_cst pairing in
+    shm_context.cc:296-305/:364-376 forbids."""
+    model = MODELS["shm_ring"].build(bug="missed_wake")
+    (v,) = explore(model).violations
+    assert v.kind == "deadlock"
+    assert v.trace == [
+        "w.stale_waiter_load",
+        "r.set_read_waiters",
+        "r.load_data_seq",
+        "r.recheck_empty",
+        "w.publish",
+        "r.futex_wait_data",
+        "w.bump_data_seq",
+        "w.wake_if_stale_saw_waiter",
+        "w.set_write_waiters",
+        "w.load_space_seq",
+        "w.recheck_space",
+        "w.futex_wait_space",
+    ]
+
+
+# --- CLI --------------------------------------------------------------------
+
+def test_cli_default_run_is_clean_and_inside_ci_budget(capsys):
+    start = time.monotonic()
+    assert cli.main([]) == 0
+    elapsed = time.monotonic() - start
+    out = capsys.readouterr().out
+    assert "10 seeded bugs re-found" in out
+    assert "0 problem(s)" in out
+    # `make check-model` gates check-tsan/check-asan: the full pass must
+    # stay far below the CI cap (it runs in well under five seconds).
+    assert elapsed < 120, "model checking no longer fits the CI budget"
+
+
+def test_cli_list_names_models_and_bugs(capsys):
+    assert cli.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name in MODELS:
+        assert name in out
+    assert "missed_wake" in out and "deadlock" in out
+
+
+def test_cli_bug_mode_prints_counterexample(capsys):
+    assert cli.main(["--model", "shm_ring", "--bug", "missed_wake"]) == 0
+    out = capsys.readouterr().out
+    assert "re-found deadlock" in out
+    assert "w.futex_wait_space" in out   # the trace is printed
+    assert "final state:" in out
+
+
+def test_cli_json_format(capsys):
+    assert cli.main(["--format", "json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["findings"] == []
+
+
+def test_cli_sarif_format(capsys):
+    assert cli.main(["--format", "sarif", "--model", "rendezvous"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["version"] == "2.1.0"
+    (run,) = payload["runs"]
+    assert run["tool"]["driver"]["name"] == "hvd-model"
+    assert run["tool"]["driver"]["informationUri"] == "docs/MODEL.md"
+    assert run["results"] == []
+
+
+def test_sarif_findings_carry_stable_fingerprints():
+    """A violation rendered through the shared reporter gets the same
+    partialFingerprints scheme hvd-lint uses, so SARIF consumers can
+    diff model regressions across commits."""
+    from horovod_tpu.lint.report import format_sarif
+
+    spec = MODELS["rendezvous"]
+    model = spec.build(bug="ungated_growth")
+    (v,) = explore(model).violations
+    finding = cli._violation_finding(spec, model, v)
+    assert finding.rule == "model-invariant"
+    assert finding.path.endswith("rendezvous.py")
+    buf = io.StringIO()
+    format_sarif([finding], 1, buf, tool_name="hvd-model",
+                 information_uri="docs/MODEL.md")
+    payload = json.loads(buf.getvalue())
+    (result,) = payload["runs"][0]["results"]
+    fp = result["partialFingerprints"]["hvdLintFingerprint/v1"]
+    assert len(fp) == 16
+    assert result["ruleId"] == "model-invariant"
+
+
+def test_cli_flags_a_missed_regression(capsys, monkeypatch):
+    """A seeded bug whose variant explores clean is a LOST regression:
+    the checker must fail CI, not silently shrink its coverage."""
+    def build(ranks=None, bug=None):
+        return _counter_model(2)  # "bug" variant is accidentally clean
+
+    fake = ModelSpec(
+        name="fake", build=build,
+        clean_builds=lambda ranks=None: [build(ranks)],
+        bugs={"lost": BugSpec("deadlock", "regression that vanished")},
+        default_ranks=2, rank_range=(2, 2), description="test double")
+    monkeypatch.setitem(cli.MODELS, "fake", fake)
+    assert cli.main(["--model", "fake"]) == 1
+    out = capsys.readouterr().out
+    assert "model-regression-missed" in out
+    assert "NOT re-found" in out
+
+
+def test_cli_budget_finding(capsys):
+    assert cli.main(["--model", "shm_ring", "--no-bugs",
+                     "--max-states", "10"]) == 1
+    out = capsys.readouterr().out
+    assert "model-budget" in out
