@@ -265,6 +265,9 @@ def _run_negotiation_bench(n, iters, extra_env=None, timeout=1800):
             m = re.search(r"METRICS_SNAPSHOT (\{.*\})", out)
             if m:
                 counters.setdefault(r, {})["metrics"] = json.loads(m.group(1))
+            m = re.search(r"TRACE_COUNTERS (\{.*\})", out)
+            if m:
+                counters.setdefault(r, {})["trace"] = json.loads(m.group(1))
     finally:
         for p in procs:
             if p.poll() is None:
@@ -1852,6 +1855,132 @@ def emit(out):
     print(json.dumps(out))
 
 
+def trace_overhead_main(args):
+    """bench.py --trace-overhead (docs/TRACING.md): is the always-on
+    span recorder actually free enough to leave on?
+
+    Interleaved A/B pairs (tracing ON first, then OFF, repeated — host
+    drift cancels) on two workloads: (1) the autotune A/B step workload
+    (48 x 128KB gradients/step at 4 ranks, tuner off) for the steps/s
+    number the <3% acceptance bounds, (2) the bucket-mode negotiation
+    microbench (16 tensors/step, HVD_TPU_CYCLE_TIME=0) — maximal span
+    rate per unit work, the recorder's worst case — for the us/op
+    number. The tracing-on negotiation run also proves drops == 0 at
+    the DEFAULT ring size: an overhead number measured while silently
+    shedding spans would be fiction."""
+    import statistics as _stats
+
+    def _steps(trace):
+        return _run_autotune_ab(4, {"HVD_TPU_AUTOTUNE": "0",
+                                    "HVD_TPU_TRACE": trace,
+                                    "AB_ITERS": str(max(150,
+                                                        args.num_iters * 4))})
+
+    # One discarded warmup run (the first launcher run of a batch is a
+    # consistent cold-start outlier), then pairs with ALTERNATING order
+    # so host drift cancels inside the per-pair delta. The overhead the
+    # <3% gate bounds is the median per-pair delta in JOB CPU-seconds
+    # per step: on a saturated 1-core host steps/s is exactly
+    # 1 / job-CPU-per-step, and wall-clock runs swing +/-15% with
+    # hypervisor steal while the rusage window doesn't (the same reason
+    # the negotiation microbench and SCALING.md measure CPU time). Wall
+    # steps/s medians ride along for the record.
+    _steps("1")
+    on_steps, off_steps, on_cpu, off_cpu, pair_pcts = [], [], [], [], []
+    for i in range(12):
+        order = ("1", "0") if i % 2 == 0 else ("0", "1")
+        pair = {}
+        for trace in order:
+            pair[trace] = _steps(trace)
+        on_steps.append(pair["1"]["steps_per_s"])
+        off_steps.append(pair["0"]["steps_per_s"])
+        cpu_on = pair["1"]["cpu_ms_per_step_job"]
+        cpu_off = pair["0"]["cpu_ms_per_step_job"]
+        on_cpu.append(cpu_on)
+        off_cpu.append(cpu_off)
+        pair_pcts.append((cpu_on - cpu_off) / cpu_off * 100)
+        print("trace overhead pair %d (%s first): cpu/step on %.2f / "
+              "off %.2f ms (%.2f%%); wall on %.2f / off %.2f steps/s"
+              % (i + 1, "on" if order[0] == "1" else "off", cpu_on,
+                 cpu_off, pair_pcts[-1], pair["1"]["steps_per_s"],
+                 pair["0"]["steps_per_s"]), file=sys.stderr)
+    step_on = _stats.median(on_steps)
+    step_off = _stats.median(off_steps)
+    step_overhead_pct = round(_stats.median(pair_pcts), 2)
+    print("trace overhead (step workload): %.2f%% job-CPU-per-step cost "
+          "(wall medians %.2f -> %.2f steps/s)"
+          % (step_overhead_pct, step_off, step_on), file=sys.stderr)
+
+    neg_iters = max(100, args.num_iters * 10)
+    neg_env = {"HVD_TPU_CYCLE_TIME": "0", "HVD_TPU_BENCH_TENSORS": "16"}
+    on_us, off_us, neg_pair_pcts = [], [], []
+    trace_ctr = None
+    for i in range(5):
+        order = ("1", "0") if i % 2 == 0 else ("0", "1")
+        pair_cpu = {}
+        for trace in order:
+            us, ctr = _run_negotiation_bench(
+                4, neg_iters, dict(neg_env, HVD_TPU_TRACE=trace))
+            (on_us if trace == "1" else off_us).append(us)
+            c0 = ctr.get(0) or {}
+            # Coordinator CPU-us per op — steal-immune, like the step
+            # workload's job-CPU metric (wall us/op rides along).
+            pair_cpu[trace] = (c0["cpu_us"] /
+                               (c0["iters"] * c0["tensors_per_step"]))
+            if trace == "1":
+                trace_ctr = c0.get("trace") or trace_ctr
+        neg_pair_pcts.append(
+            (pair_cpu["1"] - pair_cpu["0"]) / pair_cpu["0"] * 100)
+    neg_on = _stats.median(on_us)
+    neg_off = _stats.median(off_us)
+    neg_overhead_pct = round(_stats.median(neg_pair_pcts), 2)
+    spans = int((trace_ctr or {}).get("trace_spans_total", 0))
+    dropped = int((trace_ctr or {}).get("trace_spans_dropped_total", -1))
+    print("trace overhead (negotiation worst case): %.2f%% coordinator-"
+          "CPU-per-op cost (wall medians %.1f -> %.1f us/op); rank-0 "
+          "spans %d, dropped %d"
+          % (neg_overhead_pct, neg_off, neg_on, spans, dropped),
+          file=sys.stderr)
+
+    ok = (step_overhead_pct < 3.0 and spans > 0 and dropped == 0)
+    emit({
+        "round": 13,
+        "command": "JAX_PLATFORMS=cpu python bench.py --trace-overhead",
+        "note": "always-on trace recorder A/B (docs/TRACING.md): one "
+                "discarded warmup run, then 12 on/off pairs in "
+                "ALTERNATING order (drift cancels inside each pair); "
+                "value = median per-pair delta in JOB CPU-seconds per "
+                "step, the determinant of steps/s on a saturated "
+                "1-core host (wall runs swing +/-15% with hypervisor "
+                "steal; CPU time measures the framework — the "
+                "SCALING.md methodology). Step workload = autotune A/B "
+                "shape (48 x 128KB gradients/step, 4 ranks, tuner "
+                "off); negotiation workload = bucket-mode control-"
+                "plane microbench (16 tensors/step, cycle pacing off) "
+                "as the recorder's worst case, its overhead likewise "
+                "the median per-pair delta in coordinator CPU-us per "
+                "op over 5 alternating pairs. "
+                "Acceptance: steps/s cost < 3% with ZERO ring drops "
+                "at the default HVD_TPU_TRACE_RING.",
+        "metric": "trace_overhead_steps_pct",
+        "value": step_overhead_pct,
+        "unit": "percent_steps_per_s_cost",
+        "steps_per_s_tracing_off": step_off,
+        "steps_per_s_tracing_on": step_on,
+        "cpu_ms_per_step_job_off": _stats.median(off_cpu),
+        "cpu_ms_per_step_job_on": _stats.median(on_cpu),
+        "negotiation_us_per_op_off": neg_off,
+        "negotiation_us_per_op_on": neg_on,
+        "negotiation_overhead_pct": neg_overhead_pct,
+        "rank0_spans_total": spans,
+        "rank0_spans_dropped": dropped,
+        "vs_baseline": None,
+        "baseline": "no prior tracing round (BENCH_r13 introduces the "
+                    "recorder); acceptance: <3% steps/s cost, 0 drops",
+    })
+    return 0 if ok else 1
+
+
 def _cpu_per_cycle(ctr):
     """Rank-0 CPU-us per work cycle from a negotiation-bench counter
     dict (None when the worker predates the cpu_us field)."""
@@ -2288,6 +2417,11 @@ def main():
                          "loop RPS/latency curve on a 2-replica pool "
                          "plus the autoscale-on-traffic-step row; "
                          "CPU-only, prints one JSON line (BENCH_r12)")
+    ap.add_argument("--trace-overhead", action="store_true",
+                    help="A/B the always-on trace recorder "
+                         "(docs/TRACING.md): tracing on vs off on the "
+                         "step and negotiation workloads; CPU-only, "
+                         "prints one JSON line (BENCH_r13)")
     ap.add_argument("--scaling", action="store_true",
                     help="regenerate the SCALING.md evidence (weak "
                          "scaling on the virtual CPU mesh + negotiation "
@@ -2333,6 +2467,8 @@ def main():
         return durable_commit_main(args)
     if args.serve:
         return serve_main(args)
+    if args.trace_overhead:
+        return trace_overhead_main(args)
     if args.scaling:
         return scaling_main(args)
     if args.all_models:

@@ -232,6 +232,23 @@ class HorovodBasics:
             self._has_autotune_json = True
         except AttributeError:
             self._has_autotune_json = False
+        # Distributed tracing (native/trace.h, docs/TRACING.md) — also
+        # optional, same stale-build tolerance.
+        try:
+            lib.horovod_tpu_trace_now_ns.restype = ctypes.c_int64
+            lib.horovod_tpu_trace_now_ns.argtypes = []
+            lib.horovod_tpu_trace_record.restype = None
+            lib.horovod_tpu_trace_record.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+            lib.horovod_tpu_trace_dump_bundle.restype = ctypes.c_char_p
+            lib.horovod_tpu_trace_dump_bundle.argtypes = [ctypes.c_char_p]
+            lib.horovod_tpu_trace_counters.restype = None
+            lib.horovod_tpu_trace_counters.argtypes = [
+                ctypes.POINTER(ctypes.c_uint64)]
+            self._has_trace = True
+        except AttributeError:
+            self._has_trace = False
 
     # -- lifecycle ---------------------------------------------------------
     def init(self):
@@ -398,6 +415,47 @@ class HorovodBasics:
                 "hierarchical_allreduce": bool(har.value),
                 "hierarchical_allgather": bool(hag.value),
                 "active": bool(active.value)}
+
+    # -- distributed tracing (docs/TRACING.md) -----------------------------
+    def trace_now_ns(self):
+        """Monotonic trace-clock ns on the native recorder's per-process
+        epoch; 0 on a pre-trace core build."""
+        if not self._has_trace:
+            return 0
+        return int(self.lib.horovod_tpu_trace_now_ns())
+
+    def trace_record(self, name, phase, start_ns, end_ns, nbytes=0,
+                     group=0):
+        """Records one span into the native trace ring (no-op before
+        init, with HVD_TPU_TRACE=0, or on a pre-trace core). `phase`
+        takes the wire values from native/trace.h (8 = request)."""
+        if not self._has_trace:
+            return
+        self.lib.horovod_tpu_trace_record(
+            name.encode("utf-8"), int(phase), int(start_ns), int(end_ns),
+            int(nbytes), int(group))
+
+    def trace_dump_bundle(self, reason="manual"):
+        """Forces a flight-recorder bundle dump; returns the bundle path
+        or "" when HVD_TPU_BUNDLE_DIR is unset, the per-process cap is
+        hit, or the core predates tracing."""
+        if not self._has_trace:
+            return ""
+        out = self.lib.horovod_tpu_trace_dump_bundle(
+            reason.encode("utf-8"))
+        return out.decode("utf-8") if out else ""
+
+    def trace_counters(self):
+        """Dict of trace_spans_total / trace_spans_dropped_total /
+        bundles_written_total (all zero on a pre-trace core)."""
+        if not self._has_trace:
+            return {"trace_spans_total": 0, "trace_spans_dropped_total": 0,
+                    "bundles_written_total": 0}
+        out = (ctypes.c_uint64 * 3)()
+        self.lib.horovod_tpu_trace_counters(out)
+        return {"trace_spans_total": int(out[0]),
+                "trace_spans_dropped_total": int(out[1]),
+                "bundles_written_total": int(out[2])}
 
     # -- topology ----------------------------------------------------------
     def rank(self):

@@ -17,6 +17,8 @@ int64_t HorovodOp::NumElements(
 Status HorovodOp::MemcpyInFusionBuffer(std::vector<TensorTableEntry>& entries,
                                        void** buffer_data,
                                        std::size_t* buffer_len) {
+  Trace& trace = global_state_->trace;
+  const int64_t t_fuse_start = trace.NowNs();
   std::size_t total = 0;
   for (const auto& e : entries) total += e.SizeBytes();
   Status status = global_state_->fusion_buffer.InitializeBuffer(
@@ -30,6 +32,10 @@ Status HorovodOp::MemcpyInFusionBuffer(std::vector<TensorTableEntry>& entries,
   }
   *buffer_data = buf;
   *buffer_len = total;
+  trace.Record(entries.empty() ? "fuse" : entries[0].tensor_name.c_str(),
+               TRACE_FUSE, t_fuse_start, trace.NowNs(),
+               static_cast<int64_t>(total),
+               entries.empty() ? 0 : entries[0].group_id);
   return Status::OK();
 }
 

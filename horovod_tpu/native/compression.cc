@@ -9,6 +9,7 @@
 
 #include "half.h"
 #include "metrics.h"
+#include "trace.h"
 
 namespace hvdtpu {
 
@@ -70,6 +71,16 @@ void CountCodecWork(CompressionMode mode, int64_t count,
     }
   }
   m.compression_seconds.Observe(seconds);
+  // Codec span (trace.h): records from the worker threads the pipelined
+  // ring runs codecs on — the ring write is lock-free and thread-safe.
+  Trace& t = GlobalTrace();
+  if (t.enabled()) {
+    const int64_t end_ns = t.NowNs();
+    t.Record(compress ? "encode" : "decode",
+             compress ? TRACE_ENCODE : TRACE_DECODE,
+             end_ns - static_cast<int64_t>(seconds * 1e9), end_ns,
+             static_cast<int64_t>(wire_bytes));
+  }
 }
 
 }  // namespace

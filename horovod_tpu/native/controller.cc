@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <cassert>
+#include <cstdio>
 #include <cstring>
 #include <sstream>
 #include <unordered_set>
@@ -10,6 +11,7 @@
 #include "logging.h"
 #include "metrics.h"
 #include "parameter_manager.h"
+#include "trace.h"
 
 namespace hvdtpu {
 
@@ -426,6 +428,9 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
     // Gather worker RequestLists (rank 0's own slot is unused).
     std::vector<std::string> blobs;
     GatherBlobs(std::string(), &blobs);
+    // Clock-alignment T2: the reference clock's reading right after the
+    // gather returned (the workers stamped T1 just before sending).
+    const int64_t clock_t2 = GlobalTrace().NowNs();
     for (int r = 1; r < size_; ++r) {
       RequestList list;
       if (!list.ParseFrom(blobs[r].data(), blobs[r].size())) {
@@ -484,8 +489,10 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
     // Divergence cross-check: fail provably diverged pending tensors NOW
     // with a named call site, instead of letting them hang to the stall
     // timeout (divergence.h documents the two proof rules).
+    bool diverged = false;
     for (const auto& diag : divergence_.Check(message_table_,
                                               group_table_)) {
+      diverged = true;
       LOG(ERROR) << diag.message;
       GlobalMetrics().divergence_errors_total.fetch_add(
           1, std::memory_order_relaxed);
@@ -502,6 +509,13 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
       error.set_error_message(diag.message);
       responses.push_back(std::move(error));
     }
+    if (diverged) {
+      // Flight recorder: the coordinator holds the proof (pending table
+      // + call records); the workers hold their own in-flight evidence
+      // — dump here, flag them to dump on parse.
+      GlobalTrace().DumpBundle("divergence", PendingNegotiationJson());
+      pending_trace_flags_ |= ResponseList::kFlagDumpBundle;
+    }
     response_list.set_shutdown(should_shut_down);
     FuseResponses(responses, response_list);
     // Autotune bootstrap: consume any pending re-arm NOW (after fusion,
@@ -510,6 +524,11 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
     // cycle, so the whole ring re-enters tuning in lockstep.
     response_list.set_autotune_wire(
         parameter_manager_.WireEpochForBroadcast());
+    // Clock-alignment T3 (right before the broadcast) + any armed
+    // bundle-dump flag ride the same tail.
+    response_list.set_clock(clock_t2, GlobalTrace().NowNs());
+    response_list.set_trace_flags(pending_trace_flags_);
+    pending_trace_flags_ = 0;
     std::string blob;
     response_list.SerializeTo(&blob);
     BroadcastBlob(&blob);
@@ -537,14 +556,28 @@ ResponseList Controller::FinishCycle(std::deque<Response> responses,
     }
     std::string blob;
     message_list.SerializeTo(&blob);
+    // Clock-alignment T1/T4 bracket the gather+broadcast round trip;
+    // the coordinator's T2/T3 stamps ride the ResponseList tail back.
+    Trace& trace = GlobalTrace();
+    const int64_t clock_t1 = trace.NowNs();
     GatherBlobs(blob, nullptr);
     std::string response_blob;
     BroadcastBlob(&response_blob);
+    const int64_t clock_t4 = trace.NowNs();
     if (!response_list.ParseFrom(response_blob.data(), response_blob.size())) {
       LOG(FATAL) << "Failed to parse ResponseList from coordinator";
     }
     if (response_list.autotune_wire() != ResponseList::kAutotuneAbsent) {
       parameter_manager_.NoteWireEpoch(response_list.autotune_wire());
+    }
+    if (response_list.clock_t2() >= 0 && response_list.clock_t3() >= 0) {
+      trace.UpdateClockSample(clock_t1, response_list.clock_t2(),
+                              response_list.clock_t3(), clock_t4);
+    }
+    if (response_list.trace_flags() & ResponseList::kFlagDumpBundle) {
+      // The coordinator saw a stall escalation / divergence this cycle;
+      // dump while the evidence is still in this rank's ring.
+      trace.DumpBundle("escalation", std::string());
     }
   }
   // Work on ANY rank makes this a full work cycle (the final list is
@@ -630,8 +663,18 @@ ResponseList Controller::ComputeResponseList(
     if (is_coordinator() &&
         stall_inspector_.CheckForStalledTensors(size_)) {
       this_process_requested_shutdown = true;
+      // Flight recorder: capture the pending table (missing ranks by
+      // name) before the coordinated shutdown tears it down, and arm
+      // the broadcast flag so every worker dumps too.
+      GlobalTrace().DumpBundle("stall_escalation", PendingNegotiationJson());
+      pending_trace_flags_ |= ResponseList::kFlagDumpBundle;
     }
     stall_inspector_.UpdateCheckTime();
+  }
+  // An armed bundle flag rides full-cycle broadcasts only — break the
+  // all-cached fast path until FinishCycle ships it.
+  if (is_coordinator() && pending_trace_flags_ != 0) {
+    cache_coordinator.set_uncached_in_queue(true);
   }
   // Quiescent-stall escape hatch: when every rank is blocked waiting, no
   // rank has uncached work, so cycles ride the fast bit-sync and the
@@ -733,6 +776,59 @@ ResponseList Controller::ComputeResponseList(
 
   return FinishCycle(std::move(cached_responses), non_cached_messages,
                      should_shut_down);
+}
+
+std::string Controller::PendingNegotiationJson() const {
+  if (!is_coordinator()) return "{}";
+  auto now = std::chrono::steady_clock::now();
+  std::string out = "{\"pending\":[";
+  bool first_entry = true;
+  for (const auto& kv : message_table_) {
+    if (!first_entry) out += ',';
+    first_entry = false;
+    out += "{\"name\":\"";
+    for (char c : kv.first) {
+      if (c == '"' || c == '\\') out += '\\';
+      out += c;
+    }
+    out += "\",\"reported\":[";
+    std::unordered_set<int> reported;
+    bool first_rank = true;
+    for (const auto& req : kv.second) {
+      reported.insert(req.request_rank());
+      if (!first_rank) out += ',';
+      first_rank = false;
+      out += std::to_string(req.request_rank());
+    }
+    out += "],\"missing\":[";
+    const Request& head = kv.second.front();
+    std::vector<int> members;
+    if (head.group_id() != 0 && group_table_ != nullptr) {
+      members = group_table_->Members(head.group_id());
+    }
+    if (members.empty()) {
+      for (int r = 0; r < size_; ++r) members.push_back(r);
+    }
+    first_rank = true;
+    for (int r : members) {
+      if (reported.count(r)) continue;
+      if (!first_rank) out += ',';
+      first_rank = false;
+      out += std::to_string(r);
+    }
+    out += "],\"age_seconds\":";
+    double age = 0.0;
+    auto it = negotiate_started_.find(kv.first);
+    if (it != negotiate_started_.end()) {
+      age = std::chrono::duration<double>(now - it->second).count();
+    }
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "%.3f", age);
+    out += buf;
+    out += '}';
+  }
+  out += "]}";
+  return out;
 }
 
 }  // namespace hvdtpu

@@ -85,6 +85,14 @@ class Controller {
 
   StallInspector& stall_inspector() { return stall_inspector_; }
 
+  // Coordinator: the pending-negotiation table as JSON — every tensor
+  // still waiting on announcements, which ranks reported it and which
+  // are missing (group members for group tensors, world otherwise).
+  // The flight recorder embeds it in post-mortem bundles (trace.h) so a
+  // bundle names the missing rank and the in-flight tensors. "{}" off
+  // the coordinator.
+  std::string PendingNegotiationJson() const;
+
   // --- divergence cross-check (divergence.h) ---
   // The process-wide call tracker feeds each cycle's RequestList with this
   // rank's (seq, digest, recent calls); on the coordinator the detector
@@ -209,6 +217,12 @@ class Controller {
 
   std::atomic<uint64_t> cycles_fast_{0};
   std::atomic<uint64_t> cycles_full_{0};
+
+  // Coordinator: ResponseList::kFlagDumpBundle et al, armed by a stall
+  // escalation / divergence this cycle and shipped on the next
+  // FinishCycle broadcast so every worker dumps a flight-recorder
+  // bundle while the evidence is still in its ring (trace.h).
+  uint8_t pending_trace_flags_ = 0;
 
   uint32_t cache_capacity_ = 1024;
 };

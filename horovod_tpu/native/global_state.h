@@ -18,6 +18,7 @@
 #include "tcp_context.h"
 #include "tensor_queue.h"
 #include "timeline.h"
+#include "trace.h"
 
 namespace hvdtpu {
 
@@ -60,6 +61,9 @@ struct HorovodGlobalState {
   // singleton: leaf components without a state pointer (stall inspector,
   // the C snapshot API) reach the same registry via GlobalMetrics().
   Metrics& metrics = GlobalMetrics();
+  // Always-on span recorder + flight recorder (trace.h). Same singleton
+  // pattern as metrics: leaf components reach it via GlobalTrace().
+  Trace& trace = GlobalTrace();
   std::unique_ptr<Controller> controller;
   std::unique_ptr<OperationManager> op_manager;
 

@@ -294,6 +294,11 @@ void ResponseList::SerializeTo(std::string* out) const {
   PutU32(out, static_cast<uint32_t>(responses_.size()));
   for (const auto& resp : responses_) resp.SerializeTo(out);
   PutI64(out, static_cast<int64_t>(autotune_wire_));
+  // Clock-alignment tail (after the autotune word; same
+  // forward-compatibility rule — older decoders ignore it).
+  PutI64(out, clock_t2_);
+  PutI64(out, clock_t3_);
+  PutU8(out, trace_flags_);
 }
 
 bool ResponseList::ParseFrom(const char* data, std::size_t len) {
@@ -317,6 +322,19 @@ bool ResponseList::ParseFrom(const char* data, std::size_t len) {
   int64_t wire;
   autotune_wire_ = tail.GetI64(&wire) ? static_cast<uint64_t>(wire)
                                       : kAutotuneAbsent;
+  // Clock-alignment tail (trace.h): continue reading the same tail —
+  // absent on a pre-trace writer's blob means "no sample", not an
+  // error.
+  int64_t t2, t3;
+  uint8_t tf;
+  if (tail.GetI64(&t2) && tail.GetI64(&t3)) {
+    clock_t2_ = t2;
+    clock_t3_ = t3;
+  } else {
+    clock_t2_ = -1;
+    clock_t3_ = -1;
+  }
+  trace_flags_ = tail.GetU8(&tf) ? tf : 0;
   return true;
 }
 

@@ -247,6 +247,25 @@ class ResponseList {
   uint64_t autotune_wire() const { return autotune_wire_; }
   void set_autotune_wire(uint64_t v) { autotune_wire_ = v; }
 
+  // Clock-alignment piggyback (trace.h, docs/TRACING.md): the
+  // coordinator's trace-clock stamps taken right after its gather
+  // returned (T2) and right before its broadcast (T3), appended AFTER
+  // the autotune word — pre-trace decoders stop at the shorter blob and
+  // see -1 ("no sample"). The worker combines them with its own
+  // T1(pre-gather)/T4(post-broadcast) stamps into an NTP offset sample.
+  int64_t clock_t2() const { return clock_t2_; }
+  int64_t clock_t3() const { return clock_t3_; }
+  void set_clock(int64_t t2, int64_t t3) {
+    clock_t2_ = t2;
+    clock_t3_ = t3;
+  }
+  // Coordinator->worker flag bits on the same tail. Bit 0: every rank
+  // dumps a flight-recorder bundle this cycle (stall escalation /
+  // divergence — the coordinator saw it, the workers hold the evidence).
+  static constexpr uint8_t kFlagDumpBundle = 1;
+  uint8_t trace_flags() const { return trace_flags_; }
+  void set_trace_flags(uint8_t f) { trace_flags_ = f; }
+
   void SerializeTo(std::string* out) const;
   bool ParseFrom(const char* data, std::size_t len);
 
@@ -254,6 +273,9 @@ class ResponseList {
   std::vector<Response> responses_;
   bool shutdown_ = false;
   uint64_t autotune_wire_ = kAutotuneAbsent;
+  int64_t clock_t2_ = -1;
+  int64_t clock_t3_ = -1;
+  uint8_t trace_flags_ = 0;
 };
 
 // --- low-level wire helpers (shared with net.cc) ---

@@ -4,6 +4,8 @@
 #include <cstdio>
 #include <sstream>
 
+#include "trace.h"
+
 namespace hvdtpu {
 
 MetricHistogram::MetricHistogram(std::vector<double> bounds, double scale)
@@ -78,6 +80,9 @@ const char* SummaryFieldName(int field) {
     case SUM_GROUP_TENSORS: return "group_tensors_total";
     case SUM_SHM_SEGMENTS: return "shm_segments_active";
     case SUM_SHM_BYTES_SENT: return "net_shm_bytes_sent_total";
+    case SUM_TRACE_SPANS: return "trace_spans_total";
+    case SUM_TRACE_SPANS_DROPPED: return "trace_spans_dropped_total";
+    case SUM_BUNDLES_WRITTEN: return "bundles_written_total";
   }
   return "unknown";
 }
@@ -185,6 +190,16 @@ std::vector<double> Metrics::Summary() const {
   v[SUM_SHM_SEGMENTS] = static_cast<double>(shm_segments_active.load());
   v[SUM_SHM_BYTES_SENT] =
       static_cast<double>(net_shm_bytes_sent_total.load());
+  {
+    // The trace recorder owns its counters (trace.h); the summary wire
+    // carries them like any registry field so hvd-top's `trc` column
+    // and the job view see every rank's span/drop/bundle totals.
+    const Trace& t = GlobalTrace();
+    v[SUM_TRACE_SPANS] = static_cast<double>(t.spans_total.load());
+    v[SUM_TRACE_SPANS_DROPPED] =
+        static_cast<double>(t.spans_dropped.load());
+    v[SUM_BUNDLES_WRITTEN] = static_cast<double>(t.bundles_written.load());
+  }
   return v;
 }
 
@@ -340,6 +355,12 @@ std::string Metrics::SnapshotJson() const {
   AppendKV(&out, "group_tensors_total", group_tensors_total.load(), &first);
   AppendKV(&out, "group_negotiated_overflow_total",
            group_negotiated_overflow_total.load(), &first);
+  AppendKV(&out, "trace_spans_total", GlobalTrace().spans_total.load(),
+           &first);
+  AppendKV(&out, "trace_spans_dropped_total",
+           GlobalTrace().spans_dropped.load(), &first);
+  AppendKV(&out, "bundles_written_total",
+           GlobalTrace().bundles_written.load(), &first);
   out.append("},\"gauges\":{");
   first = true;
   AppendKV(&out, "queue_depth", static_cast<double>(queue_depth.load()),

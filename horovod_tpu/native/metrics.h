@@ -129,6 +129,15 @@ enum SummaryField : int {
   // `shm` column renders them ('-' for a pre-shm worker's summary).
   SUM_SHM_SEGMENTS,
   SUM_SHM_BYTES_SENT,
+  // Distributed tracing + flight recorder (docs/TRACING.md). Appended
+  // after the shm fields: spans recorded / spans lost to ring overrun
+  // on this rank, and post-mortem bundles it wrote; the hvd-top `trc`
+  // column renders them ('-' for a pre-trace worker's summary). The
+  // values live in the Trace singleton (trace.h) — Summary() reads
+  // them through GlobalTrace() like any other registry field.
+  SUM_TRACE_SPANS,
+  SUM_TRACE_SPANS_DROPPED,
+  SUM_BUNDLES_WRITTEN,
   SUM_FIELD_COUNT
 };
 const char* SummaryFieldName(int field);

@@ -13,6 +13,8 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <csignal>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <memory>
@@ -29,6 +31,7 @@
 #include "global_state.h"
 #include "logging.h"
 #include "tcp_controller.h"
+#include "trace.h"
 
 namespace hvdtpu {
 
@@ -127,6 +130,56 @@ class HandleManager {
 
 HandleManager g_handles;
 
+// ---------------- fatal-signal flight recorder ----------------
+// A crashing rank is about to lose its buffered evidence: the trace ring,
+// a possibly-unflushed shard, and an unterminated timeline JSON array.
+// Dump a best-effort bundle and finalize the timeline, then restore the
+// previous disposition and re-raise so the exit (core dump, abort status)
+// is unchanged. Formally async-signal-unsafe (locks, allocation) — but the
+// process is dying anyway, and a rare self-deadlock here costs nothing the
+// crash wasn't already taking.
+
+struct sigaction g_prev_sigactions[NSIG];
+std::atomic<bool> g_fatal_dump_done{false};
+
+void FatalSignalHandler(int sig) {
+  // Restore the previous disposition FIRST: if the dump itself faults,
+  // the re-entered signal takes the old path and the process still dies.
+  if (sig >= 0 && sig < NSIG) {
+    sigaction(sig, &g_prev_sigactions[sig], nullptr);
+  }
+  if (!g_fatal_dump_done.exchange(true)) {
+    char reason[32];
+    std::snprintf(reason, sizeof(reason), "fatal_signal_%d", sig);
+    // No PendingNegotiationJson here: the controller's tables belong to
+    // the background thread and are not guarded against this (arbitrary)
+    // crashing thread.
+    GlobalTrace().DumpBundle(reason, std::string());
+    g_state.timeline.EmergencyFinalize();
+  }
+  raise(sig);
+}
+
+void InstallFatalSignalHandlers() {
+  static std::atomic<bool> installed{false};
+  if (installed.exchange(true)) return;
+  struct sigaction sa;
+  std::memset(&sa, 0, sizeof(sa));
+  sa.sa_handler = FatalSignalHandler;
+  sigemptyset(&sa.sa_mask);
+  for (int sig : {SIGSEGV, SIGABRT, SIGBUS, SIGFPE}) {
+    sigaction(sig, &sa, &g_prev_sigactions[sig]);
+  }
+  // SIGTERM only when nothing else claimed it: Python/launcher handlers
+  // keep precedence, but a default-disposition TERM (the launcher's kill
+  // path) should finalize the timeline before the process goes.
+  struct sigaction cur;
+  if (sigaction(SIGTERM, nullptr, &cur) == 0 && cur.sa_handler == SIG_DFL &&
+      (cur.sa_flags & SA_SIGINFO) == 0) {
+    sigaction(SIGTERM, &sa, &g_prev_sigactions[SIGTERM]);
+  }
+}
+
 // ---------------- background loop ----------------
 // (env parsing lives in common.h EnvInt64/EnvDouble/EnvBool)
 
@@ -178,6 +231,22 @@ std::pair<int64_t, int64_t> PerformOperation(HorovodGlobalState& state,
       metrics.fusion_fill_ratio.Observe(fill > 1.0 ? 1.0 : fill);
     }
   }
+  Trace& trace = state.trace;
+  const int64_t t_exec_start = trace.NowNs();
+  if (trace.enabled()) {
+    // Close the negotiation-wait span opened at enqueue: the gap from
+    // submission to execution is what the cross-rank agreement (and any
+    // straggler) cost this tensor.
+    for (const auto& e : entries) {
+      int64_t opened = trace.CloseSpan(
+          GroupQualifiedName(response.group_id(), e.tensor_name));
+      if (opened >= 0) {
+        trace.Record(e.tensor_name.c_str(), TRACE_NEGOTIATE, opened,
+                     t_exec_start, static_cast<int64_t>(e.SizeBytes()),
+                     response.group_id());
+      }
+    }
+  }
   for (const auto& e : entries) {
     state.timeline.Start(e.tensor_name, response.response_type());
   }
@@ -187,10 +256,17 @@ std::pair<int64_t, int64_t> PerformOperation(HorovodGlobalState& state,
   } catch (const std::exception& ex) {
     status = Status::UnknownError(ex.what());
   }
+  // One exec span per response: a fused response executes as one wire
+  // operation, named by its first tensor.
+  const int64_t t_exec_end = trace.NowNs();
+  trace.Record(entries[0].tensor_name.c_str(), TRACE_EXEC, t_exec_start,
+               t_exec_end, bytes, response.group_id());
   for (auto& e : entries) {
     state.timeline.End(e.tensor_name, status.ok());
     if (e.callback) e.callback(status, e);
   }
+  trace.Record(entries[0].tensor_name.c_str(), TRACE_CALLBACK, t_exec_end,
+               trace.NowNs(), 0, response.group_id());
   // A data-plane transport loss (ring EOF / checksum mismatch / deadline
   // — cpu_operations.cc RingLost) leaves the ring desynced: later
   // exchanges would pair mismatched steps. Escalate to the same
@@ -388,6 +464,14 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
       EnvInt64(HVD_TPU_DIVERGENCE_CALLS, 64),
       EnvDouble(HVD_TPU_DIVERGENCE_GRACE, 5.0));
 
+  // Span recorder + flight recorder (trace.h, docs/TRACING.md): always on
+  // unless HVD_TPU_TRACE=0. The generation tags shard files and bundles so
+  // merged traces keep elastic re-inits apart. Fatal-signal hooks ride the
+  // same init so a crashing rank still flushes its evidence.
+  state.trace.Configure(state.controller->rank(), state.controller->size(),
+                        EnvInt64(HVD_TPU_GENERATION_ENV, 0));
+  InstallFatalSignalHandlers();
+
   const char* timeline_path = std::getenv(HVD_TPU_TIMELINE);
   if (timeline_path != nullptr) {
     state.timeline.Initialize(timeline_path,
@@ -451,9 +535,16 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
     }
   } catch (const ConnectionLostError& ex) {
     // A peer died mid-protocol. Recoverable: the process survives, and a
-    // later shutdown()+init() joins the next elastic generation.
+    // later shutdown()+init() joins the next elastic generation. Dump the
+    // flight recorder first — on the coordinator the pending table names
+    // the missing rank and the in-flight tensors.
     LOG(ERROR) << "peer connection lost: " << ex.what();
     state.connection_lost.store(true);
+    std::string bundle = state.trace.DumpBundle(
+        "connection_lost", state.controller->PendingNegotiationJson());
+    if (!bundle.empty()) {
+      LOG(ERROR) << "post-mortem bundle: " << bundle;
+    }
   } catch (const std::exception& ex) {
     LOG(ERROR) << "background loop terminated: " << ex.what();
   }
@@ -467,6 +558,10 @@ void BackgroundThreadLoop(HorovodGlobalState& state) {
   state.tensor_queue.FinalizeTensorQueue(fail_status);
   g_handles.FailAll(fail_status);
   state.timeline.Shutdown();
+  // Drain the ring to the shard file; the drainer thread itself survives
+  // the generation (process-lifetime singleton, like the metrics registry)
+  // so an elastic re-init just re-Configures.
+  state.trace.FlushShard();
   state.tcp_context.Finalize();
 }
 
@@ -596,6 +691,7 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
                        done_entry.gathered_sizes);
   };
   LOG(TRACE) << "enqueue " << name << " handle " << handle;
+  const int64_t payload_bytes = static_cast<int64_t>(entry.SizeBytes());
   Status status = g_state.tensor_queue.AddToTensorQueue(std::move(entry),
                                                         std::move(message));
   // Only ADMITTED calls enter the fingerprint: a rejected enqueue (e.g.
@@ -611,6 +707,16 @@ Status EnqueueTensor(Request::RequestType type, const char* name,
         GroupQualifiedName(static_cast<uint32_t>(group), name));
     g_state.metrics.tensors_enqueued_total.fetch_add(
         1, std::memory_order_relaxed);
+    Trace& trace = g_state.trace;
+    if (trace.enabled()) {
+      // Instant enqueue span + the open negotiation-wait span
+      // PerformOperation closes when this tensor finally executes.
+      const int64_t now = trace.NowNs();
+      trace.Record(name, TRACE_ENQUEUE, now, now, payload_bytes,
+                   static_cast<uint32_t>(group));
+      trace.OpenSpan(GroupQualifiedName(static_cast<uint32_t>(group), name),
+                     now);
+    }
   }
   return status;
 }
@@ -773,6 +879,11 @@ void horovod_tpu_drain_metrics(int64_t requested, int64_t draining) {
   if (requested > 0) m.drains_requested_total.fetch_add(
       static_cast<uint64_t>(requested), std::memory_order_relaxed);
   if (draining >= -1) m.draining.store(draining, std::memory_order_relaxed);
+  if (draining == 1) {
+    // A drain victim is about to leave the job: preserve its evidence
+    // window while the ring still holds the final cycles.
+    GlobalTrace().DumpBundle("drain", std::string());
+  }
 }
 
 // This rank's collective call-sequence fingerprint: seq = number of
@@ -1092,5 +1203,41 @@ const void* horovod_tpu_allgather_data(int handle) {
 }
 
 void horovod_tpu_release(int handle) { g_handles.Release(handle); }
+
+// ---------------- distributed tracing (trace.h / docs/TRACING.md) ------
+
+// Monotonic trace-clock ns (per-process epoch): Python-emitted spans land
+// on the same clock the native ring uses.
+int64_t horovod_tpu_trace_now_ns() { return GlobalTrace().NowNs(); }
+
+// Record a span from Python (serve plane, tests). `phase` takes the wire
+// values from trace.h (TRACE_ENQUEUE..TRACE_REQUEST); group 0 = world.
+// No-op until init configures the recorder or when HVD_TPU_TRACE=0.
+void horovod_tpu_trace_record(const char* name, int phase, int64_t start_ns,
+                              int64_t end_ns, int64_t bytes, int group) {
+  GlobalTrace().Record(name == nullptr ? "" : name, phase, start_ns, end_ns,
+                       bytes, group < 0 ? 0u : static_cast<uint32_t>(group));
+}
+
+// Force a flight-recorder bundle (drain handlers, tests). Returns the
+// bundle path, or "" when HVD_TPU_BUNDLE_DIR is unset / the per-process
+// cap is hit. Pending-negotiation state is deliberately omitted: this is
+// callable from any thread, and the controller's tables belong to the
+// background thread.
+const char* horovod_tpu_trace_dump_bundle(const char* reason) {
+  static thread_local std::string out;
+  out = GlobalTrace().DumpBundle(reason == nullptr ? "manual" : reason,
+                                 std::string());
+  return out.c_str();
+}
+
+// out[0]=spans recorded  out[1]=spans dropped (ring overrun)  out[2]=bundles
+void horovod_tpu_trace_counters(uint64_t* out) {
+  if (out == nullptr) return;
+  Trace& t = GlobalTrace();
+  out[0] = t.spans_total.load(std::memory_order_relaxed);
+  out[1] = t.spans_dropped.load(std::memory_order_relaxed);
+  out[2] = t.bundles_written.load(std::memory_order_relaxed);
+}
 
 }  // extern "C"

@@ -16,6 +16,7 @@
 #include "fault.h"
 #include "logging.h"
 #include "metrics.h"
+#include "trace.h"
 
 namespace hvdtpu {
 
@@ -958,6 +959,8 @@ bool TcpContext::ControlSendFrame(uint32_t tag, const void* payload,
   while (true) {
     if (control_conns_[0].SendFrame(tag, payload, len)) {
       ++my_ctrl_opseq_;
+      GlobalTrace().NoteControlFrame(tag, /*send=*/true,
+                                     len + kFrameHeaderBytes);
       return true;
     }
     NetError err = control_conns_[0].last_error();
@@ -978,6 +981,8 @@ bool TcpContext::ControlRecvFrame(uint32_t expect_tag, std::string* payload) {
         return false;
       }
       ++my_ctrl_opseq_;
+      GlobalTrace().NoteControlFrame(tag, /*send=*/false,
+                                     payload->size() + kFrameHeaderBytes);
       return true;
     }
     NetError err = control_conns_[0].last_error();
@@ -997,6 +1002,8 @@ bool TcpContext::ControlRecvFrameInto(uint32_t expect_tag, void* buf,
         return false;
       }
       ++my_ctrl_opseq_;
+      GlobalTrace().NoteControlFrame(tag, /*send=*/false,
+                                     len + kFrameHeaderBytes);
       return true;
     }
     NetError err = control_conns_[0].last_error();
@@ -1457,6 +1464,8 @@ bool TcpContext::GatherBlobs(const std::string& mine,
     for (int r = 1; r < size_; ++r) recvd += (*all)[r].size();
     ctrl_bytes_recv_ += recvd + kFrameHeaderBytes * (size_ - 1);
     ctrl_msgs_ += size_ - 1;
+    GlobalTrace().NoteControlFrame(kTagGather, /*send=*/false,
+                                   recvd + kFrameHeaderBytes * (size_ - 1));
     return true;
   }
   if (!ControlSendFrame(kTagGather, mine.data(), mine.size())) return false;
@@ -1475,6 +1484,9 @@ bool TcpContext::BroadcastBlob(std::string* blob) {
     ctrl_bytes_sent_ +=
         (blob->size() + kFrameHeaderBytes) * uint64_t(size_ - 1);
     ctrl_msgs_ += size_ - 1;
+    GlobalTrace().NoteControlFrame(
+        kTagBcast, /*send=*/true,
+        (blob->size() + kFrameHeaderBytes) * uint64_t(size_ - 1));
     return true;
   }
   if (!ControlRecvFrame(kTagBcast, blob)) return false;
@@ -1506,6 +1518,9 @@ bool TcpContext::BitwiseSync(std::vector<uint64_t>& bits, bool is_or) {
     ctrl_bytes_recv_ += (nbytes + kFrameHeaderBytes) * uint64_t(size_ - 1);
     ctrl_bytes_sent_ += (nbytes + kFrameHeaderBytes) * uint64_t(size_ - 1);
     ctrl_msgs_ += 2 * uint64_t(size_ - 1);
+    GlobalTrace().NoteControlFrame(
+        kTagBits, /*send=*/true,
+        (nbytes + kFrameHeaderBytes) * uint64_t(size_ - 1));
     return true;
   }
   if (!(ControlSendFrame(kTagBits, bits.data(), nbytes) &&
@@ -1608,6 +1623,15 @@ bool TcpContext::PairExchange(Conn* next, Conn* prev, Channel chan,
     LOG(ERROR) << "ring exchange on unconnected ring";
     return false;
   }
+
+  // Wire-hop span (trace.h): one per exchange, both directions. Ring
+  // exchanges run in lockstep, so the per-channel hop sequence pairs the
+  // same logical hop across ranks; the causal check compares the
+  // sender's start against its next-neighbor's end after clock
+  // correction. Only the GLOBAL ring has a rank-derivable peer.
+  Trace& hop_trace = GlobalTrace();
+  const uint64_t hop_seq = trace_hop_seq_[static_cast<int>(chan)]++;
+  const int64_t hop_start = hop_trace.NowNs();
 
   // Transport selection (docs/TRANSPORT.md): a leg rides its negotiated
   // shm ring only while the cycle-synchronized shm_transport knob says
@@ -1835,6 +1859,17 @@ bool TcpContext::PairExchange(Conn* next, Conn* prev, Channel chan,
     m.net_shm_bytes_recv_total.fetch_add(
         static_cast<uint64_t>(recv_len) + kFrameHeaderBytes,
         std::memory_order_relaxed);
+  }
+  if (hop_trace.enabled()) {
+    static const char* kChanNames[] = {"hop.control", "hop.ring",
+                                       "hop.local", "hop.cross"};
+    int ci = static_cast<int>(chan);
+    hop_trace.Record(ci >= 0 && ci < 4 ? kChanNames[ci] : "hop.?",
+                     TRACE_WIRE_HOP, hop_start, hop_trace.NowNs(),
+                     static_cast<int64_t>(send_len), /*group=*/0,
+                     chan == Channel::RING ? (rank_ + 1) % size_ : -1,
+                     hop_seq,
+                     sshm != nullptr ? TRACE_FLAG_SHM : 0);
   }
   return true;
 }

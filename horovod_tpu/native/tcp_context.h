@@ -309,6 +309,12 @@ class TcpContext {
   double ring_tx_bytes_per_us_ = 0.0;
   double ring_tx_ready_us_ = 0.0;
 
+  // Per-logical-channel wire-hop sequence for trace spans (trace.h):
+  // ring exchanges run in lockstep, so hop N on the sender is hop N on
+  // the receiver — the merge tool pairs spans across ranks by
+  // (channel, hop). Indexed by Channel value. Background thread only.
+  uint64_t trace_hop_seq_[4] = {0, 0, 0, 0};
+
   int rank_ = 0;
   int size_ = 1;
   int local_rank_ = 0;

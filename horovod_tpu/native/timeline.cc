@@ -38,6 +38,18 @@ void TimelineWriter::Shutdown() {
   }
 }
 
+void TimelineWriter::EmergencyFinalize() {
+  // Signal context: mark inactive so enqueues stop, then close the array
+  // directly. The writer thread may be mid-record — a torn tail is what
+  // `hvd-trace --repair` exists for; an unterminated array is strictly
+  // worse.
+  if (!active_.exchange(false)) return;
+  if (file_ != nullptr) {
+    std::fputs("\n]\n", file_);
+    std::fflush(file_);
+  }
+}
+
 // Comma-before-record separation: every record is preceded by ",\n"
 // except the first. Runs on the writer thread (and Shutdown after join),
 // so first_record_ needs no lock.
@@ -175,6 +187,11 @@ void Timeline::Shutdown() {
   if (!initialized_.load()) return;
   writer_.Shutdown();
   initialized_.store(false);
+}
+
+void Timeline::EmergencyFinalize() {
+  if (!initialized_.load()) return;
+  writer_.EmergencyFinalize();
 }
 
 int64_t Timeline::TimeSinceStartMicros() const {

@@ -45,6 +45,10 @@ class TimelineWriter {
  public:
   void Initialize(const std::string& file_name);
   void Shutdown();
+  // Fatal-signal best effort: terminate the JSON array in place, no
+  // locks, no thread join — the process is about to die and an
+  // unterminated file helps nobody (operations.cc FatalSignalHandler).
+  void EmergencyFinalize();
   bool active() const { return active_.load(); }
   void EnqueueWriteEvent(const std::string& tensor_name, char phase,
                          const std::string& op_name, const std::string& args,
@@ -85,6 +89,7 @@ class Timeline {
  public:
   void Initialize(const std::string& file_name, unsigned int rank);
   void Shutdown();
+  void EmergencyFinalize();
   bool Initialized() const { return initialized_.load(); }
 
   void NegotiateStart(const std::string& tensor_name,

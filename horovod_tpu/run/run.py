@@ -516,6 +516,27 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
                                         "rank%d.log" % slots[i].rank)
             rank_envs[i]["HVD_TPU_LOG_FILE"] = log_paths[i]
 
+    # Flight-recorder bundles (docs/TRACING.md): unless the caller
+    # already routes them, local ranks dump post-mortem bundles next to
+    # the tee'd logs so the failure summary below can name them. Same
+    # local-only caveat as the logs: a launcher-local path means nothing
+    # on a remote host, so remote ranks only get the env when the user
+    # set it to a path valid everywhere.
+    bundle_dir = os.environ.get("HVD_TPU_BUNDLE_DIR")
+    if not bundle_dir and log_dir is not None:
+        bundle_dir = os.path.join(log_dir, "bundles")
+        for i in tee_slots:
+            rank_envs[i].setdefault("HVD_TPU_BUNDLE_DIR", bundle_dir)
+
+    def sweep_bundles():
+        """Post-mortem bundles the ranks left behind, oldest first."""
+        if not bundle_dir or not os.path.isdir(bundle_dir):
+            return []
+        found = [os.path.join(bundle_dir, n)
+                 for n in os.listdir(bundle_dir)
+                 if n.startswith("hvd_bundle_") and n.endswith(".json")]
+        return sorted(found, key=lambda p: os.path.getmtime(p))
+
     procs = launch(slots, rank_envs, command, ssh_port=ssh_port,
                    verbose=verbose)
 
@@ -622,6 +643,9 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
                     describe_last_durable
                 sys.stderr.write(
                     "[launcher] %s\n" % describe_last_durable(ckpt_dir))
+            for bpath in sweep_bundles():
+                sys.stderr.write(
+                    "[launcher] post-mortem bundle: %s\n" % bpath)
             if drained_ranks:
                 # EXIT_DRAINED (not 0) so a supervisor can tell a
                 # preempted job from a completed one; ranks that
@@ -645,6 +669,9 @@ def run_command(np, hosts, command, start_port=0, ssh_port=None,
                     describe_last_durable
                 sys.stderr.write(
                     "[launcher] %s\n" % describe_last_durable(ckpt_dir))
+            for bpath in sweep_bundles():
+                sys.stderr.write(
+                    "[launcher] post-mortem bundle: %s\n" % bpath)
         elif (exit_code == 0 and log_dir is not None
               and not os.environ.get("HVD_TPU_LOG_DIR")):
             # Clean run: reclaim the tmp log dir (an explicit

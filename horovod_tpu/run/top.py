@@ -102,6 +102,23 @@ def _shm_state(cur, prev, dt, ctx):
     return "%d/%s" % (segs, _fmt_rate(rate))
 
 
+def _trc_state(cur, prev, dt, ctx):
+    """Trace recorder health (docs/TRACING.md): span rate through the
+    ring, suffixed with the cumulative ring-drop count when any span was
+    ever dropped (e.g. '1.2k/d37' = 1200 spans/s, 37 dropped — grow
+    HVD_TPU_TRACE_RING). 'off' = tracing disabled on the worker; '-' =
+    the worker's summary predates the trace fields (mixed-version
+    elastic job)."""
+    if "trace_spans_total" not in cur:
+        return "-"
+    dropped = int(cur.get("trace_spans_dropped_total", 0))
+    rate = _rate(cur, prev, "trace_spans_total", dt)
+    if rate is None and float(cur.get("trace_spans_total", 0.0)) <= 0:
+        return "off"
+    base = _fmt_rate(rate)
+    return "%s/d%d" % (base, dropped) if dropped else base
+
+
 def _cmp_ratio(cur, prev, dt, ctx):
     """Live wire-compression factor (docs/COMPRESSION.md): f32 bytes
     into the codec / bytes put on the wire. '-' when the worker
@@ -164,6 +181,9 @@ _COLUMNS = [
     # Shared-memory data plane: attached segments (+ shm byte rate) —
     # docs/TRANSPORT.md.
     ("shm", 8, _shm_state),
+    # Trace recorder: span rate (+ '/dN' once the ring ever dropped) —
+    # docs/TRACING.md.
+    ("trc", 8, _trc_state),
     ("lag_s", 9, lambda cur, prev, dt, ctx: "%.2f" % ctx["lag_total"]),
 ]
 

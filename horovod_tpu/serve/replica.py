@@ -37,6 +37,8 @@ from horovod_tpu.elastic import durable
 from horovod_tpu.elastic.run import drain_requested
 from horovod_tpu.elastic.state import EXIT_DRAINED
 
+from horovod_tpu.trace import emit as trace_emit
+
 from . import model as _model
 from .batcher import MicroBatcher
 from .chaos import ServeChaos
@@ -108,6 +110,8 @@ class Replica:
         self.httpd = None
         self.port = None
         self.watcher = None
+        self._trace = trace_emit.shard_for("serve_r%d" % self.wid,
+                                           rank=self.wid)
 
     def _log(self, msg):
         sys.stderr.write("[serve %d] %s\n" % (self.wid, msg))
@@ -203,7 +207,15 @@ class Replica:
             tickets = self.batcher.next_batch(timeout=0.05)
             if tickets:
                 fwd, stamp = self._snapshot_forward()
+                # Per-request span (docs/TRACING.md): one "serve.batch"
+                # span per forward into this replica's own trace shard,
+                # so hvd-trace merges serve latency next to the training
+                # plane's spans. No-op unless HVD_TPU_TRACE_DIR is set.
+                span_start = trace_emit.now_ns()
                 self.batcher.run_batch(fwd, tickets, stamp=stamp)
+                self._trace.span("serve.batch", span_start,
+                                 trace_emit.now_ns(),
+                                 nbytes=len(tickets), cycle=self.step)
                 continue
             if self._drain_seen:
                 # Queue flushed (next_batch returned empty after

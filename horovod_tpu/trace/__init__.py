@@ -1,0 +1,18 @@
+"""Distributed-trace tooling (docs/TRACING.md).
+
+The native core's span recorder (native/trace.h) writes one JSONL shard
+per rank; this package merges them into a single Perfetto/chrome-tracing
+JSON on rank 0's clock, prints per-tensor critical-path tables, checks
+causal ordering of wire hops after clock correction, and repairs
+truncated legacy timeline files. ``emit`` is the pure-Python span
+emitter the serve plane uses (replicas never load the native core).
+"""
+
+from horovod_tpu.trace.merge import (  # noqa: F401
+    CausalViolation,
+    MergedTrace,
+    critical_path_table,
+    load_shard,
+    merge_shards,
+    repair_timeline,
+)

@@ -1,0 +1,129 @@
+"""hvd-trace command line: merge shards, print critical paths, repair.
+
+``hvd-trace <trace-dir | shards...>`` merges per-rank shards into one
+chrome-tracing JSON (open in Perfetto / chrome://tracing) and prints the
+per-tensor critical-path table. ``--check-causal`` additionally audits
+that every global-ring wire hop is causally ordered after clock
+correction (non-zero exit on violation, for use in tests and CI).
+``--repair FILE`` fixes a truncated legacy HVD_TPU_TIMELINE file in
+place instead.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from horovod_tpu.trace.merge import (
+    ShardError,
+    critical_path_table,
+    merge_shards,
+    repair_timeline,
+)
+
+
+def _fmt_ms(ns):
+    return "%.3f" % (ns / 1e6)
+
+
+def print_table(rows, out=sys.stdout, limit=20):
+    if not rows:
+        out.write("no tensor spans found\n")
+        return
+    cols = ("tensor", "dominant", "dom ms", "straggler", "spread ms",
+            "neg wait ms")
+    widths = [max(len(cols[0]), max(len(r["tensor"]) for r in rows[:limit])),
+              10, 12, 9, 12, 12]
+    fmt = "  ".join("%%-%ds" % w for w in widths) + "\n"
+    out.write(fmt % cols)
+    for r in rows[:limit]:
+        out.write(fmt % (
+            r["tensor"],
+            r["dominant_phase"],
+            _fmt_ms(r["dominant_ns"]),
+            "-" if r["straggler_rank"] is None else str(r["straggler_rank"]),
+            _fmt_ms(r["enqueue_spread_ns"]),
+            _fmt_ms(r["negotiation_wait_ns"]),
+        ))
+    if len(rows) > limit:
+        out.write("  ... %d more tensors (use --limit)\n"
+                  % (len(rows) - limit))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="hvd-trace",
+        description="Merge hvd trace shards into a Perfetto-loadable "
+                    "JSON and report per-tensor critical paths.")
+    parser.add_argument("paths", nargs="*",
+                        help="trace directory (HVD_TPU_TRACE_DIR) or "
+                             "individual trace_rank*.jsonl shards")
+    parser.add_argument("-o", "--output", default=None,
+                        help="write merged chrome-tracing JSON here "
+                             "(default: <first path>/trace_merged.json)")
+    parser.add_argument("--no-table", action="store_true",
+                        help="skip the critical-path table")
+    parser.add_argument("--limit", type=int, default=20,
+                        help="max table rows (default 20)")
+    parser.add_argument("--check-causal", action="store_true",
+                        help="verify corrected send-start < recv-end for "
+                             "every paired ring hop; exit 3 on violation")
+    parser.add_argument("--repair", metavar="FILE", default=None,
+                        help="repair a truncated timeline/trace JSON "
+                             "array in place and exit")
+    args = parser.parse_args(argv)
+
+    if args.repair is not None:
+        try:
+            changed = repair_timeline(args.repair)
+        except (IOError, OSError) as e:
+            sys.stderr.write("hvd-trace: %s\n" % e)
+            return 2
+        print("%s: %s" % (args.repair,
+                          "repaired" if changed else "already valid"))
+        return 0
+
+    if not args.paths:
+        parser.error("need a trace directory or shard files "
+                     "(or --repair FILE)")
+    try:
+        merged = merge_shards(args.paths)
+    except (ShardError, IOError, OSError) as e:
+        sys.stderr.write("hvd-trace: %s\n" % e)
+        return 2
+
+    out_path = args.output
+    if out_path is None:
+        base = args.paths[0]
+        if not os.path.isdir(base):
+            base = os.path.dirname(base) or "."
+        out_path = os.path.join(base, "trace_merged.json")
+    with open(out_path, "w") as f:
+        json.dump(merged.to_chrome(), f)
+    n_spans = sum(len(r["spans"]) for r in merged.ranks.values())
+    print("merged %d spans from %d ranks -> %s"
+          % (n_spans, len(merged.ranks), out_path))
+    for rank in sorted(merged.ranks):
+        r = merged.ranks[rank]
+        print("  rank %d: %d spans, clock offset %+d ns (+/- %d ns)"
+              % (rank, len(r["spans"]), r["offset_ns"],
+                 min(r["uncertainty_ns"], 1 << 60)))
+
+    if not args.no_table:
+        print()
+        print_table(critical_path_table(merged), limit=args.limit)
+
+    if args.check_causal:
+        violations = merged.check_causal()
+        if violations:
+            for v in violations:
+                sys.stderr.write("causal violation: %r\n" % v)
+            sys.stderr.write("hvd-trace: %d causal violation(s)\n"
+                             % len(violations))
+            return 3
+        print("causal check: all paired ring hops ordered")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

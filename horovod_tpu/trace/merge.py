@@ -1,0 +1,329 @@
+"""Shard merging, clock correction, critical-path and causal analysis.
+
+Input: the per-rank JSONL shards the native recorder writes under
+HVD_TPU_TRACE_DIR (``trace_rank<r>.jsonl``, schema in native/trace.cc).
+Every timestamp in a shard is on that rank's private monotonic clock;
+the shard's clock lines carry the NTP-style offset to rank 0 estimated
+on the control plane, so the merge lands every span on ONE clock —
+rank 0's — and cross-rank comparisons (who enqueued last, did the recv
+end after the send started) become plain subtraction.
+"""
+
+import json
+import os
+import re
+
+PHASE_NAMES = {
+    0: "enqueue",
+    1: "negotiate",
+    2: "fuse",
+    3: "exec",
+    4: "wire",
+    5: "encode",
+    6: "decode",
+    7: "callback",
+    8: "request",
+}
+PHASE_ENQUEUE = 0
+PHASE_NEGOTIATE = 1
+PHASE_WIRE = 4
+
+_SHARD_RE = re.compile(r"trace_rank(\d+)\.jsonl$")
+
+
+class ShardError(ValueError):
+    """A shard file is unreadable or not a trace shard."""
+
+
+class CausalViolation(object):
+    """One wire hop whose corrected send start is after the recv end."""
+
+    def __init__(self, channel, hop, send_rank, recv_rank, send_start_ns,
+                 recv_end_ns):
+        self.channel = channel
+        self.hop = hop
+        self.send_rank = send_rank
+        self.recv_rank = recv_rank
+        self.send_start_ns = send_start_ns
+        self.recv_end_ns = recv_end_ns
+
+    def __repr__(self):
+        return ("CausalViolation(%s hop %d: rank %d sent at %d ns but "
+                "rank %d finished receiving at %d ns)" %
+                (self.channel, self.hop, self.send_rank, self.recv_rank,
+                 self.send_start_ns, self.recv_end_ns))
+
+
+def load_shard(path):
+    """Parses one shard file.
+
+    Returns ``(header, clock, spans)``: the header dict, the LAST clock
+    sample emitted (the recorder only re-emits on improvement, so last =
+    best known; ``None`` when the rank never estimated — rank 0 by
+    definition has offset 0), and the span dicts in write order. A
+    truncated final line (the rank died mid-drain) is dropped, not
+    fatal — that is exactly the crashed-run case this tooling exists
+    for.
+    """
+    header = None
+    clock = None
+    spans = []
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail of a killed rank
+            if "hvd_trace_shard" in rec:
+                header = rec
+            elif "clock" in rec:
+                clock = rec["clock"]
+            elif "p" in rec:
+                spans.append(rec)
+    if header is None:
+        raise ShardError("%s is not an hvd trace shard (no header line)"
+                         % path)
+    return header, clock, spans
+
+
+def find_shards(path):
+    """Expands a trace directory to its shard paths (rank order)."""
+    if os.path.isdir(path):
+        found = []
+        for name in os.listdir(path):
+            m = _SHARD_RE.search(name)
+            if m:
+                found.append((int(m.group(1)), os.path.join(path, name)))
+        return [p for _, p in sorted(found)]
+    return [path]
+
+
+class MergedTrace(object):
+    """All ranks' spans on rank 0's clock."""
+
+    def __init__(self):
+        self.ranks = {}       # rank -> {"header", "offset_ns",
+                              #          "uncertainty_ns", "spans"}
+        self.world_size = 0
+
+    def corrected(self, rank, ts_ns):
+        """A rank-local timestamp moved onto rank 0's clock."""
+        return ts_ns + self.ranks[rank]["offset_ns"]
+
+    def spans(self):
+        """Yields ``(rank, span)`` over every rank in rank order."""
+        for rank in sorted(self.ranks):
+            for span in self.ranks[rank]["spans"]:
+                yield rank, span
+
+    def to_chrome(self):
+        """The merged trace as a chrome-tracing / Perfetto JSON object.
+
+        One chrome "process" per rank, one "thread" per span phase;
+        every event a complete ("X") event with microsecond timestamps
+        on rank 0's clock. ``json.dump`` of the return value is a valid
+        trace file.
+        """
+        events = []
+        for rank in sorted(self.ranks):
+            events.append({"name": "process_name", "ph": "M", "pid": rank,
+                           "args": {"name": "rank %d" % rank}})
+            events.append({"name": "process_sort_index", "ph": "M",
+                           "pid": rank, "args": {"sort_index": rank}})
+            for pid_phase, pname in PHASE_NAMES.items():
+                events.append({"name": "thread_name", "ph": "M",
+                               "pid": rank, "tid": pid_phase,
+                               "args": {"name": pname}})
+        for rank, s in self.spans():
+            start = self.corrected(rank, s["s"])
+            events.append({
+                "name": s["n"],
+                "ph": "X",
+                "pid": rank,
+                "tid": s["p"],
+                "ts": start / 1000.0,
+                "dur": max(0, s["e"] - s["s"]) / 1000.0,
+                "args": {"bytes": s.get("b", 0), "group": s.get("g", 0),
+                         "peer": s.get("pe", -1), "hop": s.get("c", 0),
+                         "shm": bool(s.get("f", 0) & 1)},
+            })
+        meta = {
+            "hvd_trace": 1,
+            "ranks": sorted(self.ranks),
+            "clock": {
+                str(r): {
+                    "offset_ns": self.ranks[r]["offset_ns"],
+                    "uncertainty_ns": self.ranks[r]["uncertainty_ns"],
+                } for r in self.ranks
+            },
+        }
+        return {"traceEvents": events, "otherData": meta,
+                "displayTimeUnit": "ms"}
+
+    def check_causal(self):
+        """Causal-order audit of global-ring wire hops.
+
+        PairExchange stamps every exchange with a per-channel hop
+        sequence that advances in lockstep around the ring, so hop N on
+        rank r pairs with hop N on rank (r+1) %% world. The receiver
+        cannot finish before the sender started: after clock correction,
+        ``send.start <= recv.end`` (padded by the two ranks' combined
+        offset uncertainty) must hold for every pair. Returns the list
+        of violations (empty = causally consistent). Only ``hop.ring``
+        spans are audited — group sub-rings advance hop sequences on
+        member ranks only, so their pairing is not rank-derivable here.
+        """
+        hops = {}  # (rank, hop_seq) -> span
+        for rank, s in self.spans():
+            if s["p"] == PHASE_WIRE and s["n"] == "hop.ring":
+                hops[(rank, s["c"])] = s
+        violations = []
+        n = self.world_size
+        if n < 2:
+            return violations
+        for (rank, hop), s in sorted(hops.items()):
+            peer = s.get("pe", -1)
+            if peer < 0:
+                continue
+            r = hops.get((peer, hop))
+            if r is None:
+                continue
+            tol = (self.ranks[rank]["uncertainty_ns"] +
+                   self.ranks[peer]["uncertainty_ns"])
+            send_start = self.corrected(rank, s["s"])
+            recv_end = self.corrected(peer, r["e"])
+            if send_start > recv_end + tol:
+                violations.append(CausalViolation(
+                    s["n"], hop, rank, peer, send_start, recv_end))
+        return violations
+
+
+def merge_shards(paths):
+    """Loads shards (files or one directory) into a MergedTrace."""
+    shard_paths = []
+    for p in paths:
+        shard_paths.extend(find_shards(p))
+    if not shard_paths:
+        raise ShardError("no trace_rank*.jsonl shards found in %s"
+                         % list(paths))
+    merged = MergedTrace()
+    for path in shard_paths:
+        header, clock, spans = load_shard(path)
+        rank = int(header.get("rank", -1))
+        merged.ranks[rank] = {
+            "header": header,
+            # Rank 0 is the reference: offset identically 0. A worker
+            # whose shard carries no clock line (died before the first
+            # full negotiation cycle) merges uncorrected, flagged by a
+            # huge uncertainty so the causal audit skips its pairs.
+            "offset_ns": clock["offset_ns"] if clock else 0,
+            "uncertainty_ns": (clock["uncertainty_ns"] if clock
+                               else (0 if rank == 0 else 1 << 60)),
+            "spans": spans,
+        }
+        merged.world_size = max(merged.world_size,
+                                int(header.get("size", 0)))
+    return merged
+
+
+def critical_path_table(merged):
+    """Per-tensor critical-path rows from a MergedTrace.
+
+    For every tensor that negotiated, reports which phase dominated its
+    total recorded time, which rank was the straggler — the one whose
+    corrected enqueue landed LAST, holding the collective open — and
+    how much negotiation wait it inflicted: the longest negotiate span
+    among the OTHER ranks (they sat in the pending table for exactly as
+    long as the straggler was late, plus one cycle).
+
+    Returns a list of row dicts sorted by inflicted wait, descending.
+    """
+    by_tensor = {}
+    for rank, s in merged.spans():
+        if s["p"] in (PHASE_WIRE,):
+            continue  # hops are channel-keyed, not tensor-keyed
+        t = by_tensor.setdefault(s["n"], {"enqueue": {}, "negotiate": {},
+                                          "phase_ns": {}})
+        dur = max(0, s["e"] - s["s"])
+        t["phase_ns"][s["p"]] = t["phase_ns"].get(s["p"], 0) + dur
+        if s["p"] == PHASE_ENQUEUE:
+            # Latest enqueue per rank: a tensor reused across steps keeps
+            # its worst epoch.
+            ts = merged.corrected(rank, s["s"])
+            if ts > t["enqueue"].get(rank, -(1 << 62)):
+                t["enqueue"][rank] = ts
+        elif s["p"] == PHASE_NEGOTIATE:
+            if dur > t["negotiate"].get(rank, -1):
+                t["negotiate"][rank] = dur
+    rows = []
+    for name, t in by_tensor.items():
+        if not t["phase_ns"]:
+            continue
+        dominant = max(t["phase_ns"].items(), key=lambda kv: kv[1])
+        straggler = None
+        inflicted = 0
+        spread = 0
+        if len(t["enqueue"]) >= 2:
+            straggler = max(t["enqueue"], key=t["enqueue"].get)
+            spread = (t["enqueue"][straggler] -
+                      min(t["enqueue"].values()))
+            others = [v for r, v in t["negotiate"].items()
+                      if r != straggler]
+            inflicted = max(others) if others else 0
+        rows.append({
+            "tensor": name,
+            "dominant_phase": PHASE_NAMES.get(dominant[0],
+                                              str(dominant[0])),
+            "dominant_ns": dominant[1],
+            "straggler_rank": straggler,
+            "enqueue_spread_ns": spread,
+            "negotiation_wait_ns": inflicted,
+        })
+    rows.sort(key=lambda r: r["negotiation_wait_ns"], reverse=True)
+    return rows
+
+
+def repair_timeline(path, write=True):
+    """Closes the JSON array of a truncated chrome-tracing timeline.
+
+    A rank killed mid-run leaves HVD_TPU_TIMELINE output (and pre-trace
+    legacy files) as an unterminated array, often ending in a partial
+    record. Cuts back to the last point where the file parses as a
+    complete array and rewrites it in place (``write=False`` to probe).
+    Returns True when the file was (or would be) modified, False when it
+    already parses.
+    """
+    with open(path, "r") as f:
+        raw = f.read()
+    try:
+        json.loads(raw)
+        return False
+    except ValueError:
+        pass
+    body = raw.rstrip()
+    if body.endswith("]"):
+        body = body[:-1]
+    # Walk record boundaries backwards until the prefix closes cleanly.
+    # The parse check is what proves a cut point is a boundary, so a
+    # '}' inside a quoted string can't fool it.
+    idx = len(body)
+    repaired = None
+    while True:
+        idx = body.rfind("}", 0, idx)
+        if idx < 0:
+            repaired = "[\n]\n"
+            break
+        candidate = body[:idx + 1] + "\n]\n"
+        try:
+            json.loads(candidate)
+            repaired = candidate
+            break
+        except ValueError:
+            continue
+    if write:
+        with open(path, "w") as f:
+            f.write(repaired)
+    return True

@@ -71,15 +71,27 @@ def main():
             step()
 
     iters = int(os.environ.get("AB_ITERS", "80"))
+    # Job-total CPU per step alongside wall steps/s: on an oversubscribed
+    # 1-core host, wall clock measures the hypervisor (steal/scheduler
+    # modes swing runs +/-15%) while CPU time measures the framework —
+    # same rationale as the negotiation microbench's rusage window.
+    import resource
+    ru0 = resource.getrusage(resource.RUSAGE_SELF)
     t0 = time.perf_counter()
     for _ in range(iters):
         step()
     dt = time.perf_counter() - t0
+    ru1 = resource.getrusage(resource.RUSAGE_SELF)
+    cpu_s = ((ru1.ru_utime - ru0.ru_utime) +
+             (ru1.ru_stime - ru0.ru_stime))
+    job_cpu_s = float(hvd.allreduce(np.array([cpu_s], np.float64),
+                                    "ab.cpu_total", average=False)[0])
     bytes_per_step = k * elems * 4
     if r == 0:
         out = {
             "steps_per_s": round(iters / dt, 2),
             "ms_per_step": round(dt / iters * 1e3, 3),
+            "cpu_ms_per_step_job": round(job_cpu_s / iters * 1e3, 3),
             "mb_per_step": round(bytes_per_step / 1e6, 3),
             "bytes_per_us": round(bytes_per_step * iters / (dt * 1e6), 2),
             "tune_steps": tune_steps,

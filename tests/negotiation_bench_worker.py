@@ -85,6 +85,10 @@ def main():
             "cache_hit_rate": round(c["cache_hit_total"] / looked_up, 4)
             if looked_up else None,
         }))
+        # Trace-recorder counters for bench.py --trace-overhead: the A/B
+        # there asserts spans flowed when tracing was on AND nothing was
+        # dropped at the default ring size.
+        print("TRACE_COUNTERS %s" % json.dumps(basics.trace_counters()))
     print("rank %d done" % r)
     return 0
 

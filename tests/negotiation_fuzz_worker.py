@@ -43,6 +43,35 @@ def main():
         scraper = threading.Thread(target=scrape_loop, daemon=True)
         scraper.start()
 
+    # Trace-plane race check (the sanitizer tracing variant,
+    # native/Makefile TRACE_FUZZ_ENV): with a deliberately tiny ring
+    # (HVD_TPU_TRACE_RING=256) the recorder wraps constantly while the
+    # background thread, the codec worker threads, and THIS hammer
+    # thread all write spans — any seqlock regression in native/trace.h
+    # is a sanitizer report. Mid-fuzz the hammer also dumps a bundle
+    # through the C API (empty pending table: that API is callable from
+    # any thread, unlike the coordinator-side dump paths), racing the
+    # bundle's ring snapshot against live writers.
+    stop_hammer = threading.Event()
+    hammer = None
+    if os.environ.get("HVD_TPU_FUZZ_TRACE") == "1":
+        from horovod_tpu.common.basics import get_basics
+
+        def hammer_loop():
+            b = get_basics()
+            i = 0
+            while not stop_hammer.is_set():
+                t0 = b.trace_now_ns()
+                b.trace_record("hammer.%d" % (i % 7), 8, t0,
+                               b.trace_now_ns(), nbytes=i)
+                i += 1
+                if i % 512 == 0:
+                    b.trace_dump_bundle("fuzz")
+                    counters = b.trace_counters()
+                    assert counters["trace_spans_total"] >= 0
+        hammer = threading.Thread(target=hammer_loop, daemon=True)
+        hammer.start()
+
     # HVD_TPU_FUZZ_TENSORS trims the run; HVD_TPU_FUZZ_ROUNDS repeats
     # the enqueue+verify cycle with fresh names so negotiation traffic
     # flows across the WHOLE run instead of batching into the first few
@@ -200,6 +229,10 @@ def main():
     if state is not None:
         assert state._durable.flush(timeout=120), \
             "durable writer did not drain"
+
+    if hammer is not None:
+        stop_hammer.set()
+        hammer.join(timeout=10)
 
     if scraper is not None:
         stop_scraper.set()

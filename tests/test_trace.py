@@ -1,0 +1,266 @@
+"""hvd-trace e2e + unit tests (docs/TRACING.md): shard merge with
+aligned clocks, critical-path attribution, causal ordering of wire
+hops, the flight recorder's post-mortem bundles, timeline repair, and
+the hvd-top trc column. The `run_launcher` harness lives in
+conftest.py."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.trace import (critical_path_table, merge_shards,
+                               repair_timeline)
+
+pytestmark = pytest.mark.e2e
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_straggler_critical_path_and_causal_order(run_launcher, tmp_path):
+    """ISSUE 18 acceptance: 4 ranks, rank 3 straggling 2s — the merged
+    trace is one valid JSON, the critical-path table names the straggler
+    attributing >= 1.5s to negotiation wait, and every paired ring-hop
+    edge is causally ordered after clock correction."""
+    trace_dir = str(tmp_path / "trace")
+    proc = run_launcher(4, "trace_straggler_worker.py", extra_env={
+        "HVD_TPU_TRACE_DIR": trace_dir,
+        "HVD_TPU_TL_STRAGGLE": "2",
+    }, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    shards = sorted(os.listdir(trace_dir))
+    assert shards == ["trace_rank%d.jsonl" % r for r in range(4)], shards
+
+    merged = merge_shards([trace_dir])
+    assert sorted(merged.ranks) == [0, 1, 2, 3]
+    assert merged.world_size == 4
+
+    # Non-reference ranks piggybacked clock samples on the control
+    # plane; rank 0 is the reference (offset identically 0).
+    assert merged.ranks[0]["offset_ns"] == 0
+    for r in (1, 2, 3):
+        assert merged.ranks[r]["uncertainty_ns"] < 1 << 60, \
+            "rank %d never adopted a clock sample" % r
+
+    # The merged trace round-trips as ONE valid chrome-tracing JSON.
+    chrome = json.loads(json.dumps(merged.to_chrome()))
+    assert len(chrome["traceEvents"]) > 100
+    assert all("ph" in e for e in chrome["traceEvents"])
+
+    rows = critical_path_table(merged)
+    straggled = [r for r in rows if r["tensor"] == "straggled"]
+    assert straggled, [r["tensor"] for r in rows]
+    row = straggled[0]
+    assert row["straggler_rank"] == 3, row
+    assert row["dominant_phase"] == "negotiate", row
+    assert row["negotiation_wait_ns"] >= 1.5e9, row
+    # And it dominates the table: nothing else in this run waited
+    # anywhere near that long.
+    assert rows[0]["tensor"] == "straggled", rows[:3]
+
+    # Causal check: sender's corrected hop start precedes the paired
+    # receiver's corrected hop end for every global-ring wire hop.
+    violations = merged.check_causal()
+    assert violations == [], violations
+
+    # The CLI drives the same pipeline end to end and exits 0.
+    cli = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bin", "hvd-trace"),
+         trace_dir, "--check-causal"],
+        capture_output=True, text=True, timeout=120)
+    assert cli.returncode == 0, cli.stdout + cli.stderr
+    assert "causal check: all paired ring hops ordered" in cli.stdout
+    assert "straggled" in cli.stdout
+    with open(os.path.join(trace_dir, "trace_merged.json")) as f:
+        assert len(json.load(f)["traceEvents"]) == len(chrome["traceEvents"])
+
+
+def _load_bundle(path):
+    with open(path) as f:
+        b = json.load(f)
+    assert b.get("hvd_bundle") == 1, path
+    pending = b.get("pending")
+    if isinstance(pending, str):
+        pending = json.loads(pending) if pending else None
+    return b, pending
+
+
+def test_sigkill_survivor_bundles_and_timeline(run_launcher, tmp_path):
+    """A SIGKILLed peer (no cleanup, no goodbye frame) must leave a
+    post-mortem bundle on EVERY survivor; the coordinator's names the
+    missing rank and the in-flight tensor; the launcher failure summary
+    lists the bundle paths; and rank 0's timeline file — historically
+    left an unterminated JSON array by any crash — parses whole."""
+    bundle_dir = str(tmp_path / "bundles")
+    timeline_file = str(tmp_path / "timeline.json")
+    proc = run_launcher(3, "trace_kill_worker.py", extra_env={
+        "HVD_TPU_BUNDLE_DIR": bundle_dir,
+        "HVD_TPU_TIMELINE": timeline_file,
+        "HVD_TPU_KILL_RANK": "1",
+        # No reconnect hold: the coordinator must fail over (and dump
+        # its bundle) the moment the peer's socket dies, not after a 5s
+        # window the launcher's teardown SIGTERM would win.
+        "HVD_TPU_RECONNECT_SECONDS": "0",
+    }, timeout=300)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode != 0, out  # the job failed, by design
+
+    # Satellite 1 regression: the whole timeline file parses — no
+    # truncated array, no trailing comma — even though the job died.
+    with open(timeline_file) as f:
+        records = json.loads(f.read())
+    assert isinstance(records, list) and len(records) > 0
+
+    bundles = sorted(os.listdir(bundle_dir))
+    by_rank = {}
+    for name in bundles:
+        assert name.startswith("hvd_bundle_rank"), name
+        b, pending = _load_bundle(os.path.join(bundle_dir, name))
+        by_rank.setdefault(b["rank"], []).append((name, b, pending))
+    # Every SURVIVOR (0 and 2) dumped at least one bundle; the killed
+    # rank got no chance to (SIGKILL is uncatchable).
+    assert 0 in by_rank and 2 in by_rank, bundles
+    assert 1 not in by_rank, bundles
+
+    # The coordinator's connection-lost bundle names the missing rank
+    # and the in-flight tensor.
+    conn = [(n, b, p) for n, b, p in by_rank[0]
+            if "connection_lost" in n]
+    assert conn, by_rank[0]
+    _, b0, pending0 = conn[0]
+    assert b0["world_size"] == 3
+    entries = (pending0 or {}).get("pending") or []
+    doomed = [e for e in entries if e["name"] == "doomed"]
+    assert doomed, pending0
+    assert 1 in doomed[0]["missing"], doomed
+    assert 1 not in doomed[0]["reported"], doomed
+
+    # The launcher's failure summary points the operator at them.
+    assert "post-mortem bundle:" in out, out
+
+
+def test_stall_warning_rate_limit_escalation_and_bundle(run_launcher,
+                                                        tmp_path):
+    """The stall inspector's full warning ladder in one run: first
+    check emits the full missing-ranks block, the next check collapses
+    the unchanged set to the rate-limited 'Stall persists ... repeat'
+    line, the shutdown threshold escalates to coordinated shutdown —
+    and the escalation arms a flight-recorder dump on every rank."""
+    bundle_dir = str(tmp_path / "bundles")
+    proc = run_launcher(2, "stall_worker.py", extra_env={
+        "HVD_TPU_STALL_CHECK_TIME_SECONDS": "2",
+        "HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS": "5",
+        "HVD_TPU_BUNDLE_DIR": bundle_dir,
+    }, timeout=120)
+    out = proc.stdout + proc.stderr
+    assert "rank 0 exited cleanly" in out, out
+    assert "rank 1 exited cleanly" in out, out
+    # Full warning block on the first tripped check...
+    assert "missing ranks: 1" in out, out
+    # ...the rate-limited repeat line on the next (same missing set)...
+    assert "Stall persists" in out, out
+    assert "repeat #" in out, out
+    # ...then escalation.
+    assert "Stall threshold exceeded" in out, out
+
+    # The escalation dumped bundles: rank 0 at the decision point, rank
+    # 1 via the flag riding the shutdown broadcast.
+    names = os.listdir(bundle_dir) if os.path.isdir(bundle_dir) else []
+    esc = [n for n in names if "escalation" in n]
+    assert esc, names
+    ranks_with_bundle = set()
+    for n in esc:
+        b, pending = _load_bundle(os.path.join(bundle_dir, n))
+        ranks_with_bundle.add(b["rank"])
+        if b["rank"] == 0:
+            entries = (pending or {}).get("pending") or []
+            assert any(e["name"] == "stalled" for e in entries), pending
+    assert 0 in ranks_with_bundle, names
+
+
+def test_repair_truncated_timeline(tmp_path):
+    """`hvd-trace --repair` fixes PRE-EXISTING truncated timelines from
+    before the emergency-finalize hook: mid-record truncation, dangling
+    comma, and an already-valid file (no-op)."""
+    good = [{"ph": "B", "ts": 1, "name": "a"},
+            {"ph": "E", "ts": 2, "name": "b"},
+            {"ph": "X", "ts": 3, "name": 'tricky "}" name'}]
+    body = "[\n" + ",\n".join(json.dumps(r) for r in good)
+
+    # Torn mid-record (SIGKILL mid-fprintf).
+    torn = tmp_path / "torn.json"
+    torn.write_text(body + ',\n{"ph": "B", "ts": 4, "na')
+    assert repair_timeline(str(torn)) is True
+    assert json.loads(torn.read_text()) == good
+
+    # Dangling comma after a complete record.
+    comma = tmp_path / "comma.json"
+    comma.write_text(body + ",\n")
+    assert repair_timeline(str(comma)) is True
+    assert json.loads(comma.read_text()) == good
+
+    # Already valid: untouched, reported as such.
+    ok = tmp_path / "ok.json"
+    ok.write_text(body + "\n]\n")
+    before = ok.read_text()
+    assert repair_timeline(str(ok)) is False
+    assert ok.read_text() == before
+
+    # The CLI wraps the same repair.
+    torn2 = tmp_path / "torn2.json"
+    torn2.write_text(body + ',\n{"ph": "B"')
+    cli = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bin", "hvd-trace"),
+         "--repair", str(torn2)],
+        capture_output=True, text=True, timeout=60)
+    assert cli.returncode == 0, cli.stdout + cli.stderr
+    assert "repaired" in cli.stdout
+    assert json.loads(torn2.read_text()) == good
+
+
+def test_serve_emitter_shares_shard_schema(tmp_path, monkeypatch):
+    """The pure-Python serve emitter writes shards the merge tool reads
+    with no special casing — and is a no-op without HVD_TPU_TRACE_DIR."""
+    from horovod_tpu.trace import emit
+
+    monkeypatch.delenv("HVD_TPU_TRACE_DIR", raising=False)
+    emit._shards.clear()
+    off = emit.shard_for("serve_r9")
+    assert not off.enabled
+    off.span("noop", 0, 1)  # must not write anywhere
+
+    trace_dir = tmp_path / "trace"
+    monkeypatch.setenv("HVD_TPU_TRACE_DIR", str(trace_dir))
+    emit._shards.clear()
+    em = emit.shard_for("serve_r9", rank=9)
+    assert em.enabled
+    t0 = emit.now_ns()
+    em.span("serve.batch", t0, emit.now_ns(), nbytes=4, cycle=7)
+
+    shard = trace_dir / "trace_serve_r9.jsonl"
+    merged = merge_shards([str(shard)])
+    assert 9 in merged.ranks
+    spans = merged.ranks[9]["spans"]
+    assert len(spans) == 1
+    assert spans[0]["n"] == "serve.batch"
+    assert spans[0]["p"] == emit.TRACE_REQUEST
+    assert spans[0]["b"] == 4 and spans[0]["c"] == 7
+    emit._shards.clear()
+
+
+def test_top_trc_column():
+    """hvd-top's trc cell: '-' for a summary predating the trace fields
+    (mixed-version elastic job), 'off' when tracing is disabled, span
+    rate when flowing, '/dN' suffix once the ring ever dropped."""
+    from horovod_tpu.run.top import _trc_state
+
+    assert _trc_state({}, None, 1.0, {}) == "-"
+    assert _trc_state({"trace_spans_total": 0}, None, 1.0, {}) == "off"
+    cur = {"trace_spans_total": 5000.0, "trace_spans_dropped_total": 0}
+    prev = {"trace_spans_total": 2000.0, "trace_spans_dropped_total": 0}
+    assert _trc_state(cur, prev, 2.0, {}) == "1.5k"
+    cur = {"trace_spans_total": 5000.0, "trace_spans_dropped_total": 37}
+    assert _trc_state(cur, prev, 2.0, {}) == "1.5k/d37"

@@ -1,0 +1,50 @@
+"""Flight-recorder e2e worker: rank HVD_TPU_KILL_RANK SIGKILLs itself —
+no cleanup, no goodbye frame — while the survivors are mid-negotiation
+on the "doomed" tensor. Every survivor must leave a post-mortem bundle
+(HVD_TPU_BUNDLE_DIR): the coordinator's via the connection-lost dump
+(pending table naming the missing rank and the in-flight tensor), the
+rest via connection-lost cascade or the launcher-teardown SIGTERM hook.
+With HVD_TPU_TIMELINE set, the test also proves rank 0's timeline file
+is a complete JSON array afterwards (the emergency-finalize hook)."""
+
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    kill_rank = int(os.environ.get("HVD_TPU_KILL_RANK", "1"))
+    assert 0 < kill_rank < n, "kill a NON-zero rank (timeline lives on 0)"
+
+    out = hvd.allreduce(np.ones(4, np.float32), "pre_kill")
+    assert np.allclose(out, n), out
+
+    if r == kill_rank:
+        # A beat so the survivors get "doomed" into the coordinator's
+        # pending table first — the bundle must name it as in-flight.
+        time.sleep(1.0)
+        print("rank %d: SIGKILL now" % r, flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    try:
+        hvd.allreduce(np.ones(4, np.float32), "doomed")
+    except Exception as e:
+        print("rank %d: collective failed after kill: %s" % (r, e),
+              flush=True)
+        return 1
+    # The collective can never complete; wait for the launcher teardown
+    # (its SIGTERM is itself a bundle trigger) instead of exiting on our
+    # own, which would make the survivor-bundle assertion vacuous.
+    time.sleep(300)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""Trace e2e worker: the LAST rank straggles HVD_TPU_TL_STRAGGLE seconds
+before joining the "straggled" allreduce, so every other rank's
+negotiate span for that tensor records the wait the straggler inflicted.
+The test merges the per-rank shards (HVD_TPU_TRACE_DIR) and asserts the
+critical-path table names the straggler and attributes the wait to
+negotiation."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    straggle = float(os.environ.get("HVD_TPU_TL_STRAGGLE", "2"))
+
+    # Warmup: populates the response cache and gives the control plane a
+    # few full cycles to piggyback clock samples on.
+    for i in range(5):
+        out = hvd.allreduce(np.ones(8, np.float32), "warmup.%d" % i)
+        assert np.allclose(out, n), out
+
+    if r == n - 1:
+        time.sleep(straggle)
+    out = hvd.allreduce(np.full(16, float(r + 1), np.float32), "straggled")
+    assert np.allclose(out, sum(range(1, n + 1))), out
+
+    # Post-straggle traffic so the trace has healthy spans on both sides
+    # of the event (and more ring hops for the causal check).
+    for i in range(5):
+        out = hvd.allreduce(np.ones(8, np.float32), "cooldown.%d" % i)
+        assert np.allclose(out, n), out
+
+    print("rank %d: straggler trace run done" % r, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
